@@ -1,0 +1,103 @@
+"""RL prompt datasets + samplers.
+
+Equivalent of the reference's dataset layer (verl ``RLHFDataset`` +
+``create_rl_dataset``/``create_rl_sampler``, reference
+``main_ppo.py:348-439``; OpenR1 preprocessing ``examples/data_preprocess/
+openr1.py:26-88``). Sources: in-memory records, JSONL, or parquet (via
+pyarrow when present). Each record carries ``prompt``, ``ground_truth``,
+``data_source`` and optional ``extra_info`` — the fields the reward layer
+dispatches on (SURVEY.md C17).
+"""
+
+from __future__ import annotations
+
+import json
+import random
+from dataclasses import dataclass, field
+from typing import Iterator, Sequence
+
+import numpy as np
+
+
+@dataclass
+class RLDataset:
+    records: list[dict]
+
+    @classmethod
+    def from_jsonl(cls, path: str) -> "RLDataset":
+        with open(path) as f:
+            return cls([json.loads(line) for line in f if line.strip()])
+
+    @classmethod
+    def from_parquet(cls, path: str, prompt_key: str = "prompt") -> "RLDataset":
+        import pyarrow.parquet as pq  # optional dep, present with pandas stacks
+
+        records = pq.read_table(path).to_pylist()
+        if prompt_key != "prompt":
+            for r in records:
+                r["prompt"] = r.get(prompt_key, r.get("prompt", ""))
+        return cls(records)
+
+    def __len__(self) -> int:
+        return len(self.records)
+
+    def __getitem__(self, i: int) -> dict:
+        return self.records[i]
+
+
+def make_sampler(n: int, kind: str = "random", seed: int = 0) -> Iterator[int]:
+    """random | sequential index stream (reference create_rl_sampler,
+    main_ppo.py:398-439; curriculum hooks slot in here)."""
+    rng = random.Random(seed)
+    while True:
+        order = list(range(n))
+        if kind == "random":
+            rng.shuffle(order)
+        yield from order
+
+
+class PromptDataLoader:
+    """Batches of raw records; stateful for checkpoint/resume (the reference
+    uses StatefulDataLoader, stream_ray_trainer.py:38)."""
+
+    def __init__(self, dataset: RLDataset, batch_size: int, shuffle: bool = True, seed: int = 0):
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.sampler = make_sampler(len(dataset), "random" if shuffle else "sequential", seed)
+        self.consumed = 0
+
+    def state_dict(self) -> dict:
+        return {"consumed": self.consumed}
+
+    def load_state_dict(self, state: dict) -> None:
+        for _ in range(state["consumed"]):
+            next(self.sampler)
+        self.consumed = state["consumed"]
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> list[dict]:
+        batch = [self.dataset[next(self.sampler)] for _ in range(self.batch_size)]
+        self.consumed += self.batch_size
+        return batch
+
+
+# -- synthetic arithmetic task for e2e tests/benchmarks ---------------------
+
+
+def make_arithmetic_dataset(n: int = 512, seed: int = 0, lo: int = 0, hi: int = 20) -> RLDataset:
+    """Tiny addition task: trainable end-to-end with the ByteTokenizer.
+    Serves the role of GSM8K in environments with no dataset downloads."""
+    rng = random.Random(seed)
+    records = []
+    for _ in range(n):
+        a, b = rng.randint(lo, hi), rng.randint(lo, hi)
+        records.append(
+            {
+                "prompt": f"{a}+{b}=",
+                "ground_truth": str(a + b),
+                "data_source": "gsm8k",  # routes to the gsm8k scorer (flexible)
+            }
+        )
+    return RLDataset(records)

@@ -1,0 +1,333 @@
+"""Functional transformer decoder (Llama-3 / Qwen-3 families), TPU-first.
+
+Replaces the reference's HF-transformers actor/critic modules wrapped in
+FSDP (reference ``stream_fsdp_workers.py:284-302``) and SGLang's serving
+model. One functional forward serves training (full-sequence, remat'd
+scan-over-layers) and rollout (incremental decode against a KV cache).
+
+Design choices (TPU rationale):
+- Params are plain pytrees (nested dicts of jnp arrays); layer params are
+  STACKED along a leading ``n_layers`` axis and the forward runs
+  ``lax.scan`` over them — one compiled layer body regardless of depth
+  (fast compile, XLA-friendly), with ``jax.checkpoint`` rematerialisation
+  for the training path (HBM↔FLOPs trade, SURVEY.md §2.2 FSDP row).
+- bf16 params/activations, f32 softmax/logits head.
+- GQA + RoPE (llama3 frequency scaling supported), RMSNorm, SwiGLU,
+  optional per-head QK-norm (Qwen3).
+- ``param_specs`` returns a matching PartitionSpec tree: params shard over
+  (fsdp, tp) — GSPMD inserts the all-gathers the reference got from FSDP
+  + NCCL (SURVEY.md §2.4 mapping).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from polyrl_tpu.ops.attention import attention, causal_mask
+from polyrl_tpu.parallel.mesh import DP, FSDP, SP, TP
+
+
+@dataclasses.dataclass(frozen=True)
+class RopeScaling:
+    """llama3-style NTK-by-parts frequency scaling (frozen → ModelConfig stays
+    hashable for use as a jit static argument)."""
+
+    factor: float = 8.0
+    low_freq_factor: float = 1.0
+    high_freq_factor: float = 4.0
+    original_max_position_embeddings: int = 8192
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 2048
+    intermediate_size: int = 5632
+    num_layers: int = 16
+    num_heads: int = 16
+    num_kv_heads: int = 8
+    head_dim: int | None = None  # default hidden/heads
+    rope_theta: float = 500000.0
+    rope_scaling: RopeScaling | None = None
+    rms_norm_eps: float = 1e-5
+    tie_word_embeddings: bool = False
+    use_qk_norm: bool = False  # Qwen3
+    max_position_embeddings: int = 131072
+    dtype: Any = jnp.bfloat16
+
+    @property
+    def head_dim_(self) -> int:
+        return self.head_dim or self.hidden_size // self.num_heads
+
+
+# -- presets ----------------------------------------------------------------
+
+PRESETS: dict[str, ModelConfig] = {
+    # test-size model for unit tests / CPU mesh dry runs
+    "tiny": ModelConfig(
+        vocab_size=512, hidden_size=64, intermediate_size=128, num_layers=2,
+        num_heads=4, num_kv_heads=2, rope_theta=10000.0, max_position_embeddings=512,
+    ),
+    # Llama-3.1-8B (HF config: meta-llama/Llama-3.1-8B)
+    "llama3-8b": ModelConfig(
+        vocab_size=128256, hidden_size=4096, intermediate_size=14336,
+        num_layers=32, num_heads=32, num_kv_heads=8, rope_theta=500000.0,
+        rope_scaling=RopeScaling(factor=8.0, low_freq_factor=1.0,
+                                 high_freq_factor=4.0,
+                                 original_max_position_embeddings=8192),
+    ),
+    # Qwen3-1.7B (the reference recipe model, run_async_grpo_pipeline.sh:17)
+    "qwen3-1.7b": ModelConfig(
+        vocab_size=151936, hidden_size=2048, intermediate_size=6144,
+        num_layers=28, num_heads=16, num_kv_heads=8, head_dim=128,
+        rope_theta=1000000.0, use_qk_norm=True, tie_word_embeddings=True,
+    ),
+    # Qwen3-8B
+    "qwen3-8b": ModelConfig(
+        vocab_size=151936, hidden_size=4096, intermediate_size=12288,
+        num_layers=36, num_heads=32, num_kv_heads=8, head_dim=128,
+        rope_theta=1000000.0, use_qk_norm=True,
+    ),
+}
+
+
+def get_config(name: str, **overrides) -> ModelConfig:
+    return dataclasses.replace(PRESETS[name], **overrides)
+
+
+# -- init -------------------------------------------------------------------
+
+
+def init_params(rng: jax.Array, cfg: ModelConfig) -> dict:
+    """Initialise stacked-layer params. Normal(0.02) like the HF default."""
+    hd = cfg.head_dim_
+    d, f, L = cfg.hidden_size, cfg.intermediate_size, cfg.num_layers
+    hq, hkv = cfg.num_heads, cfg.num_kv_heads
+    keys = jax.random.split(rng, 8)
+    std = 0.02
+
+    def norm(key, *shape):
+        return (jax.random.normal(key, shape, dtype=jnp.float32) * std).astype(cfg.dtype)
+
+    params = {
+        "embed": norm(keys[0], cfg.vocab_size, d),
+        "final_norm": jnp.ones((d,), dtype=cfg.dtype),
+        "layers": {
+            "attn_norm": jnp.ones((L, d), dtype=cfg.dtype),
+            "mlp_norm": jnp.ones((L, d), dtype=cfg.dtype),
+            "wq": norm(keys[1], L, d, hq * hd),
+            "wk": norm(keys[2], L, d, hkv * hd),
+            "wv": norm(keys[3], L, d, hkv * hd),
+            "wo": norm(keys[4], L, hq * hd, d),
+            "w_gate": norm(keys[5], L, d, f),
+            "w_up": norm(keys[6], L, d, f),
+            "w_down": norm(keys[7], L, f, d),
+        },
+    }
+    if cfg.use_qk_norm:
+        params["layers"]["q_norm"] = jnp.ones((L, hd), dtype=cfg.dtype)
+        params["layers"]["k_norm"] = jnp.ones((L, hd), dtype=cfg.dtype)
+    if not cfg.tie_word_embeddings:
+        params["lm_head"] = norm(jax.random.fold_in(rng, 99), d, cfg.vocab_size)
+    return params
+
+
+def param_specs(cfg: ModelConfig) -> dict:
+    """PartitionSpec tree matching ``init_params`` (fsdp × tp sharding)."""
+    layer = {
+        "attn_norm": P(None, None),
+        "mlp_norm": P(None, None),
+        "wq": P(None, FSDP, TP),
+        "wk": P(None, FSDP, TP),
+        "wv": P(None, FSDP, TP),
+        "wo": P(None, TP, FSDP),
+        "w_gate": P(None, FSDP, TP),
+        "w_up": P(None, FSDP, TP),
+        "w_down": P(None, TP, FSDP),
+    }
+    if cfg.use_qk_norm:
+        layer["q_norm"] = P(None, None)
+        layer["k_norm"] = P(None, None)
+    specs = {
+        "embed": P(TP, FSDP),
+        "final_norm": P(None),
+        "layers": layer,
+    }
+    if not cfg.tie_word_embeddings:
+        specs["lm_head"] = P(FSDP, TP)
+    return specs
+
+
+# -- building blocks --------------------------------------------------------
+
+
+def rms_norm(x: jnp.ndarray, weight: jnp.ndarray, eps: float) -> jnp.ndarray:
+    dtype = x.dtype
+    x = x.astype(jnp.float32)
+    var = jnp.mean(x * x, axis=-1, keepdims=True)
+    x = x * jax.lax.rsqrt(var + eps)
+    return (x * weight.astype(jnp.float32)).astype(dtype)
+
+
+def _rope_freqs(cfg: ModelConfig) -> np.ndarray:
+    hd = cfg.head_dim_
+    freqs = 1.0 / (cfg.rope_theta ** (np.arange(0, hd, 2, dtype=np.float64) / hd))
+    if cfg.rope_scaling:
+        # llama3 NTK-by-parts frequency scaling (HF rope_scaling type="llama3")
+        s = cfg.rope_scaling
+        factor = s.factor
+        low, high = s.low_freq_factor, s.high_freq_factor
+        old_len = s.original_max_position_embeddings
+        wavelen = 2 * np.pi / freqs
+        ratio = old_len / wavelen
+        smooth = np.clip((ratio - low) / (high - low), 0.0, 1.0)
+        scaled = np.where(
+            wavelen > old_len / low,  # low-frequency: fully scale
+            freqs / factor,
+            np.where(
+                wavelen < old_len / high,  # high-frequency: keep
+                freqs,
+                (1 - smooth) * freqs / factor + smooth * freqs,
+            ),
+        )
+        freqs = scaled
+    return freqs.astype(np.float32)
+
+
+def rope_cos_sin(cfg: ModelConfig, positions: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """positions [B, T] → (cos, sin) [B, T, hd/2] in f32."""
+    freqs = jnp.asarray(_rope_freqs(cfg))
+    angles = positions.astype(jnp.float32)[..., None] * freqs[None, None, :]
+    return jnp.cos(angles), jnp.sin(angles)
+
+
+def apply_rope(x: jnp.ndarray, cos: jnp.ndarray, sin: jnp.ndarray) -> jnp.ndarray:
+    """x [B, T, H, D]; rotate-half convention (HF Llama/Qwen)."""
+    d2 = x.shape[-1] // 2
+    x1, x2 = x[..., :d2], x[..., d2:]
+    cos = cos[:, :, None, :].astype(jnp.float32)
+    sin = sin[:, :, None, :].astype(jnp.float32)
+    x1f, x2f = x1.astype(jnp.float32), x2.astype(jnp.float32)
+    out1 = x1f * cos - x2f * sin
+    out2 = x2f * cos + x1f * sin
+    return jnp.concatenate([out1, out2], axis=-1).astype(x.dtype)
+
+
+# -- forward ----------------------------------------------------------------
+
+
+def _layer_forward(cfg, x, lp, cos, sin, mask, layer_cache):
+    """One decoder layer. layer_cache: None or (k_cache, v_cache) [B, S, Hkv, D]
+    already containing past KV; this layer writes its new KV at write_idx."""
+    b, t, d = x.shape
+    hq, hkv, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim_
+
+    h = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
+    q = (h @ lp["wq"]).reshape(b, t, hq, hd)
+    k = (h @ lp["wk"]).reshape(b, t, hkv, hd)
+    v = (h @ lp["wv"]).reshape(b, t, hkv, hd)
+    if cfg.use_qk_norm:
+        q = rms_norm(q, lp["q_norm"], cfg.rms_norm_eps)
+        k = rms_norm(k, lp["k_norm"], cfg.rms_norm_eps)
+    q = apply_rope(q, cos, sin)
+    k = apply_rope(k, cos, sin)
+
+    if layer_cache is not None:
+        k_cache, v_cache, write_idx = layer_cache
+        k_full = jax.lax.dynamic_update_slice(k_cache, k.astype(k_cache.dtype), (0, write_idx, 0, 0))
+        v_full = jax.lax.dynamic_update_slice(v_cache, v.astype(v_cache.dtype), (0, write_idx, 0, 0))
+        attn_out = attention(q, k_full, v_full, mask=mask)
+        new_cache = (k_full, v_full)
+    else:
+        attn_out = attention(q, k, v, mask=mask)
+        new_cache = None
+
+    attn_out = attn_out.reshape(b, t, hq * hd) @ lp["wo"]
+    x = x + attn_out
+
+    h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
+    gate = jax.nn.silu((h @ lp["w_gate"]).astype(jnp.float32)).astype(h.dtype)
+    mlp_out = (gate * (h @ lp["w_up"])) @ lp["w_down"]
+    x = x + mlp_out
+    return x, new_cache
+
+
+def forward(
+    params: dict,
+    cfg: ModelConfig,
+    input_ids: jnp.ndarray,          # [B, T]
+    positions: jnp.ndarray,          # [B, T] absolute positions (left-pad aware)
+    attn_mask: jnp.ndarray,          # [B, Tk] 1=valid token (Tk = T, or cache len when cache given)
+    cache: tuple | None = None,      # (k, v) each [L, B, S, Hkv, D]
+    write_idx: int | jnp.ndarray = 0,
+    remat: bool = False,
+) -> tuple[jnp.ndarray, tuple | None]:
+    """Returns (logits [B, T, V] float32, new_cache or None).
+
+    Without cache: full-sequence causal forward (training / prefill-scoring).
+    With cache: attends over the cache buffer [B, S]; the current chunk's KV
+    is written at ``write_idx``; ``attn_mask`` must be [B, S] marking valid
+    cache slots INCLUDING the chunk being written.
+    """
+    b, t = input_ids.shape
+    x = params["embed"][input_ids]  # gather; sharded over tp on vocab dim
+
+    cos, sin = rope_cos_sin(cfg, positions)
+
+    if cache is None:
+        # causal within the chunk + padding mask
+        cm = causal_mask(t, t)  # [T, T]
+        mask = cm[None, None, :, :] & (attn_mask[:, None, None, :] > 0)
+    else:
+        # left-padded layout: cache slot order == temporal order, so the
+        # causal constraint is expressed in slot indices, not positions.
+        s = cache[0].shape[2]
+        kv_pos = jnp.arange(s)[None, None, None, :]
+        slot_written = kv_pos <= (write_idx + t - 1)  # slots at/below the chunk
+        causal = kv_pos <= (write_idx + jnp.arange(t)[None, None, :, None])
+        mask = causal & slot_written & (attn_mask[:, None, None, :] > 0)
+
+    layers = params["layers"]
+
+    if cache is None:
+        def body(x, lp):
+            x, _ = _layer_forward(cfg, x, lp, cos, sin, mask, None)
+            return x, None
+        if remat:
+            body = jax.checkpoint(body)
+        x, _ = jax.lax.scan(body, x, layers)
+        new_cache = None
+    else:
+        k_cache, v_cache = cache
+
+        def body(x, scanned):
+            lp, kc, vc = scanned
+            x, (kf, vf) = _layer_forward(cfg, x, lp, cos, sin, mask, (kc, vc, write_idx))
+            return x, (kf, vf)
+
+        x, (k_new, v_new) = jax.lax.scan(body, x, (layers, k_cache, v_cache))
+        new_cache = (k_new, v_new)
+
+    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    head = params["embed"].T if cfg.tie_word_embeddings else params["lm_head"]
+    logits = jnp.einsum("btd,dv->btv", x, head, preferred_element_type=jnp.float32)
+    return logits, new_cache
+
+
+def make_cache(cfg: ModelConfig, batch: int, max_len: int, dtype=None) -> tuple:
+    """Allocate a zeroed KV cache: (k, v) each [L, B, S, Hkv, D]."""
+    dtype = dtype or cfg.dtype
+    shape = (cfg.num_layers, batch, max_len, cfg.num_kv_heads, cfg.head_dim_)
+    return (jnp.zeros(shape, dtype=dtype), jnp.zeros(shape, dtype=dtype))
+
+
+def cache_specs(cfg: ModelConfig) -> P:
+    """KV cache sharding: batch over (dp, fsdp), heads over tp."""
+    return P(None, (DP, FSDP), None, TP, None)

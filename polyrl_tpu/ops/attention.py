@@ -1,0 +1,65 @@
+"""Attention ops: dense reference implementation + dispatch point for Pallas.
+
+The reference's training attention is flash-attn varlen (SURVEY.md §2.2,
+``stream_dp_actor.py:41-43``) and its rollout attention is SGLang
+RadixAttention/paged-KV CUDA kernels. Here the contract is a single
+``attention`` entry: a dense, mask-based implementation that XLA fuses well
+at v0, with the same signature later served by Pallas splash/ragged kernels
+(see polyrl_tpu/ops/pallas/).
+
+Shapes follow TPU-friendly layout [B, T, H, D] (batch, seq, heads, head_dim)
+— contraction dims land on the MXU lanes, and the seq dim stays shardable
+along the ``sp`` mesh axis.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def repeat_kv(x: jnp.ndarray, n_rep: int) -> jnp.ndarray:
+    """GQA: repeat KV heads to match Q heads. [B, T, Hkv, D] → [B, T, Hkv*n, D]."""
+    if n_rep == 1:
+        return x
+    b, t, h, d = x.shape
+    return jnp.broadcast_to(x[:, :, :, None, :], (b, t, h, n_rep, d)).reshape(b, t, h * n_rep, d)
+
+
+def causal_mask(q_len: int, kv_len: int, q_offset: jnp.ndarray | int = 0) -> jnp.ndarray:
+    """[q_len, kv_len] boolean mask; True = attend. q position i sits at
+    absolute position q_offset + i."""
+    q_pos = jnp.arange(q_len)[:, None] + q_offset
+    kv_pos = jnp.arange(kv_len)[None, :]
+    return kv_pos <= q_pos
+
+
+def attention(
+    q: jnp.ndarray,  # [B, Tq, Hq, D]
+    k: jnp.ndarray,  # [B, Tk, Hkv, D]
+    v: jnp.ndarray,  # [B, Tk, Hkv, D]
+    mask: jnp.ndarray | None = None,  # broadcastable to [B, Hq, Tq, Tk]; True=attend
+    scale: float | None = None,
+    logits_dtype: jnp.dtype = jnp.float32,
+) -> jnp.ndarray:
+    """Dense scaled-dot-product attention with GQA.
+
+    Softmax runs in float32 (MXU accumulates f32 anyway; keeps logprob math
+    trustworthy for token-level continuation — SURVEY.md §7 hard part #1).
+    """
+    b, tq, hq, d = q.shape
+    hkv = k.shape[2]
+    if hq != hkv:
+        n_rep = hq // hkv
+        k = repeat_kv(k, n_rep)
+        v = repeat_kv(v, n_rep)
+    scale = scale if scale is not None else d ** -0.5
+
+    # [B, H, Tq, Tk]
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=logits_dtype)
+    logits = logits * scale
+    if mask is not None:
+        logits = jnp.where(mask, logits, jnp.finfo(logits_dtype).min)
+    probs = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+    return out

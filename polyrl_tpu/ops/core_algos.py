@@ -1,0 +1,425 @@
+"""Core RL algorithms: advantage estimators, policy/value losses, KL penalties.
+
+TPU-native reimplementation of the algorithmic surface the reference consumes
+from verl's ``core_algos`` (see SURVEY.md §2.5; consumed at reference
+``rlboost/verl_stream/workers/actor/stream_dp_actor.py:178-193`` and
+``rlboost/verl_stream/workers/critic/stream_dp_critic.py:106-113``).
+
+Everything here is a pure function on ``jnp`` arrays, jit-safe (static
+shapes, no data-dependent Python control flow), and mask-aware: ``mask`` is
+1.0 for response tokens and 0.0 for prompt/padding tokens. Shapes are
+``[batch, seq]`` unless noted.
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+_EPS = 1e-8
+
+
+class AdvantageEstimator(str, enum.Enum):
+    """Advantage estimators (reference enum at stream_ray_trainer.py:50,377,387)."""
+
+    GAE = "gae"
+    GRPO = "grpo"
+    REINFORCE_PLUS_PLUS = "reinforce_plus_plus"
+    REMAX = "remax"
+    RLOO = "rloo"
+
+
+# ---------------------------------------------------------------------------
+# masked statistics helpers
+# ---------------------------------------------------------------------------
+
+
+def masked_sum(x: jnp.ndarray, mask: jnp.ndarray, axis=None) -> jnp.ndarray:
+    return jnp.sum(x * mask, axis=axis)
+
+
+def masked_mean(x: jnp.ndarray, mask: jnp.ndarray, axis=None) -> jnp.ndarray:
+    return masked_sum(x, mask, axis=axis) / (jnp.sum(mask, axis=axis) + _EPS)
+
+
+def masked_var(x: jnp.ndarray, mask: jnp.ndarray, unbiased: bool = True) -> jnp.ndarray:
+    mean = masked_mean(x, mask)
+    var = masked_mean((x - mean) ** 2, mask)
+    if unbiased:
+        n = jnp.sum(mask)
+        var = var * n / jnp.clip(n - 1.0, min=1.0)
+    return var
+
+
+def masked_whiten(x: jnp.ndarray, mask: jnp.ndarray, shift_mean: bool = True) -> jnp.ndarray:
+    """Whiten ``x`` over masked entries (used before PPO policy loss with GAE)."""
+    mean = masked_mean(x, mask)
+    var = masked_var(x, mask)
+    whitened = (x - mean) * jax.lax.rsqrt(var + _EPS)
+    if not shift_mean:
+        whitened = whitened + mean
+    return whitened * mask
+
+
+# ---------------------------------------------------------------------------
+# advantage estimators
+# ---------------------------------------------------------------------------
+
+
+def compute_gae_advantage_return(
+    token_level_rewards: jnp.ndarray,
+    values: jnp.ndarray,
+    response_mask: jnp.ndarray,
+    gamma: float = 1.0,
+    lam: float = 1.0,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Generalized Advantage Estimation over the response region.
+
+    Returns ``(advantages, returns)``; advantages are whitened over the mask.
+    Implemented as a reverse ``lax.scan`` over the time axis (TPU-friendly —
+    no Python loop over sequence length).
+    """
+    seq_len = token_level_rewards.shape[-1]
+
+    # next value: values shifted left; zeroed where the NEXT token is invalid
+    # (i.e. no bootstrap past the last response token).
+    next_values = jnp.concatenate(
+        [values[:, 1:], jnp.zeros_like(values[:, :1])], axis=-1
+    )
+    next_mask = jnp.concatenate(
+        [response_mask[:, 1:], jnp.zeros_like(response_mask[:, :1])], axis=-1
+    )
+    deltas = token_level_rewards + gamma * next_values * next_mask - values
+
+    def backward_step(carry, xs):
+        delta_t, mask_t = xs
+        lastgaelam = delta_t + gamma * lam * carry
+        # where masked, carry advantage through unchanged
+        lastgaelam = jnp.where(mask_t > 0, lastgaelam, carry)
+        return lastgaelam, lastgaelam
+
+    init = jnp.zeros(token_level_rewards.shape[0], dtype=token_level_rewards.dtype)
+    xs = (jnp.moveaxis(deltas, -1, 0)[::-1], jnp.moveaxis(response_mask, -1, 0)[::-1])
+    _, advs_rev = jax.lax.scan(backward_step, init, xs)
+    advantages = jnp.moveaxis(advs_rev[::-1], 0, -1)
+    returns = advantages + values
+    advantages = masked_whiten(advantages, response_mask)
+    return advantages * response_mask, returns * response_mask
+
+
+def compute_grpo_outcome_advantage(
+    token_level_rewards: jnp.ndarray,
+    response_mask: jnp.ndarray,
+    group_ids: jnp.ndarray,
+    norm_adv_by_std: bool = True,
+    num_groups: int | None = None,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """GRPO outcome advantage: per-group reward z-score broadcast over tokens.
+
+    ``group_ids`` is an int array [batch] mapping each trajectory to its
+    prompt group (the reference unrolls ``n`` samples per prompt —
+    sglang_rollout_remote.py:198-225). Implemented with segment sums so it
+    stays jit-compatible for any grouping.
+    """
+    scores = masked_sum(token_level_rewards, response_mask, axis=-1)  # [B]
+    if num_groups is None:
+        num_groups = int(scores.shape[0])
+
+    ones = jnp.ones_like(scores)
+    group_count = jax.ops.segment_sum(ones, group_ids, num_segments=num_groups)
+    group_sum = jax.ops.segment_sum(scores, group_ids, num_segments=num_groups)
+    group_mean = group_sum / jnp.clip(group_count, min=1.0)
+    centered = scores - group_mean[group_ids]
+    if norm_adv_by_std:
+        group_sqsum = jax.ops.segment_sum(centered**2, group_ids, num_segments=num_groups)
+        group_std = jnp.sqrt(group_sqsum / jnp.clip(group_count - 1.0, min=1.0))
+        centered = centered / (group_std[group_ids] + _EPS)
+    advantages = centered[:, None] * response_mask
+    return advantages, advantages
+
+
+def compute_rloo_outcome_advantage(
+    token_level_rewards: jnp.ndarray,
+    response_mask: jnp.ndarray,
+    group_ids: jnp.ndarray,
+    num_groups: int | None = None,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """RLOO: leave-one-out baseline within each prompt group."""
+    scores = masked_sum(token_level_rewards, response_mask, axis=-1)
+    if num_groups is None:
+        num_groups = int(scores.shape[0])
+    ones = jnp.ones_like(scores)
+    group_count = jax.ops.segment_sum(ones, group_ids, num_segments=num_groups)
+    group_sum = jax.ops.segment_sum(scores, group_ids, num_segments=num_groups)
+    n = group_count[group_ids]
+    loo_baseline = (group_sum[group_ids] - scores) / jnp.clip(n - 1.0, min=1.0)
+    adv = jnp.where(n > 1, scores - loo_baseline, scores)
+    advantages = adv[:, None] * response_mask
+    return advantages, advantages
+
+
+def compute_reinforce_plus_plus_outcome_advantage(
+    token_level_rewards: jnp.ndarray,
+    response_mask: jnp.ndarray,
+    gamma: float = 1.0,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """REINFORCE++: discounted reward-to-go, globally whitened."""
+
+    def backward_step(carry, xs):
+        reward_t, mask_t = xs
+        running = reward_t + gamma * carry
+        running = jnp.where(mask_t > 0, running, carry)
+        return running, running
+
+    init = jnp.zeros(token_level_rewards.shape[0], dtype=token_level_rewards.dtype)
+    xs = (
+        jnp.moveaxis(token_level_rewards, -1, 0)[::-1],
+        jnp.moveaxis(response_mask, -1, 0)[::-1],
+    )
+    _, ret_rev = jax.lax.scan(backward_step, init, xs)
+    returns = jnp.moveaxis(ret_rev[::-1], 0, -1) * response_mask
+    advantages = masked_whiten(returns, response_mask)
+    return advantages * response_mask, returns
+
+
+def compute_remax_outcome_advantage(
+    token_level_rewards: jnp.ndarray,
+    reward_baselines: jnp.ndarray,
+    response_mask: jnp.ndarray,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """ReMax: subtract the greedy-rollout baseline reward [batch]."""
+    scores = masked_sum(token_level_rewards, response_mask, axis=-1)
+    returns = (scores - reward_baselines)[:, None] * response_mask
+    return returns, returns
+
+
+# ---------------------------------------------------------------------------
+# KL penalties (reference: verl core_algos.kl_penalty, applied at
+# stream_ray_trainer.py:465-498 via apply_kl_penalty)
+# ---------------------------------------------------------------------------
+
+
+def kl_penalty(
+    logprob: jnp.ndarray,
+    ref_logprob: jnp.ndarray,
+    penalty: str = "kl",
+) -> jnp.ndarray:
+    """Per-token KL penalty between policy and reference logprobs."""
+    if penalty == "kl":
+        return logprob - ref_logprob
+    if penalty == "abs":
+        return jnp.abs(logprob - ref_logprob)
+    if penalty == "mse":
+        return 0.5 * (logprob - ref_logprob) ** 2
+    if penalty in ("low_var_kl", "k3"):
+        # k3 estimator: exp(r) - r - 1 with r = ref - logprob; low variance,
+        # non-negative. Clipped for numerical safety.
+        kl = ref_logprob - logprob
+        ratio = jnp.exp(jnp.clip(kl, -20.0, 20.0))
+        return jnp.clip(ratio - kl - 1.0, -10.0, 10.0)
+    raise NotImplementedError(f"unknown kl penalty: {penalty}")
+
+
+def apply_kl_penalty(
+    token_level_scores: jnp.ndarray,
+    logprob: jnp.ndarray,
+    ref_logprob: jnp.ndarray,
+    response_mask: jnp.ndarray,
+    kl_coef: float,
+    penalty: str = "kl",
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Fold a KL penalty into token-level rewards; returns (rewards, mean_kl)."""
+    kld = kl_penalty(logprob, ref_logprob, penalty) * response_mask
+    token_level_rewards = token_level_scores - kl_coef * kld
+    return token_level_rewards, masked_mean(kld, response_mask)
+
+
+# ---------------------------------------------------------------------------
+# loss aggregation (verl agg_loss; consumed at stream_dp_actor.py:178-193)
+# ---------------------------------------------------------------------------
+
+
+def agg_loss(
+    loss_mat: jnp.ndarray,
+    loss_mask: jnp.ndarray,
+    loss_agg_mode: str = "token-mean",
+) -> jnp.ndarray:
+    """Aggregate a [B, T] per-token loss into a scalar."""
+    if loss_agg_mode == "token-mean":
+        return masked_mean(loss_mat, loss_mask)
+    if loss_agg_mode == "seq-mean-token-sum":
+        seq_losses = masked_sum(loss_mat, loss_mask, axis=-1)
+        return jnp.mean(seq_losses)
+    if loss_agg_mode == "seq-mean-token-mean":
+        seq_losses = masked_mean(loss_mat, loss_mask, axis=-1)
+        return jnp.mean(seq_losses)
+    if loss_agg_mode == "seq-mean-token-sum-norm":
+        seq_losses = masked_sum(loss_mat, loss_mask, axis=-1)
+        return jnp.sum(seq_losses) / loss_mask.shape[-1]
+    raise NotImplementedError(f"unknown loss_agg_mode: {loss_agg_mode}")
+
+
+# ---------------------------------------------------------------------------
+# policy losses (vanilla / gpg / clip_cov — reference dispatch at
+# stream_dp_actor.py:178-182 via get_policy_loss_fn)
+# ---------------------------------------------------------------------------
+
+
+def compute_policy_loss_vanilla(
+    old_log_prob: jnp.ndarray,
+    log_prob: jnp.ndarray,
+    advantages: jnp.ndarray,
+    response_mask: jnp.ndarray,
+    clip_ratio: float = 0.2,
+    clip_ratio_low: float | None = None,
+    clip_ratio_high: float | None = None,
+    clip_ratio_c: float = 3.0,
+    loss_agg_mode: str = "token-mean",
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """PPO clipped surrogate with dual-clip.
+
+    Returns (loss, clipfrac, approx_kl, clipfrac_lower).
+    """
+    lo = clip_ratio_low if clip_ratio_low is not None else clip_ratio
+    hi = clip_ratio_high if clip_ratio_high is not None else clip_ratio
+
+    negative_approx_kl = jnp.clip(log_prob - old_log_prob, -20.0, 20.0)
+    ratio = jnp.exp(negative_approx_kl)
+    approx_kl = masked_mean(-negative_approx_kl, response_mask)
+
+    pg_losses1 = -advantages * ratio
+    pg_losses2 = -advantages * jnp.clip(ratio, 1.0 - lo, 1.0 + hi)
+    clip_pg_losses1 = jnp.maximum(pg_losses1, pg_losses2)
+    clipfrac = masked_mean((pg_losses2 > pg_losses1).astype(jnp.float32), response_mask)
+
+    # dual-clip: bound the loss when advantage < 0 and ratio explodes
+    pg_losses3 = -advantages * clip_ratio_c
+    clip_pg_losses2 = jnp.minimum(pg_losses3, clip_pg_losses1)
+    clipfrac_lower = masked_mean(
+        ((clip_pg_losses1 > pg_losses3) & (advantages < 0)).astype(jnp.float32),
+        response_mask,
+    )
+    pg_losses = jnp.where(advantages < 0, clip_pg_losses2, clip_pg_losses1)
+    pg_loss = agg_loss(pg_losses, response_mask, loss_agg_mode)
+    return pg_loss, clipfrac, approx_kl, clipfrac_lower
+
+
+def compute_policy_loss_gpg(
+    old_log_prob: jnp.ndarray,
+    log_prob: jnp.ndarray,
+    advantages: jnp.ndarray,
+    response_mask: jnp.ndarray,
+    loss_agg_mode: str = "token-mean",
+    **_: object,
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """GPG: plain policy-gradient loss (no ratio, no clip)."""
+    pg_losses = -log_prob * advantages
+    pg_loss = agg_loss(pg_losses, response_mask, loss_agg_mode)
+    zero = jnp.zeros((), dtype=pg_loss.dtype)
+    return pg_loss, zero, zero, zero
+
+
+def compute_policy_loss_clip_cov(
+    old_log_prob: jnp.ndarray,
+    log_prob: jnp.ndarray,
+    advantages: jnp.ndarray,
+    response_mask: jnp.ndarray,
+    clip_ratio: float = 0.2,
+    clip_ratio_low: float | None = None,
+    clip_ratio_high: float | None = None,
+    clip_cov_ratio: float = 0.0002,
+    clip_cov_lb: float = 1.0,
+    clip_cov_ub: float = 5.0,
+    loss_agg_mode: str = "token-mean",
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Clip-Cov: unclip the highest-covariance tokens to keep exploration.
+
+    Tokens whose covariance cov(logp, A) falls within [lb, ub] are candidates
+    for clipping exemption; the top ``clip_cov_ratio`` fraction by covariance
+    is exempted from the PPO clip. jit-safe via a static top-k size.
+    """
+    lo = clip_ratio_low if clip_ratio_low is not None else clip_ratio
+    hi = clip_ratio_high if clip_ratio_high is not None else clip_ratio
+
+    negative_approx_kl = jnp.clip(log_prob - old_log_prob, -20.0, 20.0)
+    ratio = jnp.exp(negative_approx_kl)
+    approx_kl = masked_mean(-negative_approx_kl, response_mask)
+
+    pg_losses1 = -advantages * ratio
+    pg_losses2 = -advantages * jnp.clip(ratio, 1.0 - lo, 1.0 + hi)
+
+    corr = jnp.ones_like(advantages)
+    centered_lp = log_prob - masked_mean(log_prob, response_mask)
+    centered_adv = advantages - masked_mean(advantages, response_mask)
+    cov = centered_lp * centered_adv
+    cov = jnp.where(response_mask > 0, cov, -jnp.inf)
+    in_band = (cov >= clip_cov_lb) & (cov <= clip_cov_ub)
+
+    n_tokens = advantages.shape[0] * advantages.shape[1]
+    k = max(int(n_tokens * clip_cov_ratio), 1)
+    flat_cov = jnp.where(in_band.reshape(-1), cov.reshape(-1), -jnp.inf)
+    _, topk_idx = jax.lax.top_k(flat_cov, k)
+    corr = corr.reshape(-1).at[topk_idx].set(0.0).reshape(advantages.shape)
+    # only exempt where cov was finite (top_k may select -inf when few valid)
+    corr = jnp.where(jnp.isfinite(flat_cov.reshape(advantages.shape)), corr, 1.0)
+
+    clipped = (pg_losses2 > pg_losses1).astype(jnp.float32) * corr
+    clipfrac = masked_mean(clipped, response_mask)
+    pg_losses = jnp.maximum(pg_losses1, pg_losses2) * corr + pg_losses1 * (1.0 - corr)
+    pg_loss = agg_loss(pg_losses, response_mask, loss_agg_mode)
+    return pg_loss, clipfrac, approx_kl, jnp.zeros_like(clipfrac)
+
+
+POLICY_LOSS_FNS: dict[str, Callable] = {
+    "vanilla": compute_policy_loss_vanilla,
+    "gpg": compute_policy_loss_gpg,
+    "clip_cov": compute_policy_loss_clip_cov,
+}
+
+
+def get_policy_loss_fn(name: str = "vanilla") -> Callable:
+    """Policy-loss dispatch (reference: stream_dp_actor.py:178-182)."""
+    try:
+        return POLICY_LOSS_FNS[name]
+    except KeyError:
+        raise NotImplementedError(f"unknown policy loss: {name}") from None
+
+
+# ---------------------------------------------------------------------------
+# value loss (verl compute_value_loss; consumed at stream_dp_critic.py:106)
+# ---------------------------------------------------------------------------
+
+
+def compute_value_loss(
+    vpreds: jnp.ndarray,
+    returns: jnp.ndarray,
+    values: jnp.ndarray,
+    response_mask: jnp.ndarray,
+    cliprange_value: float = 0.5,
+    loss_agg_mode: str = "token-mean",
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Clipped value loss; returns (loss, clipfrac)."""
+    vpredclipped = jnp.clip(vpreds, values - cliprange_value, values + cliprange_value)
+    vf_losses1 = (vpreds - returns) ** 2
+    vf_losses2 = (vpredclipped - returns) ** 2
+    clipped = jnp.maximum(vf_losses1, vf_losses2)
+    vf_loss = 0.5 * agg_loss(clipped, response_mask, loss_agg_mode)
+    vf_clipfrac = masked_mean((vf_losses2 > vf_losses1).astype(jnp.float32), response_mask)
+    return vf_loss, vf_clipfrac
+
+
+def entropy_from_logits(logits: jnp.ndarray) -> jnp.ndarray:
+    """Token-level entropy of a categorical distribution from raw logits."""
+    logz = jax.scipy.special.logsumexp(logits, axis=-1, keepdims=True)
+    logp = logits - logz
+    return -jnp.sum(jnp.exp(logp) * logp, axis=-1)
+
+
+def logprobs_from_logits(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
+    """Per-token logprob of ``labels`` under ``logits`` ([..., V] → [...])."""
+    logz = jax.scipy.special.logsumexp(logits, axis=-1)
+    label_logits = jnp.take_along_axis(logits, labels[..., None], axis=-1)[..., 0]
+    return label_logits - logz

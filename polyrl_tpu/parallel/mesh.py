@@ -1,0 +1,102 @@
+"""Device mesh construction and named sharding axes.
+
+TPU-native equivalent of the reference's parallelism inventory (SURVEY.md
+§2.3). The reference composes FSDP sharding + rollout dp×infer_tp×infer_pp
+meshes (``stream_fsdp_workers.py:126-135``) + Ulysses SP; here all of it is
+one ``jax.sharding.Mesh`` with four logical axes:
+
+- ``dp``    data parallel (batch dim)
+- ``fsdp``  ZeRO-style parameter sharding (combines with dp for the batch)
+- ``tp``    tensor/model parallel (MXU-dim sharding, rides ICI)
+- ``sp``    sequence/context parallel (Ulysses all-to-all or ring attention)
+
+Training batches shard over (dp, fsdp); params shard over (fsdp, tp);
+sequence dim over sp. XLA inserts the collectives (GSPMD), so FSDP
+all-gather/reduce-scatter and the TP broadcast of the reference's NCCL world
+disappear into the compiled program.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DP, FSDP, TP, SP = "dp", "fsdp", "tp", "sp"
+AXES = (DP, FSDP, TP, SP)
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    dp: int = 1
+    fsdp: int = -1  # -1: absorb remaining devices
+    tp: int = 1
+    sp: int = 1
+
+    def resolve(self, n_devices: int) -> tuple[int, int, int, int]:
+        dims = [self.dp, self.fsdp, self.tp, self.sp]
+        fixed = 1
+        for d in dims:
+            if d != -1:
+                fixed *= d
+        if n_devices % fixed != 0:
+            raise ValueError(f"{n_devices} devices not divisible by fixed axes {fixed}")
+        free = n_devices // fixed
+        dims = [free if d == -1 else d for d in dims]
+        if int(np.prod(dims)) != n_devices:
+            raise ValueError(f"mesh {dims} != {n_devices} devices (use one -1 axis)")
+        return tuple(dims)
+
+
+def make_mesh(config: MeshConfig | None = None, devices: Sequence[jax.Device] | None = None) -> Mesh:
+    """Build the 4-axis training/rollout mesh.
+
+    Axis order is (dp, fsdp, tp, sp) outermost→innermost so tp (the
+    latency-critical axis) lands on the innermost, fastest ICI ring.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    config = config or MeshConfig()
+    dims = config.resolve(len(devices))
+    dev_array = np.array(devices).reshape(dims)
+    return Mesh(dev_array, AXES)
+
+
+def single_device_mesh(device: jax.Device | None = None) -> Mesh:
+    dev = device if device is not None else jax.devices()[0]
+    return Mesh(np.array([dev]).reshape(1, 1, 1, 1), AXES)
+
+
+# -- canonical partition specs --------------------------------------------
+
+# batch-dim sharding for activations/data: batch over (dp, fsdp), seq over sp
+BATCH_SPEC = P((DP, FSDP), SP)
+# token ids [B, T]
+TOKENS_SPEC = P((DP, FSDP), SP)
+# logits [B, T, V] — vocab over tp
+LOGITS_SPEC = P((DP, FSDP), SP, TP)
+REPLICATED = P()
+
+
+def sharding(mesh: Mesh, spec: P) -> NamedSharding:
+    return NamedSharding(mesh, spec)
+
+
+def shard_batch(mesh: Mesh, tree, spec: P = BATCH_SPEC):
+    """device_put a pytree of [B, ...] arrays with batch-dim sharding.
+
+    Arrays whose rank is 1 get P((dp, fsdp)); rank ≥2 get ``spec`` truncated
+    to their rank.
+    """
+
+    def put(x):
+        r = np.ndim(x)
+        if r == 0:
+            return jax.device_put(x, NamedSharding(mesh, P()))
+        parts = list(spec)[:r]
+        parts += [None] * (r - len(parts))
+        return jax.device_put(x, NamedSharding(mesh, P(*parts)))
+
+    return jax.tree_util.tree_map(put, tree)

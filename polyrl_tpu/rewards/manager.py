@@ -1,0 +1,88 @@
+"""Reward managers: turn generated token batches into token-level scores.
+
+Equivalent of the reference's reward layer C17 (``load_reward_manager`` over
+naive/prime/batch/dapo managers + custom fn, reference
+``rlboost/verl_stream/trainer/ppo/reward.py:95-190``). The naive manager
+decodes responses, calls the per-dataset scorer, and places the scalar
+outcome reward on the LAST response token (outcome supervision); token-level
+shaping hooks are the manager's job.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+from dataclasses import dataclass
+from typing import Callable
+
+import numpy as np
+
+from polyrl_tpu.data.batch import TensorBatch
+from polyrl_tpu.rewards.scorers import default_compute_score
+
+
+@dataclass
+class RewardResult:
+    token_level_scores: np.ndarray  # [B, T_resp] f32
+    scores: np.ndarray              # [B] sequence-level
+    metrics: dict
+
+
+class NaiveRewardManager:
+    """Decode → score → scatter to last response token."""
+
+    def __init__(
+        self,
+        tokenizer,
+        compute_score: Callable = default_compute_score,
+        num_workers: int = 4,
+    ):
+        self.tokenizer = tokenizer
+        self.compute_score = compute_score
+        self.num_workers = num_workers
+
+    def __call__(self, batch: TensorBatch) -> RewardResult:
+        responses = np.asarray(batch["responses"])          # [B, T]
+        response_mask = np.asarray(batch["response_mask"])  # [B, T]
+        ground_truth = batch["ground_truth"]                # non-tensor [B]
+        data_sources = (
+            batch["data_source"] if "data_source" in batch
+            else np.array(["gsm8k"] * len(responses), dtype=object)
+        )
+
+        lengths = response_mask.sum(axis=-1).astype(np.int64)
+        texts = self.tokenizer.batch_decode(
+            [responses[i, : lengths[i]] for i in range(len(responses))],
+            skip_special_tokens=True,
+        )
+
+        def score_one(i: int) -> float:
+            return float(
+                self.compute_score(str(data_sources[i]), texts[i], str(ground_truth[i]))
+            )
+
+        if self.num_workers > 1 and len(texts) > 1:
+            with concurrent.futures.ThreadPoolExecutor(self.num_workers) as ex:
+                scores = np.fromiter(ex.map(score_one, range(len(texts))), dtype=np.float32)
+        else:
+            scores = np.array([score_one(i) for i in range(len(texts))], dtype=np.float32)
+
+        token_scores = np.zeros_like(response_mask, dtype=np.float32)
+        for i, ln in enumerate(lengths):
+            if ln > 0:
+                token_scores[i, ln - 1] = scores[i]
+        return RewardResult(
+            token_level_scores=token_scores,
+            scores=scores,
+            metrics={"reward/mean": float(scores.mean()) if len(scores) else 0.0,
+                     "reward/max": float(scores.max()) if len(scores) else 0.0,
+                     "reward/min": float(scores.min()) if len(scores) else 0.0},
+        )
+
+
+REWARD_MANAGERS = {"naive": NaiveRewardManager}
+
+
+def load_reward_manager(name: str, tokenizer, compute_score=None, **kw):
+    """Resolve a reward manager by name (reference reward.py:95-150)."""
+    cls = REWARD_MANAGERS[name]
+    return cls(tokenizer, compute_score=compute_score or default_compute_score, **kw)

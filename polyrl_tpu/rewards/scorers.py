@@ -1,0 +1,136 @@
+"""Rule-based per-dataset reward scorers.
+
+TPU-neutral (pure Python/CPU) equivalent of the reference's
+``default_compute_score`` dispatch (reference
+``rlboost/verl_stream/utils/reward_score/__init__.py:19-117``): per
+``data_source`` routing to gsm8k / MATH-style / code scorers. Scores are
+computed on the driver host while the TPUs run the next ibatch — same
+overlap the reference gets from async Ray reward tasks
+(``reward.py:153-190``).
+"""
+
+from __future__ import annotations
+
+import re
+
+
+def extract_gsm8k_answer(text: str, method: str = "strict") -> str | None:
+    """GSM8K: final number after '####' (strict) or last number (flexible)."""
+    if method == "strict":
+        m = re.search(r"####\s*(-?[0-9.,]+)", text)
+        if m is None:
+            return None
+        return m.group(1).replace(",", "").rstrip(".")
+    nums = re.findall(r"-?[0-9][0-9.,]*", text)
+    if not nums:
+        return None
+    return nums[-1].replace(",", "").rstrip(".")
+
+
+def _num_eq(a: str, b: str) -> bool:
+    try:
+        return abs(float(a) - float(b)) < 1e-6
+    except (TypeError, ValueError):
+        return a == b
+
+
+def compute_score_gsm8k(
+    solution_str: str,
+    ground_truth: str,
+    method: str = "flexible",
+    correct_score: float = 1.0,
+    format_score: float = 0.0,
+) -> float:
+    answer = extract_gsm8k_answer(solution_str, method)
+    if answer is None:
+        return 0.0
+    return correct_score if _num_eq(answer, ground_truth) else format_score
+
+
+_BOXED_RE = re.compile(r"\\boxed\{")
+
+
+def extract_boxed_answer(text: str) -> str | None:
+    """Last \\boxed{...} with balanced braces (MATH-style)."""
+    starts = [m.end() for m in _BOXED_RE.finditer(text)]
+    if not starts:
+        return None
+    start = starts[-1]
+    depth = 1
+    for i in range(start, len(text)):
+        c = text[i]
+        if c == "{":
+            depth += 1
+        elif c == "}":
+            depth -= 1
+            if depth == 0:
+                return text[start:i]
+    return None
+
+
+def _normalize_math(ans: str) -> str:
+    ans = ans.strip()
+    ans = ans.replace("\\left", "").replace("\\right", "")
+    ans = ans.replace("\\!", "").replace("\\,", "").replace("\\;", "").replace(" ", "")
+    ans = ans.replace("\\%", "").replace("%", "")
+    ans = ans.replace("\\$", "").replace("$", "")
+    ans = re.sub(r"\\text\{[^}]*\}", "", ans)
+    ans = re.sub(r"\\mbox\{[^}]*\}", "", ans)
+    ans = ans.replace("\\dfrac", "\\frac").replace("\\tfrac", "\\frac")
+    ans = ans.rstrip(".")
+    # \frac{a}{b} → a/b for simple numeric fractions
+    m = re.fullmatch(r"\\frac\{(-?\d+)\}\{(-?\d+)\}", ans)
+    if m:
+        ans = f"{m.group(1)}/{m.group(2)}"
+    if ans.endswith("\\"):
+        ans = ans[:-1]
+    return ans
+
+
+def compute_score_math(solution_str: str, ground_truth: str) -> float:
+    answer = extract_boxed_answer(solution_str)
+    if answer is None:
+        return 0.0
+    a, b = _normalize_math(answer), _normalize_math(ground_truth)
+    if a == b or _num_eq(a, b):
+        return 1.0
+    # numeric fraction equivalence
+    def to_float(s: str) -> float | None:
+        m = re.fullmatch(r"(-?\d+(?:\.\d+)?)/(-?\d+(?:\.\d+)?)", s)
+        if m:
+            try:
+                return float(m.group(1)) / float(m.group(2))
+            except ZeroDivisionError:
+                return None
+        try:
+            return float(s)
+        except ValueError:
+            return None
+    fa, fb = to_float(a), to_float(b)
+    if fa is not None and fb is not None:
+        return 1.0 if abs(fa - fb) < 1e-6 else 0.0
+    return 0.0
+
+
+def default_compute_score(
+    data_source: str,
+    solution_str: str,
+    ground_truth: str,
+    extra_info: dict | None = None,
+) -> float:
+    """Per-dataset dispatch (reference reward_score/__init__.py:19-117)."""
+    ds = (data_source or "").lower()
+    if "gsm8k" in ds:
+        return compute_score_gsm8k(solution_str, ground_truth)
+    if any(k in ds for k in ("math", "aime", "openr1", "deepscaler", "numina", "dapo")):
+        return compute_score_math(solution_str, ground_truth)
+    if any(k in ds for k in ("code", "apps", "taco", "codeforces")):
+        # sandboxed code execution scoring is gated off in this environment
+        # (reference uses sandbox-fusion, reward.py:95-150); fall back to
+        # exact-match of extracted answer.
+        return 1.0 if ground_truth.strip() and ground_truth.strip() in solution_str else 0.0
+    # default: MATH-style then gsm8k-style
+    score = compute_score_math(solution_str, ground_truth)
+    if score == 0.0:
+        score = compute_score_gsm8k(solution_str, ground_truth)
+    return score

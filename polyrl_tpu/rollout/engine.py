@@ -1,0 +1,216 @@
+"""Rollout engine v0: jitted prefill + while-loop decode with KV cache.
+
+TPU-native stand-in for the reference's SGLang serving stack (SURVEY.md §2.2
+row 1 — streaming ``/generate`` with ``output_token_logprobs``, weight
+hot-swap via ``update_weights_from_tensor``, release/resume memory
+occupation — reference ``sglang_http_async_engine.py:155-298``). v0 is a
+synchronous batch engine with static shape buckets; the continuous-batching
+scheduler and paged Pallas attention land on top of this API.
+
+Shape discipline (XLA: trace once, reuse):
+- prompts are LEFT-padded to a prompt-length bucket; batch padded to a batch
+  bucket; decode runs a ``lax.while_loop`` with early exit when every row
+  hit a stop token, writing tokens/logprobs into fixed [B, max_new] buffers.
+- one compiled executable per (batch_bucket, prompt_bucket, max_new,
+  sampling-params) tuple, cached on the engine.
+
+Weight hot-swap: ``update_weights`` replaces the param pytree the compiled
+fns close over — params are an ARGUMENT, so no recompilation (same shapes,
+same shardings); the old buffers are freed by donation.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from polyrl_tpu.models import decoder
+from polyrl_tpu.rollout.sampling import SamplingParams, sample_token
+
+
+def next_bucket(n: int, buckets: tuple[int, ...]) -> int:
+    for b in buckets:
+        if n <= b:
+            return b
+    raise ValueError(f"{n} exceeds largest bucket {buckets[-1]}")
+
+
+@dataclasses.dataclass
+class GenerationOutput:
+    """Per-request result mirroring the fields the reference's manager
+    consumes from SGLang's /generate (handlers.rs:215-251): token ids +
+    per-token logprobs + finish reason + counts."""
+
+    output_ids: np.ndarray          # [n_new] int32, truncated at stop
+    output_token_logprobs: np.ndarray  # [n_new] f32
+    finish_reason: str              # "stop" | "length" | "abort"
+    prompt_tokens: int
+    completion_tokens: int
+
+
+class RolloutEngine:
+    """In-process rollout engine over one jax mesh (single-chip or sharded)."""
+
+    def __init__(
+        self,
+        cfg: decoder.ModelConfig,
+        params: Any,
+        mesh=None,
+        pad_token_id: int = 0,
+        batch_buckets: tuple[int, ...] = (8, 16, 32, 64, 128, 256),
+        prompt_buckets: tuple[int, ...] = (128, 256, 512, 1024, 2048, 4096),
+        kv_cache_dtype=jnp.bfloat16,
+    ):
+        self.cfg = cfg
+        self.params = params
+        self.mesh = mesh
+        self.pad_token_id = pad_token_id
+        self.batch_buckets = batch_buckets
+        self.prompt_buckets = prompt_buckets
+        self.kv_cache_dtype = kv_cache_dtype
+        self._compiled: dict = {}
+        self.weight_version = 0
+        self._released = False
+        # serving stats mirroring the reference's queue-depth telemetry
+        # (patches.py:423-425): running/queued counts + last throughput.
+        self.num_running = 0
+        self.num_queued = 0
+        self.last_gen_throughput = 0.0
+
+    # -- weight lifecycle (reference: /update_weights_from_agent,
+    #    release/resume_memory_occupation) --------------------------------
+
+    def update_weights(self, params: Any, version: int | None = None) -> None:
+        self.params = params
+        self.weight_version = self.weight_version + 1 if version is None else version
+
+    def release_memory(self) -> None:
+        """Yield HBM to a colocated trainer (reference trainer_mode,
+        stream_fsdp_workers.py:485-492). KV caches are per-call here, so
+        v0 only flags the state; params stay (they're shared with the
+        trainer in colocated mode)."""
+        self._released = True
+
+    def resume_memory(self) -> None:
+        self._released = False
+
+    # -- generate ---------------------------------------------------------
+
+    def _build_generate(self, bb: int, pb: int, sp: SamplingParams):
+        cfg = self.cfg
+        max_total = pb + sp.max_new_tokens
+        stop_ids = jnp.asarray(sp.stop_token_ids or (-1,), dtype=jnp.int32)
+
+        def gen_fn(params, ids, mask, rng):
+            # ids/mask: [bb, pb] left-padded
+            positions = jnp.maximum(jnp.cumsum(mask, axis=-1) - 1, 0).astype(jnp.int32)
+            cache = decoder.make_cache(cfg, bb, max_total, dtype=self.kv_cache_dtype)
+            cache_mask = jnp.concatenate(
+                [mask, jnp.zeros((bb, max_total - pb), mask.dtype)], axis=-1
+            )
+            logits, cache = decoder.forward(
+                params, cfg, ids, positions, cache_mask, cache=cache, write_idx=0
+            )
+            last_logits = logits[:, -1, :]  # [bb, V] — prompts end at pb-1
+
+            out_tokens = jnp.full((bb, sp.max_new_tokens), self.pad_token_id, jnp.int32)
+            out_logps = jnp.zeros((bb, sp.max_new_tokens), jnp.float32)
+            prompt_len = jnp.sum(mask, axis=-1).astype(jnp.int32)  # [bb]
+            # batch-bucket padding rows (empty prompts) start done, so the
+            # early-exit fires as soon as every REAL row hit a stop token.
+            done = prompt_len == 0
+
+            def cond(state):
+                step, done, *_ = state
+                return (step < sp.max_new_tokens) & ~jnp.all(done)
+
+            def body(state):
+                step, done, last_logits, cache, cache_mask, out_tokens, out_logps, rng = state
+                rng, sub = jax.random.split(rng)
+                token, logp = sample_token(last_logits, sub, sp)
+                token = jnp.where(done, self.pad_token_id, token)
+                logp = jnp.where(done, 0.0, logp)
+                out_tokens = jax.lax.dynamic_update_slice(out_tokens, token[:, None], (0, step))
+                out_logps = jax.lax.dynamic_update_slice(out_logps, logp[:, None], (0, step))
+                hit_stop = jnp.any(token[:, None] == stop_ids[None, :], axis=-1)
+                new_done = done | hit_stop
+
+                write_idx = pb + step
+                cache_mask = cache_mask.at[:, pb + step].set(
+                    jnp.where(done, 0.0, 1.0).astype(cache_mask.dtype)
+                )
+                pos = (prompt_len + step)[:, None]
+                step_logits, cache = decoder.forward(
+                    params, cfg, token[:, None], pos, cache_mask,
+                    cache=cache, write_idx=write_idx,
+                )
+                return (step + 1, new_done, step_logits[:, 0, :], cache,
+                        cache_mask, out_tokens, out_logps, rng)
+
+            state = (0, done, last_logits, cache, cache_mask, out_tokens, out_logps, rng)
+            state = jax.lax.while_loop(cond, body, state)
+            _, done, _, _, _, out_tokens, out_logps, _ = state
+            return out_tokens, out_logps, done
+
+        return jax.jit(gen_fn, donate_argnums=())
+
+    def generate(
+        self,
+        prompt_ids: list[list[int]] | list[np.ndarray],
+        sampling: SamplingParams,
+        rng: jax.Array | None = None,
+    ) -> list[GenerationOutput]:
+        """Batch-generate; returns one GenerationOutput per prompt."""
+        t0 = time.monotonic()
+        n = len(prompt_ids)
+        self.num_running = n
+        bb = next_bucket(n, self.batch_buckets)
+        max_prompt = max(len(p) for p in prompt_ids)
+        pb = next_bucket(max_prompt, self.prompt_buckets)
+
+        ids = np.full((bb, pb), self.pad_token_id, np.int32)
+        mask = np.zeros((bb, pb), np.float32)
+        for i, p in enumerate(prompt_ids):
+            ids[i, pb - len(p):] = np.asarray(p, np.int32)
+            mask[i, pb - len(p):] = 1.0
+
+        key = (bb, pb, sampling)
+        if key not in self._compiled:
+            self._compiled[key] = self._build_generate(bb, pb, sampling)
+        fn = self._compiled[key]
+        rng = rng if rng is not None else jax.random.PRNGKey(np.random.randint(0, 2**31 - 1))
+        out_tokens, out_logps, done = jax.device_get(fn(self.params, ids, mask, rng))
+
+        results = []
+        stop_set = set(sampling.stop_token_ids)
+        total_new = 0
+        for i in range(n):
+            toks = out_tokens[i]
+            lps = out_logps[i]
+            n_new = sampling.max_new_tokens
+            finish = "length"
+            for j, t in enumerate(toks):
+                if int(t) in stop_set:
+                    n_new = j + 1  # include the stop token (reference keeps eos)
+                    finish = "stop"
+                    break
+            total_new += n_new
+            results.append(
+                GenerationOutput(
+                    output_ids=toks[:n_new].copy(),
+                    output_token_logprobs=lps[:n_new].copy(),
+                    finish_reason=finish,
+                    prompt_tokens=len(prompt_ids[i]),
+                    completion_tokens=n_new,
+                )
+            )
+        dt = time.monotonic() - t0
+        self.last_gen_throughput = total_new / dt if dt > 0 else 0.0
+        self.num_running = 0
+        return results

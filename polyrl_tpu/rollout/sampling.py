@@ -1,0 +1,69 @@
+"""Token sampling: temperature / top-k / top-p, jit-safe, batched.
+
+Equivalent role to SGLang's sampler in the reference rollout path (SURVEY.md
+§2.2 row 1). All functions operate on [B, V] f32 logits and are shape-static
+so they compile once per (batch, vocab) bucket.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = jnp.finfo(jnp.float32).min
+
+
+@dataclasses.dataclass(frozen=True)
+class SamplingParams:
+    """Static sampling configuration (hashable → usable as jit static arg)."""
+
+    temperature: float = 1.0
+    top_p: float = 1.0
+    top_k: int = 0  # 0 = disabled
+    max_new_tokens: int = 128
+    stop_token_ids: tuple[int, ...] = ()
+
+
+def apply_top_k(logits: jnp.ndarray, k: int) -> jnp.ndarray:
+    if k <= 0:
+        return logits
+    kth = jax.lax.top_k(logits, k)[0][..., -1:]
+    return jnp.where(logits < kth, NEG_INF, logits)
+
+
+def apply_top_p(logits: jnp.ndarray, p: float) -> jnp.ndarray:
+    if p >= 1.0:
+        return logits
+    sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+    probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    # keep tokens while cumulative prob (exclusive) < p; always keep top-1
+    cutoff_mask = cum - probs < p
+    kept = jnp.sum(cutoff_mask, axis=-1, keepdims=True)
+    threshold = jnp.take_along_axis(sorted_logits, jnp.maximum(kept - 1, 0), axis=-1)
+    return jnp.where(logits < threshold, NEG_INF, logits)
+
+
+def sample_token(
+    logits: jnp.ndarray,  # [B, V] f32
+    rng: jax.Array,
+    params: SamplingParams,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Returns (token [B] int32, logprob [B] f32 of the sampled token under
+    the post-temperature/filter distribution — the same semantics as the
+    reference engine's ``output_token_logprobs`` used for token-level
+    continuation, SURVEY.md §3.4)."""
+    if params.temperature == 0.0:
+        token = jnp.argmax(logits, axis=-1)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        return token.astype(jnp.int32), jnp.take_along_axis(logp, token[:, None], axis=-1)[:, 0]
+
+    scaled = logits / params.temperature
+    scaled = apply_top_k(scaled, params.top_k)
+    scaled = apply_top_p(scaled, params.top_p)
+    logp = jax.nn.log_softmax(scaled, axis=-1)
+    token = jax.random.categorical(rng, scaled, axis=-1).astype(jnp.int32)
+    token_logp = jnp.take_along_axis(logp, token[:, None], axis=-1)[:, 0]
+    return token, token_logp

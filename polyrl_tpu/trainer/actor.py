@@ -1,0 +1,206 @@
+"""Stream PPO/GRPO actor: per-ibatch fwd/bwd with gradient accumulation and
+optimizer step at minibatch boundaries.
+
+TPU-native equivalent of the reference's C8 ``StreamDataParallelPPOActor``
+(``stream_dp_actor.py:58-231``): the input is already a sub-minibatch;
+gradients accumulate across calls scaled by ``loss_scale_factor``; the
+optimizer steps only when ``is_opt_step`` is set (reference :226-230, the
+cumulative-minibatch-boundary logic lives in the trainer). Instead of
+FSDP+NCCL, params/grads/opt-state shard over the (fsdp, tp) mesh axes and
+GSPMD inserts the collectives.
+
+Also provides ``compute_log_prob`` (the old/ref logprob pass, reference
+stream_ray_trainer.py:425-439) and the ref-policy variant.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from polyrl_tpu.models import decoder
+from polyrl_tpu.ops import core_algos
+
+
+@dataclasses.dataclass(frozen=True)
+class ActorConfig:
+    policy_loss: str = "vanilla"          # vanilla | gpg | clip_cov
+    clip_ratio: float = 0.2
+    clip_ratio_low: float | None = None
+    clip_ratio_high: float | None = None
+    clip_ratio_c: float = 3.0
+    entropy_coeff: float = 0.0
+    use_kl_loss: bool = False             # GRPO-style in-loss KL
+    kl_loss_coef: float = 0.001
+    kl_loss_type: str = "low_var_kl"
+    loss_agg_mode: str = "token-mean"
+    lr: float = 1e-6
+    lr_warmup_steps: int = 0
+    weight_decay: float = 0.01
+    max_grad_norm: float = 1.0
+    ppo_epochs: int = 1                   # reference guards ppo_epochs==1 (stream_dp_actor.py:145)
+    remat: bool = True
+
+
+def make_optimizer(cfg: ActorConfig, total_steps: int = 0) -> optax.GradientTransformation:
+    """AdamW with grad clipping; warmup (+cosine decay when total_steps>0)."""
+    if total_steps > 0:
+        sched = optax.warmup_cosine_decay_schedule(
+            0.0, cfg.lr, max(cfg.lr_warmup_steps, 1), total_steps
+        )
+    elif cfg.lr_warmup_steps > 0:
+        sched = optax.linear_schedule(0.0, cfg.lr, cfg.lr_warmup_steps)
+    else:
+        sched = cfg.lr
+    return optax.chain(
+        optax.clip_by_global_norm(cfg.max_grad_norm),
+        optax.adamw(sched, b1=0.9, b2=0.999, eps=1e-8, weight_decay=cfg.weight_decay),
+    )
+
+
+def _model_logprobs_entropy(params, model_cfg, input_ids, positions, attn_mask,
+                            responses, response_mask, remat, compute_entropy):
+    """Forward over [B, T_total]; logprobs of response tokens [B, T_resp]."""
+    logits, _ = decoder.forward(params, model_cfg, input_ids, positions, attn_mask, remat=remat)
+    t_resp = responses.shape[1]
+    # logits at position i predict token i+1: responses occupy the last
+    # t_resp positions of input_ids, so their predictors are shifted one left.
+    pred_logits = logits[:, -t_resp - 1 : -1, :]
+    logprobs = core_algos.logprobs_from_logits(pred_logits, responses)
+    entropy = core_algos.entropy_from_logits(pred_logits) if compute_entropy else None
+    return logprobs, entropy
+
+
+class StreamActor:
+    """Owns params + optimizer + accumulated grads; stream-update semantics."""
+
+    def __init__(
+        self,
+        model_cfg: decoder.ModelConfig,
+        cfg: ActorConfig,
+        params: Any,
+        mesh=None,
+    ):
+        self.model_cfg = model_cfg
+        self.cfg = cfg
+        self.mesh = mesh
+        self.params = params
+        self.optimizer = make_optimizer(cfg)
+        self.opt_state = self.optimizer.init(params)
+        self.accum_grads = jax.tree_util.tree_map(jnp.zeros_like, params)
+        self._update_fns: dict = {}
+        self._logprob_fns: dict = {}
+
+    # -- jitted kernels ---------------------------------------------------
+
+    def _loss_fn(self, params, batch, loss_scale: float):
+        cfg = self.cfg
+        logprobs, entropy = _model_logprobs_entropy(
+            params, self.model_cfg,
+            batch["input_ids"], batch["positions"], batch["attention_mask"],
+            batch["responses"], batch["response_mask"],
+            cfg.remat, cfg.entropy_coeff != 0.0,
+        )
+        loss_fn = core_algos.get_policy_loss_fn(cfg.policy_loss)
+        pg_loss, clipfrac, approx_kl, clipfrac_lower = loss_fn(
+            batch["old_log_probs"], logprobs, batch["advantages"],
+            batch["response_mask"],
+            clip_ratio=cfg.clip_ratio, clip_ratio_low=cfg.clip_ratio_low,
+            clip_ratio_high=cfg.clip_ratio_high, clip_ratio_c=cfg.clip_ratio_c,
+            loss_agg_mode=cfg.loss_agg_mode,
+        ) if cfg.policy_loss != "gpg" else loss_fn(
+            batch["old_log_probs"], logprobs, batch["advantages"],
+            batch["response_mask"], loss_agg_mode=cfg.loss_agg_mode,
+        )
+        loss = pg_loss
+        metrics = {
+            "actor/pg_loss": pg_loss,
+            "actor/clipfrac": clipfrac,
+            "actor/approx_kl": approx_kl,
+            "actor/clipfrac_lower": clipfrac_lower,
+        }
+        if cfg.entropy_coeff != 0.0:
+            ent = core_algos.agg_loss(entropy, batch["response_mask"], cfg.loss_agg_mode)
+            loss = loss - cfg.entropy_coeff * ent
+            metrics["actor/entropy"] = ent
+        if cfg.use_kl_loss:
+            kld = core_algos.kl_penalty(logprobs, batch["ref_log_probs"], cfg.kl_loss_type)
+            kl_loss = core_algos.agg_loss(kld, batch["response_mask"], cfg.loss_agg_mode)
+            loss = loss + cfg.kl_loss_coef * kl_loss
+            metrics["actor/kl_loss"] = kl_loss
+        return loss * loss_scale, metrics
+
+    def _build_update(self, is_opt_step: bool):
+        optimizer = self.optimizer
+
+        def update(params, opt_state, accum_grads, batch, loss_scale):
+            (loss, metrics), grads = jax.value_and_grad(self._loss_fn, has_aux=True)(
+                params, batch, loss_scale
+            )
+            accum_grads = jax.tree_util.tree_map(jnp.add, accum_grads, grads)
+            if is_opt_step:
+                updates, opt_state = optimizer.update(accum_grads, opt_state, params)
+                params = optax.apply_updates(params, updates)
+                metrics = dict(metrics)
+                metrics["actor/grad_norm"] = optax.global_norm(accum_grads)
+                accum_grads = jax.tree_util.tree_map(jnp.zeros_like, accum_grads)
+            return params, opt_state, accum_grads, loss, metrics
+
+        return jax.jit(update, donate_argnums=(0, 1, 2))
+
+    def update_stream(self, batch: dict, is_opt_step: bool, loss_scale: float = 1.0) -> dict:
+        """One sub-minibatch fwd/bwd (+opt step at boundary). ``batch`` is a
+        dict of arrays: input_ids, positions, attention_mask, responses,
+        response_mask, advantages, old_log_probs [, ref_log_probs]."""
+        if is_opt_step not in self._update_fns:
+            self._update_fns[is_opt_step] = self._build_update(is_opt_step)
+        fn = self._update_fns[is_opt_step]
+        self.params, self.opt_state, self.accum_grads, loss, metrics = fn(
+            self.params, self.opt_state, self.accum_grads, batch,
+            jnp.asarray(loss_scale, jnp.float32),
+        )
+        return metrics
+
+    def compute_log_prob(self, batch: dict, compute_entropy: bool = True):
+        """Old-logprob pass (no grad). Returns (logprobs, entropy|None)."""
+        if compute_entropy not in self._logprob_fns:
+            self._logprob_fns[compute_entropy] = jax.jit(
+                partial(_model_logprobs_entropy, remat=False,
+                        compute_entropy=compute_entropy),
+                static_argnums=(1,),
+            )
+        return self._logprob_fns[compute_entropy](
+            self.params, self.model_cfg,
+            batch["input_ids"], batch["positions"], batch["attention_mask"],
+            batch["responses"], batch["response_mask"],
+        )
+
+
+class ReferencePolicy:
+    """Frozen reference policy for KL (reference ref worker role).
+
+    Owns a COPY of the params: the actor's update step donates its param
+    buffers to XLA, so sharing the initial pytree would leave this policy
+    holding deleted buffers after the first optimizer step.
+    """
+
+    def __init__(self, model_cfg: decoder.ModelConfig, params: Any):
+        self.model_cfg = model_cfg
+        self.params = jax.tree_util.tree_map(jnp.copy, params)
+        self._fn = jax.jit(
+            partial(_model_logprobs_entropy, remat=False, compute_entropy=False),
+            static_argnums=(1,),
+        )
+
+    def compute_log_prob(self, batch: dict):
+        lp, _ = self._fn(
+            self.params, self.model_cfg,
+            batch["input_ids"], batch["positions"], batch["attention_mask"],
+            batch["responses"], batch["response_mask"],
+        )
+        return lp

@@ -1,0 +1,128 @@
+"""Stream PPO critic: token-value model with stream update semantics.
+
+Equivalent of the reference's C9 ``StreamDataParallelPPOCritic``
+(``stream_dp_critic.py:49-141``): value loss with clipping
+(``compute_value_loss``), gradient accumulation scaled by loss_scale, opt
+step on ``is_opt_step``. The value model is the decoder trunk with a scalar
+head instead of the LM head.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from polyrl_tpu.models import decoder
+from polyrl_tpu.ops import core_algos
+
+
+@dataclasses.dataclass(frozen=True)
+class CriticConfig:
+    cliprange_value: float = 0.5
+    loss_agg_mode: str = "token-mean"
+    lr: float = 1e-5
+    weight_decay: float = 0.01
+    max_grad_norm: float = 1.0
+    remat: bool = True
+
+
+def init_critic_params(rng: jax.Array, model_cfg: decoder.ModelConfig) -> dict:
+    params = decoder.init_params(rng, model_cfg)
+    params.pop("lm_head", None)
+    params["value_head"] = (
+        jax.random.normal(jax.random.fold_in(rng, 7), (model_cfg.hidden_size, 1), jnp.float32)
+        * 0.01
+    ).astype(model_cfg.dtype)
+    return params
+
+
+def critic_param_specs(model_cfg: decoder.ModelConfig) -> dict:
+    from jax.sharding import PartitionSpec as P
+
+    specs = decoder.param_specs(model_cfg)
+    specs.pop("lm_head", None)
+    specs["value_head"] = P(None, None)
+    return specs
+
+
+def forward_values(params, model_cfg, input_ids, positions, attn_mask, responses, remat):
+    """Token values for the response region [B, T_resp] (f32)."""
+    # trunk forward: reuse decoder but skip the LM head by computing
+    # hidden states via a value-head projection on the normed trunk output.
+    value_params = dict(params)
+    head = value_params.pop("value_head")
+    # decoder.forward computes logits = x @ head; give it the value head as a
+    # [D, 1] lm_head so XLA never materialises the [B, T, V] logits.
+    value_params["lm_head"] = head
+    cfg = dataclasses.replace(model_cfg, tie_word_embeddings=False)
+    values, _ = decoder.forward(value_params, cfg, input_ids, positions, attn_mask, remat=remat)
+    t_resp = responses.shape[1]
+    return values[:, -t_resp - 1 : -1, 0].astype(jnp.float32)
+
+
+class StreamCritic:
+    def __init__(self, model_cfg: decoder.ModelConfig, cfg: CriticConfig, params: Any, mesh=None):
+        self.model_cfg = model_cfg
+        self.cfg = cfg
+        self.params = params
+        self.optimizer = optax.chain(
+            optax.clip_by_global_norm(cfg.max_grad_norm),
+            optax.adamw(cfg.lr, weight_decay=cfg.weight_decay),
+        )
+        self.opt_state = self.optimizer.init(params)
+        self.accum_grads = jax.tree_util.tree_map(jnp.zeros_like, params)
+        self._update_fns: dict = {}
+        self._value_fn = None
+
+    def _loss(self, params, batch, loss_scale):
+        vpreds = forward_values(
+            params, self.model_cfg, batch["input_ids"], batch["positions"],
+            batch["attention_mask"], batch["responses"], self.cfg.remat,
+        )
+        vf_loss, clipfrac = core_algos.compute_value_loss(
+            vpreds, batch["returns"], batch["values"], batch["response_mask"],
+            cliprange_value=self.cfg.cliprange_value,
+            loss_agg_mode=self.cfg.loss_agg_mode,
+        )
+        return vf_loss * loss_scale, {"critic/vf_loss": vf_loss, "critic/vf_clipfrac": clipfrac}
+
+    def _build_update(self, is_opt_step: bool):
+        optimizer = self.optimizer
+
+        def update(params, opt_state, accum, batch, loss_scale):
+            (loss, metrics), grads = jax.value_and_grad(self._loss, has_aux=True)(
+                params, batch, loss_scale
+            )
+            accum = jax.tree_util.tree_map(jnp.add, accum, grads)
+            if is_opt_step:
+                updates, opt_state = optimizer.update(accum, opt_state, params)
+                params = optax.apply_updates(params, updates)
+                metrics = dict(metrics)
+                metrics["critic/grad_norm"] = optax.global_norm(accum)
+                accum = jax.tree_util.tree_map(jnp.zeros_like, accum)
+            return params, opt_state, accum, loss, metrics
+
+        return jax.jit(update, donate_argnums=(0, 1, 2))
+
+    def update_stream(self, batch: dict, is_opt_step: bool, loss_scale: float = 1.0) -> dict:
+        if is_opt_step not in self._update_fns:
+            self._update_fns[is_opt_step] = self._build_update(is_opt_step)
+        self.params, self.opt_state, self.accum_grads, _, metrics = self._update_fns[is_opt_step](
+            self.params, self.opt_state, self.accum_grads, batch,
+            jnp.asarray(loss_scale, jnp.float32),
+        )
+        return metrics
+
+    def compute_values(self, batch: dict) -> jnp.ndarray:
+        if self._value_fn is None:
+            self._value_fn = jax.jit(
+                lambda p, b: forward_values(
+                    p, self.model_cfg, b["input_ids"], b["positions"],
+                    b["attention_mask"], b["responses"], False,
+                )
+            )
+        return self._value_fn(self.params, batch)

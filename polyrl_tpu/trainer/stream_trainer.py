@@ -1,0 +1,294 @@
+"""StreamRLTrainer — the streaming PPO/GRPO fit loop.
+
+TPU-native equivalent of the reference's C2 ``StreamRayPPOTrainer.fit``
+(``stream_ray_trainer.py:282-707``): per training batch, rollout responses
+arrive as micro-batches ("ibatches") of at least ``min_stream_batch_size``;
+each ibatch flows reward → old_logprob → ref_logprob → values → advantage,
+then actor/critic fwd/bwd with gradient accumulation; the optimizer steps at
+cumulative minibatch boundaries (reference :500-568); weights push to the
+rollout engine after each step (:571-575); metrics feed the balancer
+(:691-704).
+
+v0 runs colocated & synchronous (the reference's ``main_ppo`` baseline
+semantics, SURVEY.md §3.5) against the in-process RolloutEngine; the
+disaggregated path swaps in the manager client without changing this loop's
+accounting.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Callable
+
+import jax
+import numpy as np
+
+from polyrl_tpu.data.batch import TensorBatch
+from polyrl_tpu.models import decoder
+from polyrl_tpu.ops import core_algos
+from polyrl_tpu.rollout.engine import RolloutEngine
+from polyrl_tpu.rollout.sampling import SamplingParams
+from polyrl_tpu.trainer.actor import ActorConfig, ReferencePolicy, StreamActor
+from polyrl_tpu.trainer.critic import CriticConfig, StreamCritic
+from polyrl_tpu.utils.metrics import MetricsTracker, marked_timer
+
+
+@dataclasses.dataclass
+class TrainerConfig:
+    # batch accounting (reference names kept: SURVEY.md C1 batch checks)
+    train_batch_size: int = 32            # prompts per step
+    rollout_n: int = 4                    # samples per prompt
+    ppo_mini_batch_size: int = 64         # trajectories per optimizer step
+    micro_batch_size: int = 8             # trajectories per fwd/bwd
+    min_stream_batch_size: int = 16       # ibatch granularity
+    # lengths
+    max_prompt_length: int = 128
+    max_response_length: int = 128
+    # algorithm
+    adv_estimator: str = "grpo"           # grpo | gae | rloo | reinforce_plus_plus | remax
+    gamma: float = 1.0
+    lam: float = 1.0
+    use_kl_in_reward: bool = False
+    kl_coef: float = 0.001
+    kl_penalty: str = "kl"
+    norm_adv_by_std_in_grpo: bool = True
+    # run
+    total_steps: int = 10
+    seed: int = 0
+    # sampling
+    temperature: float = 1.0
+    top_p: float = 1.0
+    top_k: int = 0
+
+    def __post_init__(self):
+        total = self.train_batch_size * self.rollout_n
+        if total % self.ppo_mini_batch_size != 0:
+            raise ValueError(
+                f"total trajectories {total} not divisible by ppo_mini_batch_size"
+                f" {self.ppo_mini_batch_size} (reference check main_stream.py:372-389)"
+            )
+        if self.ppo_mini_batch_size % self.micro_batch_size != 0:
+            raise ValueError("mini batch not divisible by micro batch")
+        if self.min_stream_batch_size % self.micro_batch_size != 0:
+            raise ValueError("stream batch not divisible by micro batch")
+        if self.adv_estimator in ("grpo", "rloo") and (
+            self.min_stream_batch_size % self.rollout_n != 0
+        ):
+            raise ValueError(
+                "min_stream_batch_size must be a multiple of rollout_n so prompt"
+                " groups are never split across ibatches (group-relative"
+                " advantages would silently use partial groups)"
+            )
+
+
+class StreamRLTrainer:
+    def __init__(
+        self,
+        cfg: TrainerConfig,
+        actor: StreamActor,
+        rollout: RolloutEngine,
+        tokenizer,
+        reward_manager,
+        dataloader,
+        critic: StreamCritic | None = None,
+        ref_policy: ReferencePolicy | None = None,
+        logger=None,
+    ):
+        self.cfg = cfg
+        self.actor = actor
+        self.rollout = rollout
+        self.tokenizer = tokenizer
+        self.reward_manager = reward_manager
+        self.dataloader = dataloader
+        self.critic = critic
+        self.ref_policy = ref_policy
+        self.logger = logger
+        self.global_step = 0
+        if cfg.adv_estimator == "gae" and critic is None:
+            raise ValueError("GAE requires a critic")
+
+    # -- rollout → TensorBatch -------------------------------------------
+
+    def _generate_batch(self, records: list[dict], rng) -> TensorBatch:
+        """Unroll n samples per prompt, generate, reassemble fixed-shape
+        arrays (the reference's preprocess/postprocess,
+        sglang_rollout_remote.py:227-391)."""
+        cfg = self.cfg
+        prompts, gts, sources = [], [], []
+        for rec in records:
+            ids = self.tokenizer.encode(rec["prompt"])[: cfg.max_prompt_length]
+            for _ in range(cfg.rollout_n):
+                prompts.append(ids)
+                gts.append(rec.get("ground_truth", ""))
+                sources.append(rec.get("data_source", ""))
+
+        sampling = SamplingParams(
+            temperature=cfg.temperature, top_p=cfg.top_p, top_k=cfg.top_k,
+            max_new_tokens=cfg.max_response_length,
+            stop_token_ids=(self.tokenizer.eos_token_id,),
+        )
+        outs = self.rollout.generate(prompts, sampling, rng=rng)
+
+        n = len(prompts)
+        tp, tr = cfg.max_prompt_length, cfg.max_response_length
+        pad = self.rollout.pad_token_id
+        input_ids = np.full((n, tp + tr), pad, np.int32)
+        attention_mask = np.zeros((n, tp + tr), np.float32)
+        responses = np.full((n, tr), pad, np.int32)
+        response_mask = np.zeros((n, tr), np.float32)
+        rollout_log_probs = np.zeros((n, tr), np.float32)
+        for i, (p, o) in enumerate(zip(prompts, outs)):
+            lp = len(p)
+            input_ids[i, tp - lp : tp] = p
+            attention_mask[i, tp - lp : tp] = 1.0
+            r = o.output_ids[:tr]
+            input_ids[i, tp : tp + len(r)] = r
+            attention_mask[i, tp : tp + len(r)] = 1.0
+            responses[i, : len(r)] = r
+            response_mask[i, : len(r)] = 1.0
+            rollout_log_probs[i, : len(r)] = o.output_token_logprobs[: len(r)]
+        positions = np.maximum(attention_mask.cumsum(axis=-1) - 1, 0).astype(np.int32)
+        group_ids = np.repeat(np.arange(len(records), dtype=np.int32), cfg.rollout_n)
+
+        return TensorBatch.from_dict(
+            tensors={
+                "input_ids": input_ids,
+                "attention_mask": attention_mask,
+                "positions": positions,
+                "responses": responses,
+                "response_mask": response_mask,
+                "rollout_log_probs": rollout_log_probs,
+                "group_ids": group_ids,
+            },
+            non_tensors={"ground_truth": gts, "data_source": sources},
+            meta_info={"global_step": self.global_step},
+        )
+
+    # -- per-ibatch pipeline ---------------------------------------------
+
+    def _process_ibatch(self, ibatch: TensorBatch, metrics: MetricsTracker) -> TensorBatch:
+        """reward → old_logprob → ref → values → advantage (reference
+        stream_ray_trainer.py:406-498)."""
+        cfg = self.cfg
+        with marked_timer("reward", metrics):
+            reward_out = self.reward_manager(ibatch)
+            metrics.update(reward_out.metrics)
+        feed = {k: ibatch[k] for k in
+                ("input_ids", "positions", "attention_mask", "responses", "response_mask")}
+        with marked_timer("old_log_prob", metrics):
+            old_lp, entropy = self.actor.compute_log_prob(feed)
+            ibatch.tensors["old_log_probs"] = np.asarray(old_lp)
+            metrics.update({"actor/entropy_rollout": float(
+                core_algos.masked_mean(entropy, ibatch["response_mask"]))})
+        if self.ref_policy is not None:
+            with marked_timer("ref_log_prob", metrics):
+                ibatch.tensors["ref_log_probs"] = np.asarray(
+                    self.ref_policy.compute_log_prob(feed))
+        if self.critic is not None:
+            with marked_timer("values", metrics):
+                ibatch.tensors["values"] = np.asarray(self.critic.compute_values(feed))
+
+        with marked_timer("adv", metrics):
+            token_scores = reward_out.token_level_scores
+            if cfg.use_kl_in_reward and "ref_log_probs" in ibatch:
+                token_rewards, kl_mean = core_algos.apply_kl_penalty(
+                    token_scores, ibatch["old_log_probs"], ibatch["ref_log_probs"],
+                    ibatch["response_mask"], cfg.kl_coef, cfg.kl_penalty)
+                token_rewards = np.asarray(token_rewards)
+                metrics.update({"critic/kl_in_reward": float(kl_mean)})
+            else:
+                token_rewards = token_scores
+            ibatch.tensors["token_level_rewards"] = token_rewards
+
+            est = cfg.adv_estimator
+            if est == "grpo":
+                adv, ret = core_algos.compute_grpo_outcome_advantage(
+                    token_rewards, ibatch["response_mask"], ibatch["group_ids"],
+                    norm_adv_by_std=cfg.norm_adv_by_std_in_grpo,
+                    num_groups=int(np.max(np.asarray(ibatch["group_ids"]))) + 1)
+            elif est == "rloo":
+                adv, ret = core_algos.compute_rloo_outcome_advantage(
+                    token_rewards, ibatch["response_mask"], ibatch["group_ids"],
+                    num_groups=int(np.max(np.asarray(ibatch["group_ids"]))) + 1)
+            elif est == "reinforce_plus_plus":
+                adv, ret = core_algos.compute_reinforce_plus_plus_outcome_advantage(
+                    token_rewards, ibatch["response_mask"], cfg.gamma)
+            elif est == "gae":
+                adv, ret = core_algos.compute_gae_advantage_return(
+                    token_rewards, ibatch["values"], ibatch["response_mask"],
+                    cfg.gamma, cfg.lam)
+            else:
+                raise NotImplementedError(est)
+            ibatch.tensors["advantages"] = np.asarray(adv)
+            ibatch.tensors["returns"] = np.asarray(ret)
+        return ibatch
+
+    # -- fit --------------------------------------------------------------
+
+    def fit(self) -> list[dict]:
+        """Run ``total_steps`` PPO steps; returns per-step metric dicts."""
+        cfg = self.cfg
+        history = []
+        rng = jax.random.PRNGKey(cfg.seed)
+        # bootstrap weights into the rollout engine (reference fit :340)
+        self.rollout.update_weights(self.actor.params)
+
+        for step in range(cfg.total_steps):
+            metrics = MetricsTracker()
+            step_t0 = time.monotonic()
+            records = next(self.dataloader)
+            rng, gen_rng = jax.random.split(rng)
+
+            with marked_timer("gen", metrics):
+                batch = self._generate_batch(records, gen_rng)
+
+            # stream accounting: ibatches of min_stream_batch_size; opt step
+            # when the cumulative count crosses each minibatch boundary
+            # (reference cum-minibatch logic, stream_ray_trainer.py:500-568).
+            n_total = len(batch)
+            isize = cfg.min_stream_batch_size
+            msize = cfg.ppo_mini_batch_size
+            grad_steps_per_mini = msize // cfg.micro_batch_size
+            processed = 0
+            n_tokens = 0
+            for ibatch in batch.split(isize):
+                ibatch = self._process_ibatch(ibatch, metrics)
+                n_tokens += int(np.asarray(ibatch["attention_mask"]).sum())
+                for micro in ibatch.split(cfg.micro_batch_size):
+                    processed += len(micro)
+                    is_opt = processed % msize == 0
+                    feed = {k: micro[k] for k in (
+                        "input_ids", "positions", "attention_mask", "responses",
+                        "response_mask", "advantages", "old_log_probs")}
+                    if "ref_log_probs" in micro:
+                        feed["ref_log_probs"] = micro["ref_log_probs"]
+                    with marked_timer("update_actor", metrics):
+                        m = self.actor.update_stream(
+                            feed, is_opt, loss_scale=1.0 / grad_steps_per_mini)
+                        metrics.update({k: float(v) for k, v in m.items()})
+                    if self.critic is not None:
+                        cfeed = {k: micro[k] for k in (
+                            "input_ids", "positions", "attention_mask", "responses",
+                            "response_mask", "returns", "values")}
+                        with marked_timer("update_critic", metrics):
+                            cm = self.critic.update_stream(
+                                cfeed, is_opt, loss_scale=1.0 / grad_steps_per_mini)
+                            metrics.update({k: float(v) for k, v in cm.items()})
+
+            with marked_timer("update_weight", metrics):
+                self.rollout.update_weights(self.actor.params)
+
+            self.global_step += 1
+            step_time = time.monotonic() - step_t0
+            metrics.update({
+                "training/global_step": self.global_step,
+                "perf/step_time_s": step_time,
+                "perf/throughput_tokens_per_s": n_tokens / step_time if step_time else 0.0,
+                "perf/rollout_throughput_tok_s": self.rollout.last_gen_throughput,
+            })
+            record = metrics.as_dict()
+            history.append(record)
+            if self.logger is not None:
+                self.logger.log(record, step=self.global_step)
+        return history

@@ -1,0 +1,82 @@
+"""Metrics tracking + phase timers + logging backends.
+
+Equivalent of the reference's observability plumbing (SURVEY.md §5.5):
+verl's ``marked_timer`` spans per phase (gen/reward/old_log_prob/adv/
+update_actor/update_weight — reference ``stream_ray_trainer.py:356-623``)
+and the ``Tracking`` logger multiplexing console/tensorboard/wandb
+(``:291-298``).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import time
+from collections import defaultdict
+from typing import Any
+
+
+class MetricsTracker:
+    """Accumulates metrics within a step; repeated keys average (losses) and
+    timing keys sum (phase can run many times per step)."""
+
+    def __init__(self):
+        self._sums = defaultdict(float)
+        self._counts = defaultdict(int)
+        self._timings = defaultdict(float)
+
+    def update(self, metrics: dict[str, Any]) -> None:
+        for k, v in metrics.items():
+            self._sums[k] += float(v)
+            self._counts[k] += 1
+
+    def add_timing(self, name: str, seconds: float) -> None:
+        self._timings[name] += seconds
+
+    def as_dict(self) -> dict[str, float]:
+        out = {k: self._sums[k] / self._counts[k] for k in self._sums}
+        out.update({f"timing_s/{k}": v for k, v in self._timings.items()})
+        return out
+
+
+@contextlib.contextmanager
+def marked_timer(name: str, tracker: MetricsTracker):
+    t0 = time.monotonic()
+    try:
+        yield
+    finally:
+        tracker.add_timing(name, time.monotonic() - t0)
+
+
+class Tracking:
+    """Console/JSONL/TensorBoard multiplexing logger (reference Tracking)."""
+
+    def __init__(self, backends: tuple[str, ...] = ("console",), path: str | None = None):
+        self.backends = backends
+        self._file = open(path, "a") if path and "jsonl" in backends else None
+        self._tb = None
+        if "tensorboard" in backends:
+            try:
+                from torch.utils.tensorboard import SummaryWriter
+
+                self._tb = SummaryWriter(path or "runs")
+            except Exception:
+                self._tb = None
+
+    def log(self, metrics: dict, step: int) -> None:
+        if "console" in self.backends:
+            keys = ["perf/step_time_s", "reward/mean", "actor/pg_loss"]
+            brief = {k: round(metrics[k], 4) for k in keys if k in metrics}
+            print(f"[step {step}] {brief}", flush=True)
+        if self._file is not None:
+            self._file.write(json.dumps({"step": step, **metrics}) + "\n")
+            self._file.flush()
+        if self._tb is not None:
+            for k, v in metrics.items():
+                self._tb.add_scalar(k, v, step)
+
+    def close(self) -> None:
+        if self._file:
+            self._file.close()
+        if self._tb:
+            self._tb.close()

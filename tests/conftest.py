@@ -1,0 +1,38 @@
+"""Test harness: force an 8-device virtual CPU platform before JAX import.
+
+Mirrors the reference's testing seam analysis (SURVEY.md §4): pjit sharding
+and collectives are exercised host-side on a virtual device mesh
+(``--xla_force_host_platform_device_count``) so no TPU slice is needed.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+)
+
+import jax  # noqa: E402
+
+jax.config.update("jax_threefry_partitionable", True)
+# Numerical tests assume exact f32 matmuls (TPU bf16-MXU defaults would add
+# ~1e-3 noise); production code paths keep the fast default.
+jax.config.update("jax_default_matmul_precision", "highest")
+# Single-core machine: persist compiled executables across test runs.
+jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices8():
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 virtual devices, got {len(devs)}"
+    return devs
+
+
+@pytest.fixture(scope="session")
+def rng():
+    return np.random.default_rng(0)

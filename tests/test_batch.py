@@ -1,0 +1,88 @@
+"""TensorBatch container semantics (the DataProto-equivalent verbs)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from polyrl_tpu.data.batch import TensorBatch
+
+
+def make_batch(n=4):
+    return TensorBatch.from_dict(
+        tensors={"ids": jnp.arange(n * 3).reshape(n, 3), "mask": jnp.ones((n, 3))},
+        non_tensors={"prompt": [f"p{i}" for i in range(n)]},
+        meta_info={"step": 7},
+    )
+
+
+def test_len_and_contains():
+    b = make_batch()
+    assert len(b) == 4
+    assert "ids" in b and "prompt" in b and "nope" not in b
+
+
+def test_select_and_pop():
+    b = make_batch()
+    s = b.select(tensor_keys=["ids"], non_tensor_keys=[])
+    assert list(s.tensors) == ["ids"] and not s.non_tensors
+    p = b.pop(tensor_keys=["mask"])
+    assert "mask" not in b and "mask" in p
+
+
+def test_union_merges_and_checks_size():
+    a = make_batch()
+    c = TensorBatch.from_dict(tensors={"adv": jnp.zeros((4, 3))})
+    u = a.union(c)
+    assert "adv" in u and "ids" in u
+    bad = TensorBatch.from_dict(tensors={"x": jnp.zeros((5, 1))})
+    with pytest.raises(ValueError):
+        a.union(bad)
+
+
+def test_concat_split_chunk_roundtrip():
+    b = make_batch(4)
+    parts = b.chunk(2)
+    assert [len(p) for p in parts] == [2, 2]
+    rt = TensorBatch.concat(parts)
+    np.testing.assert_array_equal(np.asarray(rt["ids"]), np.asarray(b["ids"]))
+    assert list(rt["prompt"]) == list(b["prompt"])
+
+
+def test_repeat_interleave():
+    b = make_batch(2)
+    r = b.repeat(3, interleave=True)
+    assert len(r) == 6
+    assert list(r["prompt"]) == ["p0", "p0", "p0", "p1", "p1", "p1"]
+    r2 = b.repeat(2, interleave=False)
+    assert list(r2["prompt"]) == ["p0", "p1", "p0", "p1"]
+
+
+def test_index_and_slice():
+    b = make_batch(4)
+    s = b[1:3]
+    assert len(s) == 2
+    assert list(s["prompt"]) == ["p1", "p2"]
+    i = b.index(np.array([3, 0]))
+    assert list(i["prompt"]) == ["p3", "p0"]
+
+
+def test_meta_info_carried():
+    b = make_batch()
+    assert b.chunk(2)[0].meta_info["step"] == 7
+    assert b.repeat(2).meta_info["step"] == 7
+
+
+def test_batch_dim_mismatch_raises():
+    with pytest.raises(ValueError):
+        TensorBatch.from_dict(tensors={"a": jnp.zeros((2, 1)), "b": jnp.zeros((3, 1))})
+
+
+def test_pytree_registration():
+    import jax
+
+    b = make_batch()
+    leaves = jax.tree_util.tree_leaves(b)
+    assert len(leaves) == 2  # ids, mask
+    mapped = jax.tree_util.tree_map(lambda x: x * 0, b)
+    assert float(jnp.sum(mapped["ids"])) == 0.0
+    assert list(mapped["prompt"]) == list(b["prompt"])

@@ -1,0 +1,140 @@
+"""Decoder model tests: shapes, causality, KV-cache == full-forward parity,
+and sharded forward on the 8-device CPU mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from polyrl_tpu.models import decoder
+from polyrl_tpu.parallel import mesh as meshlib
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = decoder.get_config("tiny", dtype=jnp.float32)
+    params = decoder.init_params(jax.random.PRNGKey(0), cfg)
+    return cfg, params
+
+
+def test_forward_shapes(tiny):
+    cfg, params = tiny
+    b, t = 2, 8
+    ids = jnp.ones((b, t), dtype=jnp.int32)
+    pos = jnp.broadcast_to(jnp.arange(t), (b, t))
+    mask = jnp.ones((b, t))
+    logits, _ = decoder.forward(params, cfg, ids, pos, mask)
+    assert logits.shape == (b, t, cfg.vocab_size)
+    assert logits.dtype == jnp.float32
+
+
+def test_causality(tiny):
+    """Changing a future token must not affect past logits."""
+    cfg, params = tiny
+    b, t = 1, 8
+    rng = np.random.default_rng(0)
+    ids = jnp.asarray(rng.integers(0, cfg.vocab_size, (b, t)), dtype=jnp.int32)
+    pos = jnp.broadcast_to(jnp.arange(t), (b, t))
+    mask = jnp.ones((b, t))
+    logits1, _ = decoder.forward(params, cfg, ids, pos, mask)
+    ids2 = ids.at[0, -1].set((ids[0, -1] + 1) % cfg.vocab_size)
+    logits2, _ = decoder.forward(params, cfg, ids2, pos, mask)
+    np.testing.assert_allclose(
+        np.asarray(logits1[:, :-1]), np.asarray(logits2[:, :-1]), atol=1e-5
+    )
+    assert not np.allclose(np.asarray(logits1[:, -1]), np.asarray(logits2[:, -1]))
+
+
+def test_kv_cache_decode_matches_full_forward(tiny):
+    """Prefill+decode through the cache must equal the full causal forward —
+    the correctness bedrock for rollout logprobs (SURVEY.md §7 hard part 1)."""
+    cfg, params = tiny
+    b, t_prompt, t_total, s = 2, 4, 8, 16
+    rng = np.random.default_rng(1)
+    ids = jnp.asarray(rng.integers(0, cfg.vocab_size, (b, t_total)), dtype=jnp.int32)
+    pos = jnp.broadcast_to(jnp.arange(t_total), (b, t_total))
+    full_mask = jnp.ones((b, t_total))
+    ref_logits, _ = decoder.forward(params, cfg, ids, pos, full_mask)
+
+    cache = decoder.make_cache(cfg, b, s, dtype=jnp.float32)
+    cache_mask = jnp.zeros((b, s)).at[:, :t_prompt].set(1.0)
+    pre_logits, cache = decoder.forward(
+        params, cfg, ids[:, :t_prompt], pos[:, :t_prompt], cache_mask,
+        cache=cache, write_idx=0,
+    )
+    np.testing.assert_allclose(
+        np.asarray(pre_logits), np.asarray(ref_logits[:, :t_prompt]), atol=1e-4
+    )
+
+    got = [pre_logits[:, -1]]
+    for i in range(t_prompt, t_total):
+        cache_mask = cache_mask.at[:, i].set(1.0)
+        step_logits, cache = decoder.forward(
+            params, cfg, ids[:, i : i + 1], pos[:, i : i + 1], cache_mask,
+            cache=cache, write_idx=i,
+        )
+        got.append(step_logits[:, 0])
+    got = jnp.stack(got, axis=1)  # logits at positions t_prompt-1 .. t_total-1
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(ref_logits[:, t_prompt - 1 :]), atol=1e-4
+    )
+
+
+def test_left_padding_equivalence(tiny):
+    """A left-padded sequence must produce the same final logits as unpadded
+    (the rollout engine left-pads prompts)."""
+    cfg, params = tiny
+    t = 6
+    pad = 3
+    rng = np.random.default_rng(2)
+    ids = jnp.asarray(rng.integers(1, cfg.vocab_size, (1, t)), dtype=jnp.int32)
+    pos = jnp.broadcast_to(jnp.arange(t), (1, t))
+    mask = jnp.ones((1, t))
+    ref_logits, _ = decoder.forward(params, cfg, ids, pos, mask)
+
+    ids_p = jnp.concatenate([jnp.zeros((1, pad), jnp.int32), ids], axis=1)
+    pos_p = jnp.concatenate([jnp.zeros((1, pad), jnp.int32), pos], axis=1)
+    mask_p = jnp.concatenate([jnp.zeros((1, pad)), mask], axis=1)
+    pad_logits, _ = decoder.forward(params, cfg, ids_p, pos_p, mask_p)
+    np.testing.assert_allclose(
+        np.asarray(pad_logits[:, pad:]), np.asarray(ref_logits), atol=1e-4
+    )
+
+
+def test_qk_norm_and_tied_embeddings():
+    cfg = decoder.get_config("tiny", dtype=jnp.float32, use_qk_norm=True, tie_word_embeddings=True)
+    params = decoder.init_params(jax.random.PRNGKey(0), cfg)
+    assert "lm_head" not in params
+    assert "q_norm" in params["layers"]
+    ids = jnp.ones((1, 4), dtype=jnp.int32)
+    pos = jnp.broadcast_to(jnp.arange(4), (1, 4))
+    logits, _ = decoder.forward(params, cfg, ids, pos, jnp.ones((1, 4)))
+    assert logits.shape == (1, 4, cfg.vocab_size)
+
+
+def test_sharded_forward_on_mesh(devices8):
+    """pjit the forward over a dp2×fsdp2×tp2 mesh; GSPMD must handle the
+    (fsdp, tp) param sharding without python-level collectives."""
+    cfg = decoder.get_config("tiny", dtype=jnp.float32)
+    params = decoder.init_params(jax.random.PRNGKey(0), cfg)
+    m = meshlib.make_mesh(meshlib.MeshConfig(dp=2, fsdp=2, tp=2, sp=1))
+    specs = decoder.param_specs(cfg)
+    sharded = jax.tree_util.tree_map(
+        lambda x, s: jax.device_put(x, meshlib.sharding(m, s)), params, specs,
+        is_leaf=lambda x: isinstance(x, jax.sharding.PartitionSpec),
+    )
+    b, t = 4, 8
+    ids = jnp.ones((b, t), dtype=jnp.int32)
+    pos = jnp.broadcast_to(jnp.arange(t), (b, t))
+    mask = jnp.ones((b, t))
+    data_sharding = meshlib.sharding(m, jax.sharding.PartitionSpec((meshlib.DP, meshlib.FSDP), None))
+    ids, pos, mask = (jax.device_put(x, data_sharding) for x in (ids, pos, mask))
+
+    @jax.jit
+    def f(p, ids, pos, mask):
+        logits, _ = decoder.forward(p, cfg, ids, pos, mask)
+        return logits
+
+    logits = f(sharded, ids, pos, mask)
+    ref, _ = decoder.forward(params, cfg, jnp.ones((b, t), jnp.int32), pos, mask)
+    np.testing.assert_allclose(np.asarray(logits), np.asarray(ref), atol=2e-4)

