@@ -1,0 +1,67 @@
+"""Benchmark: rollout decode throughput (tok/s/chip) on the flagship model.
+
+Runs on the real TPU chip. Prints ONE JSON line:
+``{"metric": ..., "value": N, "unit": ..., "vs_baseline": N}``.
+
+Baseline: the driver-supplied north star of 2,000 rollout tok/s/chip
+(Llama-3.1-8B GRPO on v5e-64 — BASELINE.md). This round benches the
+Qwen3-1.7B-class flagship (the reference recipe model) on one chip;
+``vs_baseline`` is value/2000.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from polyrl_tpu.models import decoder
+    from polyrl_tpu.rollout.engine import RolloutEngine
+    from polyrl_tpu.rollout.sampling import SamplingParams
+
+    preset = os.environ.get("POLYRL_BENCH_PRESET", "qwen3-1.7b")
+    batch = int(os.environ.get("POLYRL_BENCH_BATCH", "64"))
+    prompt_len = int(os.environ.get("POLYRL_BENCH_PROMPT", "128"))
+    new_tokens = int(os.environ.get("POLYRL_BENCH_NEW", "128"))
+
+    cfg = decoder.get_config(preset, dtype=jnp.bfloat16)
+    params = jax.jit(lambda: decoder.init_params(jax.random.PRNGKey(0), cfg))()
+    jax.block_until_ready(params)
+
+    engine = RolloutEngine(
+        cfg, params, pad_token_id=0,
+        batch_buckets=(batch,), prompt_buckets=(prompt_len,),
+        kv_cache_dtype=jnp.bfloat16,
+    )
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(1, cfg.vocab_size, prompt_len).tolist() for _ in range(batch)]
+    sp = SamplingParams(temperature=1.0, max_new_tokens=new_tokens, stop_token_ids=())
+
+    # warmup / compile
+    engine.generate(prompts, sp, rng=jax.random.PRNGKey(0))
+    # timed
+    t0 = time.monotonic()
+    outs = engine.generate(prompts, sp, rng=jax.random.PRNGKey(1))
+    dt = time.monotonic() - t0
+    total_new = sum(o.completion_tokens for o in outs)
+    tok_s = total_new / dt
+
+    n_chips = max(len(jax.devices()), 1)
+    result = {
+        "metric": f"rollout_decode_tok_s_per_chip[{preset},b{batch},p{prompt_len},g{new_tokens}]",
+        "value": round(tok_s / n_chips, 1),
+        "unit": "tok/s/chip",
+        "vs_baseline": round(tok_s / n_chips / 2000.0, 3),
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,171 @@
+"""ManagerClient + launcher — Python side of the rollout control plane.
+
+Plays the roles of the reference's trainer-side HTTP calls
+(``stream_batch_iter.py`` streaming batch iterator, C7;
+``launcher.py:32-49`` spawn_rollout_manager; registration/metrics calls in
+``stream_ray_trainer.py:691-704`` and ``sglang_http_async_engine.py:102-113``)
+against the C++ ``polyrl-manager`` binary.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import subprocess
+import time
+import urllib.request
+from dataclasses import dataclass
+from typing import Any, Iterator
+
+_CPP_DIR = os.path.join(os.path.dirname(__file__), "cpp")
+_BINARY = os.path.join(_CPP_DIR, "polyrl-manager")
+
+
+def build_manager(force: bool = False) -> str:
+    """Build the C++ manager if needed; returns the binary path."""
+    if force or not os.path.exists(_BINARY):
+        subprocess.run(["make", "-C", _CPP_DIR], check=True, capture_output=True)
+    return _BINARY
+
+
+def spawn_rollout_manager(bind_addr: str = "0.0.0.0:0",
+                          config_file: str | None = None,
+                          extra_args: list[str] | None = None):
+    """Start the manager subprocess; returns (Popen, port). Reads the
+    'LISTENING <port>' line the binary prints (supports ephemeral ports)."""
+    binary = build_manager()
+    cmd = [binary, "--bind-addr", bind_addr]
+    if config_file:
+        cmd += ["--config-file", config_file]
+    cmd += extra_args or []
+    proc = subprocess.Popen(cmd, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+                            text=True)
+    line = proc.stdout.readline().strip()
+    if not line.startswith("LISTENING"):
+        proc.kill()
+        raise RuntimeError(f"manager failed to start: {line!r}")
+    port = int(line.split()[1])
+    return proc, port
+
+
+@dataclass
+class GenerateResult:
+    rid: str
+    success: bool
+    output_token_ids: list[int]
+    output_token_logprobs: list[float]
+    finish_reason: str
+    error: str = ""
+
+
+class ManagerClient:
+    def __init__(self, endpoint: str, timeout_s: float = 600.0):
+        self.endpoint = endpoint if endpoint.startswith("http") else f"http://{endpoint}"
+        self.timeout_s = timeout_s
+
+    # -- plain JSON calls --------------------------------------------------
+
+    def _call(self, method: str, path: str, payload: dict | None = None,
+              timeout: float | None = None) -> dict:
+        data = json.dumps(payload or {}).encode()
+        req = urllib.request.Request(
+            self.endpoint + path, data=data, method=method,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=timeout or self.timeout_s) as r:
+            return json.loads(r.read() or b"{}")
+
+    def health(self) -> bool:
+        try:
+            return self._call("GET", "/health", timeout=3.0).get("status") == "ok"
+        except Exception:
+            return False
+
+    def wait_healthy(self, deadline_s: float = 30.0) -> None:
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < deadline_s:
+            if self.health():
+                return
+            time.sleep(0.1)
+        raise TimeoutError("manager not healthy")
+
+    def get_instances_status(self) -> dict:
+        return self._call("GET", "/get_instances_status")
+
+    def register_rollout_instance(self, instance_endpoint: str) -> dict:
+        return self._call("POST", "/register_rollout_instance",
+                          {"endpoint": instance_endpoint})
+
+    def register_local_rollout_instances(self, endpoints: list[str]) -> dict:
+        return self._call("POST", "/register_local_rollout_instances",
+                          {"endpoints": endpoints})
+
+    def generate(self, rid: str, input_ids: list[int], sampling_params: dict) -> GenerateResult:
+        out = self._call("POST", "/generate", {
+            "rid": rid, "input_ids": input_ids, "sampling_params": sampling_params})
+        return self._to_result(out)
+
+    def update_weight_version(self) -> int:
+        return int(self._call("POST", "/update_weight_version")["weight_version"])
+
+    def get_receive_instances(self, sender: str = "") -> dict:
+        return self._call("POST", "/get_receive_instances", {"sender": sender})
+
+    def update_weights(self, instances: list[str], weight_version: int | None = None) -> dict:
+        payload: dict[str, Any] = {"instances": instances}
+        if weight_version is not None:
+            payload["weight_version"] = weight_version
+        return self._call("POST", "/update_weights", payload)
+
+    def update_weight_senders(self, senders: list[str], groups_per_sender: int = 1) -> dict:
+        return self._call("PUT", "/update_weight_senders",
+                          {"senders": senders, "groups_per_sender": groups_per_sender})
+
+    def update_metrics(self, **stats) -> dict:
+        return self._call("POST", "/update_metrics", stats)
+
+    def shutdown_instances(self, skip_if_updating_weights: bool = False) -> dict:
+        return self._call("POST", "/shutdown_instances",
+                          {"skip_if_updating_weights": skip_if_updating_weights})
+
+    def abort_local_requests(self) -> dict:
+        return self._call("POST", "/abort_local_requests")
+
+    def resume_local_instances(self) -> dict:
+        return self._call("POST", "/resume_local_instances")
+
+    # -- streaming batch (the C7 StreamingBatchIterator role) -------------
+
+    def batch_generate_stream(self, requests: list[dict],
+                              max_local_gen_s: float | None = None
+                              ) -> Iterator[GenerateResult]:
+        """POST /batch_generate_requests; yields results as NDJSON lines
+        arrive. The first 'notifier' line is consumed internally (it signals
+        batch acceptance — reference stream_batch_iter.py:41-43)."""
+        payload: dict[str, Any] = {"requests": requests}
+        if max_local_gen_s is not None:
+            payload["max_local_gen_s"] = max_local_gen_s
+        req = urllib.request.Request(
+            self.endpoint + "/batch_generate_requests",
+            data=json.dumps(payload).encode(), method="POST",
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=self.timeout_s) as r:
+            for raw in r:
+                line = raw.decode().strip()
+                if not line:
+                    continue
+                obj = json.loads(line)
+                if obj.get("type") == "notifier":
+                    continue
+                yield self._to_result(obj)
+
+    @staticmethod
+    def _to_result(out: dict) -> GenerateResult:
+        return GenerateResult(
+            rid=out.get("rid", ""),
+            success=bool(out.get("success", False)),
+            output_token_ids=[int(t) for t in out.get("output_token_ids", [])],
+            output_token_logprobs=[float(x) for x in out.get("output_token_logprobs", [])],
+            finish_reason=out.get("finish_reason", ""),
+            error=out.get("error", ""),
+        )

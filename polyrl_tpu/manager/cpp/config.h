@@ -1,0 +1,96 @@
+// CLI + TOML-subset config (override order: CLI > file > default),
+// mirroring the reference's config plane (SURVEY.md C16f, config.rs:6).
+#pragma once
+
+#include <fstream>
+#include <map>
+#include <sstream>
+#include <string>
+#include <vector>
+
+namespace manager {
+
+struct Config {
+  std::string bind_addr = "0.0.0.0:30000";
+  int max_assigned_batches_per_stats_check = 4;
+  double stats_poll_interval_s = 1.0;
+  double health_check_interval_s = 2.0;
+  double health_check_deadline_s = 300.0;
+  int max_generate_attempts = 5;
+  int generate_timeout_ms = 600000;
+  int groups_per_sender = 4;
+  double initial_local_gen_s = 150.0;
+  std::vector<std::string> allowed_sender_ips;  // CIDR filters (doc only v0)
+};
+
+// Minimal TOML subset: `key = value` lines; strings, ints, floats, bools,
+// arrays of strings; [sections] flattened as "section.key".
+inline std::map<std::string, std::string> parse_toml(const std::string& path) {
+  std::map<std::string, std::string> out;
+  std::ifstream f(path);
+  std::string line, section;
+  while (std::getline(f, line)) {
+    size_t hash = line.find('#');
+    if (hash != std::string::npos) line = line.substr(0, hash);
+    auto trim = [](std::string s) {
+      size_t a = s.find_first_not_of(" \t\r");
+      size_t b = s.find_last_not_of(" \t\r");
+      return a == std::string::npos ? std::string() : s.substr(a, b - a + 1);
+    };
+    line = trim(line);
+    if (line.empty()) continue;
+    if (line.front() == '[' && line.back() == ']') {
+      section = trim(line.substr(1, line.size() - 2));
+      continue;
+    }
+    size_t eq = line.find('=');
+    if (eq == std::string::npos) continue;
+    std::string key = trim(line.substr(0, eq));
+    std::string val = trim(line.substr(eq + 1));
+    if (val.size() >= 2 && val.front() == '"' && val.back() == '"')
+      val = val.substr(1, val.size() - 2);
+    out[(section.empty() ? key : section + "." + key)] = val;
+  }
+  return out;
+}
+
+inline Config load_config(int argc, char** argv) {
+  Config cfg;
+  std::string config_file;
+  // pass 1: find --config-file
+  for (int i = 1; i < argc - 1; ++i)
+    if (std::string(argv[i]) == "--config-file") config_file = argv[i + 1];
+  if (!config_file.empty()) {
+    auto kv = parse_toml(config_file);
+    auto get = [&](const std::string& k) -> const std::string* {
+      auto it = kv.find(k);
+      return it == kv.end() ? nullptr : &it->second;
+    };
+    if (auto* v = get("bind_addr")) cfg.bind_addr = *v;
+    if (auto* v = get("max_assigned_batches_per_stats_check"))
+      cfg.max_assigned_batches_per_stats_check = std::stoi(*v);
+    if (auto* v = get("stats_poll_interval_s")) cfg.stats_poll_interval_s = std::stod(*v);
+    if (auto* v = get("health_check_interval_s")) cfg.health_check_interval_s = std::stod(*v);
+    if (auto* v = get("health_check_deadline_s")) cfg.health_check_deadline_s = std::stod(*v);
+    if (auto* v = get("max_generate_attempts")) cfg.max_generate_attempts = std::stoi(*v);
+    if (auto* v = get("generate_timeout_ms")) cfg.generate_timeout_ms = std::stoi(*v);
+    if (auto* v = get("groups_per_sender")) cfg.groups_per_sender = std::stoi(*v);
+    if (auto* v = get("initial_local_gen_s")) cfg.initial_local_gen_s = std::stod(*v);
+  }
+  // pass 2: CLI overrides
+  for (int i = 1; i < argc - 1; ++i) {
+    std::string a = argv[i];
+    std::string v = argv[i + 1];
+    if (a == "--bind-addr") cfg.bind_addr = v;
+    else if (a == "--max-assigned-batches") cfg.max_assigned_batches_per_stats_check = std::stoi(v);
+    else if (a == "--stats-poll-interval-s") cfg.stats_poll_interval_s = std::stod(v);
+    else if (a == "--health-check-interval-s") cfg.health_check_interval_s = std::stod(v);
+    else if (a == "--health-check-deadline-s") cfg.health_check_deadline_s = std::stod(v);
+    else if (a == "--max-generate-attempts") cfg.max_generate_attempts = std::stoi(v);
+    else if (a == "--generate-timeout-ms") cfg.generate_timeout_ms = std::stoi(v);
+    else if (a == "--groups-per-sender") cfg.groups_per_sender = std::stoi(v);
+  }
+  return cfg;
+}
+
+}  // namespace manager

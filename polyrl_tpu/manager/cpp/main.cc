@@ -1,0 +1,466 @@
+// polyrl-manager — rollout control plane + fault-tolerant request router.
+//
+// C++ (TPU-native build) equivalent of the reference's Rust rollout-manager
+// (SURVEY.md C16, rollout-manager/src/): instance registry + health checks
+// + stats polling, quota/zero-queue round-robin scheduling, streaming
+// generation routing with instance eviction and token-level continuation,
+// local-engine time-slicing, adaptive local/remote balancing, and
+// weight-version orchestration. Routes mirror main.rs:56-70.
+//
+// Build: make -C polyrl_tpu/manager/cpp   (→ polyrl-manager)
+
+#include <signal.h>
+
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdio>
+#include <deque>
+#include <mutex>
+#include <thread>
+
+#include "config.h"
+#include "http.h"
+#include "json.h"
+#include "state.h"
+#include "utils.h"
+
+namespace manager {
+
+using pjson::Array;
+using pjson::Object;
+using pjson::Value;
+
+static void log_line(const std::string& msg) {
+  auto now = std::chrono::system_clock::to_time_t(std::chrono::system_clock::now());
+  char buf[32];
+  strftime(buf, sizeof(buf), "%H:%M:%S", localtime(&now));
+  fprintf(stderr, "[manager %s] %s\n", buf, msg.c_str());
+}
+
+class Manager {
+ public:
+  explicit Manager(Config cfg)
+      : cfg_(std::move(cfg)), state_(cfg_.max_assigned_batches_per_stats_check) {
+    state_.balance.set_initial_gen_s(cfg_.initial_local_gen_s);
+  }
+
+  AppState& state() { return state_; }
+  const Config& config() const { return cfg_; }
+
+  // ---- generation with eviction + token-level continuation -------------
+  // (reference process_single_generate_request, handlers.rs:330-418)
+
+  Value process_generate(const Value& request, int want_local = -1) {
+    std::string rid = request["rid"].as_str();
+    PartialResponse acc;
+    Value current = request;
+    for (int attempt = 0; attempt < cfg_.max_generate_attempts; ++attempt) {
+      InstancePtr inst = state_.next_instance(want_local);
+      if (!inst) return error_response(rid, "no instance available");
+      bool finished = stream_from_instance(inst, current, acc);
+      // assigned_batches is a RATE quota: incremented on assignment, zeroed
+      // by the stats tick — never decremented (reference state.rs:84-147).
+      state_.notify_available();
+      if (finished) return build_final_response(rid, acc);
+      // failure: evict remote instances (shutdown+deregister), keep locals
+      // (they fail by abort during time-slicing, not by dying)
+      if (!inst->is_local) {
+        log_line("evicting instance " + inst->endpoint + " after stream failure");
+        state_.deregister(inst->endpoint);
+        std::string ep = inst->endpoint;
+        std::thread([ep] { phttp::request("POST", ep, "/shutdown", "{}", 2000); }).detach();
+      }
+      if (!acc.token_ids.empty()) {
+        current = build_continuation_request(request, acc);
+      }
+    }
+    if (!acc.token_ids.empty()) {
+      // give the trainer what we have (partial, marked abort)
+      acc.finished = false;
+      acc.finish_reason = "abort";
+      return build_final_response(rid, acc);
+    }
+    return error_response(rid, "max attempts exhausted");
+  }
+
+  // Stream one attempt; true iff the instance reported finished.
+  bool stream_from_instance(const InstancePtr& inst, const Value& request,
+                            PartialResponse& acc) {
+    std::string host;
+    int port;
+    if (!phttp::split_endpoint(inst->endpoint, host, port)) return false;
+    phttp::ClientConn conn;
+    if (!conn.connect(host, port, cfg_.generate_timeout_ms)) return false;
+    // fresh top-level object: pjson::Value copies alias the shared Object,
+    // so set() on a plain copy would mutate the caller's request.
+    pjson::Object req_obj = request.as_obj();
+    req_obj["stream"] = Value(true);
+    Value req(std::move(req_obj));
+    if (!conn.send_request("POST", host, "/generate", req.dump())) return false;
+    int status = 0;
+    if (!conn.read_header(status) || status != 200) return false;
+    std::string line;
+    while (conn.read_line(line)) {
+      if (line.empty()) continue;
+      // accept SGLang-style "data: {...}" or bare NDJSON
+      if (line.rfind("data:", 0) == 0) line = line.substr(5);
+      bool ok = false;
+      Value chunk = pjson::Parser::parse(line, &ok);
+      if (!ok) return false;  // decode error → eviction path
+      if (chunk["finish_reason"].as_str() == "abort") {
+        merge_chunk(acc, chunk);
+        acc.finished = false;  // abort = time-slice preemption → continue elsewhere
+        acc.finish_reason.clear();
+        return false;
+      }
+      merge_chunk(acc, chunk);
+      if (acc.finished) return true;
+    }
+    return acc.finished;
+  }
+
+  // ---- batch generate: NDJSON stream with time-sliced local engines ----
+  // (reference timed_batch_generate_requests, handlers.rs:442-564)
+
+  void batch_generate(const Value& body, phttp::ResponseWriter& rw) {
+    const Array& requests = body["requests"].as_arr();
+    double max_local_gen_s = body["max_local_gen_s"].is_num()
+                                 ? body["max_local_gen_s"].as_num()
+                                 : state_.balance.max_local_gen_s();
+    auto t_start = std::chrono::steady_clock::now();
+
+    rw.content_type = "application/x-ndjson";
+    if (!rw.start_stream()) return;
+    // first line = notifier: the batch was accepted (the trainer's local
+    // engines may now context-switch, stream_batch_iter.py:41-43)
+    rw.write_chunk("{\"type\":\"notifier\"}\n");
+
+    std::mutex mu;
+    std::condition_variable cv;
+    std::deque<std::string> ready;
+    size_t remaining = requests.size();
+    std::atomic<int64_t> total_resp_tokens{0};
+
+    std::vector<std::thread> workers;
+    workers.reserve(requests.size());
+    for (const auto& r : requests) {
+      workers.emplace_back([this, r, &mu, &cv, &ready, &remaining, &total_resp_tokens] {
+        Value resp = process_generate(r);
+        total_resp_tokens += resp["completion_tokens"].as_int();
+        std::lock_guard<std::mutex> g(mu);
+        ready.push_back(resp.dump() + "\n");
+        --remaining;
+        cv.notify_all();
+      });
+    }
+
+    // time-slice watchdog: after the local window, pull local engines from
+    // the pool and abort their in-flight requests (handlers.rs:500-513)
+    std::atomic<bool> batch_done{false};
+    std::thread watchdog([this, max_local_gen_s, &batch_done] {
+      double waited = 0;
+      while (!batch_done.load() && waited < max_local_gen_s) {
+        std::this_thread::sleep_for(std::chrono::milliseconds(200));
+        waited += 0.2;
+      }
+      if (batch_done.load()) return;
+      auto locals = state_.remove_local_from_active();
+      double local_window = max_local_gen_s;
+      for (auto& inst : locals) {
+        log_line("time-slice: aborting local instance " + inst->endpoint +
+                 " after " + std::to_string(local_window) + "s");
+        phttp::request("POST", inst->endpoint, "/abort_request", "{\"abort_all\":true}", 2000);
+      }
+    });
+
+    // drain results to the trainer as they finish
+    {
+      std::unique_lock<std::mutex> lk(mu);
+      while (remaining > 0 || !ready.empty()) {
+        cv.wait(lk, [&] { return !ready.empty() || remaining == 0; });
+        while (!ready.empty()) {
+          std::string line = std::move(ready.front());
+          ready.pop_front();
+          lk.unlock();
+          rw.write_chunk(line);
+          lk.lock();
+        }
+      }
+    }
+    batch_done = true;
+    watchdog.join();
+    for (auto& w : workers) w.join();
+
+    double total_s = std::chrono::duration<double>(
+                         std::chrono::steady_clock::now() - t_start).count();
+    double mean_len = requests.empty() ? 0.0
+                          : static_cast<double>(total_resp_tokens.load()) /
+                                static_cast<double>(requests.size());
+    state_.balance.record_generation(total_s, std::min(total_s, max_local_gen_s), mean_len);
+  }
+
+  // ---- background workers ---------------------------------------------
+
+  void start_stats_poller() {
+    stats_thread_ = std::thread([this] {
+      while (!state_.is_shutdown()) {
+        for (auto& inst : state_.active_instances()) {
+          auto resp = phttp::request("GET", inst->endpoint, "/get_server_info", "", 2000);
+          if (resp.ok()) {
+            bool ok = false;
+            Value info = pjson::Parser::parse(resp.body, &ok);
+            if (ok) {
+              inst->num_running_reqs = info["num_running_reqs"].as_int();
+              inst->num_queued_reqs = info["num_queued_reqs"].as_int();
+              inst->last_gen_throughput = info["last_gen_throughput"].as_num();
+            }
+          }
+        }
+        state_.reset_quotas();
+        std::this_thread::sleep_for(std::chrono::milliseconds(
+            static_cast<int>(cfg_.stats_poll_interval_s * 1000)));
+      }
+    });
+  }
+
+  void health_check_async(const std::string& endpoint) {
+    std::thread([this, endpoint] {
+      auto deadline = std::chrono::steady_clock::now() +
+                      std::chrono::duration<double>(cfg_.health_check_deadline_s);
+      while (std::chrono::steady_clock::now() < deadline && !state_.is_shutdown()) {
+        auto resp = phttp::request("GET", endpoint, "/health_generate", "", 3000);
+        if (resp.ok()) {
+          state_.promote_healthy(endpoint);
+          log_line("instance healthy: " + endpoint);
+          return;
+        }
+        std::this_thread::sleep_for(std::chrono::duration<double>(cfg_.health_check_interval_s));
+      }
+      log_line("health check deadline exceeded: " + endpoint);
+      state_.deregister(endpoint);
+    }).detach();
+  }
+
+  void join() {
+    if (stats_thread_.joinable()) stats_thread_.join();
+  }
+
+ private:
+  Config cfg_;
+  AppState state_;
+  std::thread stats_thread_;
+};
+
+// ---- route registration ----------------------------------------------------
+
+void register_routes(phttp::Server& server, Manager& mgr) {
+  auto& state = mgr.state();
+
+  server.route("GET", "/health", [](const phttp::Request&, phttp::ResponseWriter& rw) {
+    rw.body = "{\"status\":\"ok\"}";
+  });
+
+  server.route("GET", "/get_instances_status",
+               [&](const phttp::Request&, phttp::ResponseWriter& rw) {
+    Array arr;
+    for (auto& inst : state.all_instances()) {
+      Object o;
+      o["endpoint"] = Value(inst->endpoint);
+      o["is_local"] = Value(inst->is_local);
+      o["healthy"] = Value(inst->healthy.load());
+      o["updating_weight"] = Value(inst->updating_weight.load());
+      o["weight_version"] = Value(inst->weight_version.load());
+      o["num_running_reqs"] = Value(inst->num_running_reqs.load());
+      o["num_queued_reqs"] = Value(inst->num_queued_reqs.load());
+      o["weight_sender"] = Value(inst->weight_sender);
+      o["group_idx"] = Value(inst->group_idx);
+      arr.push_back(Value(std::move(o)));
+    }
+    Object top;
+    top["instances"] = Value(std::move(arr));
+    top["weight_version"] = Value(state.weight_version());
+    top["max_local_gen_s"] = Value(state.balance.max_local_gen_s());
+    rw.body = Value(std::move(top)).dump();
+  });
+
+  server.route("POST", "/register_rollout_instance",
+               [&](const phttp::Request& req, phttp::ResponseWriter& rw) {
+    Value body = pjson::Parser::parse(req.body);
+    std::string endpoint = body["endpoint"].as_str();
+    if (endpoint.empty()) { rw.status = 400; rw.body = "{\"error\":\"endpoint required\"}"; return; }
+    auto [sender, group] = state.register_instance(endpoint, false);
+    mgr.health_check_async(endpoint);
+    Object o;
+    o["weight_sender_endpoint"] = Value(sender);
+    o["group_idx"] = Value(group);
+    rw.body = Value(std::move(o)).dump();
+    log_line("registered remote instance " + endpoint);
+  });
+
+  server.route("POST", "/register_local_rollout_instances",
+               [&](const phttp::Request& req, phttp::ResponseWriter& rw) {
+    Value body = pjson::Parser::parse(req.body);
+    for (const auto& ep : body["endpoints"].as_arr())
+      state.register_instance(ep.as_str(), true);
+    rw.body = "{\"status\":\"ok\"}";
+  });
+
+  server.route("POST", "/generate",
+               [&](const phttp::Request& req, phttp::ResponseWriter& rw) {
+    Value body = pjson::Parser::parse(req.body);
+    rw.body = mgr.process_generate(body).dump();
+  });
+
+  server.route("POST", "/batch_generate_requests",
+               [&](const phttp::Request& req, phttp::ResponseWriter& rw) {
+    Value body = pjson::Parser::parse(req.body);
+    mgr.batch_generate(body, rw);
+  });
+
+  server.route("POST", "/update_weight_version",
+               [&](const phttp::Request&, phttp::ResponseWriter& rw) {
+    int64_t v = state.update_weight_version();
+    Object o;
+    o["weight_version"] = Value(v);
+    rw.body = Value(std::move(o)).dump();
+    log_line("weight version -> " + std::to_string(v));
+  });
+
+  server.route("POST", "/get_receive_instances",
+               [&](const phttp::Request& req, phttp::ResponseWriter& rw) {
+    Value body = pjson::Parser::parse(req.body);
+    auto insts = state.get_receive_instances(body["sender"].as_str());
+    Array arr;
+    for (auto& inst : insts) {
+      Object o;
+      o["endpoint"] = Value(inst->endpoint);
+      o["group_idx"] = Value(inst->group_idx);
+      o["bootstrap"] = Value(inst->weight_version.load() < 0);
+      arr.push_back(Value(std::move(o)));
+    }
+    Object top;
+    top["instances"] = Value(std::move(arr));
+    top["weight_version"] = Value(state.weight_version());
+    rw.body = Value(std::move(top)).dump();
+  });
+
+  server.route("POST", "/update_weights",
+               [&](const phttp::Request& req, phttp::ResponseWriter& rw) {
+    // transfer complete for these instances: tell each engine to load from
+    // its receiver agent, then rejoin the pool (handlers.rs:681-795)
+    Value body = pjson::Parser::parse(req.body);
+    int64_t version = body["weight_version"].is_num() ? body["weight_version"].as_int()
+                                                      : state.weight_version();
+    Array results;
+    for (const auto& epv : body["instances"].as_arr()) {
+      std::string ep = epv.as_str();
+      Object per;
+      per["endpoint"] = Value(ep);
+      auto resp = phttp::request("POST", ep, "/update_weights_from_agent",
+                                 "{\"weight_version\":" + std::to_string(version) + "}",
+                                 120000);
+      if (resp.ok()) {
+        state.complete_weight_update(ep, version);
+        per["success"] = Value(true);
+      } else {
+        state.abort_weight_update(ep);
+        per["success"] = Value(false);
+      }
+      results.push_back(Value(std::move(per)));
+    }
+    Object top;
+    top["results"] = Value(std::move(results));
+    rw.body = Value(std::move(top)).dump();
+  });
+
+  server.route("PUT", "/update_weight_senders",
+               [&](const phttp::Request& req, phttp::ResponseWriter& rw) {
+    Value body = pjson::Parser::parse(req.body);
+    std::vector<std::string> senders;
+    for (const auto& s : body["senders"].as_arr()) senders.push_back(s.as_str());
+    int groups = static_cast<int>(body["groups_per_sender"].as_int(mgr.config().groups_per_sender));
+    state.set_weight_senders(std::move(senders), groups);
+    rw.body = "{\"status\":\"ok\"}";
+  });
+
+  server.route("POST", "/shutdown_instances",
+               [&](const phttp::Request& req, phttp::ResponseWriter& rw) {
+    Value body = pjson::Parser::parse(req.body);
+    bool skip_updating = body["skip_if_updating_weights"].as_bool();
+    int count = 0;
+    for (auto& inst : state.all_instances()) {
+      if (inst->is_local) continue;
+      if (skip_updating && inst->updating_weight.load()) continue;
+      phttp::request("POST", inst->endpoint, "/shutdown", "{}", 2000);
+      state.deregister(inst->endpoint);
+      ++count;
+    }
+    Object o;
+    o["shutdown_count"] = Value(count);
+    rw.body = Value(std::move(o)).dump();
+  });
+
+  server.route("POST", "/update_metrics",
+               [&](const phttp::Request& req, phttp::ResponseWriter& rw) {
+    Value body = pjson::Parser::parse(req.body);
+    LoadBalanceState::StepStats s;
+    s.step_time_s = body["step_time_s"].as_num();
+    s.total_gen_time_s = body["total_gen_time_s"].is_num()
+                             ? body["total_gen_time_s"].as_num()
+                             : state.balance.last_total_gen_s();
+    s.trainer_bubble_s = body["trainer_bubble_s"].as_num();
+    s.throughput = body["throughput"].as_num();
+    s.num_instances = static_cast<int>(body["num_instances"].as_int(
+        static_cast<int64_t>(state.active_count())));
+    double new_window = state.balance.update(s);
+    Object o;
+    o["max_local_gen_s"] = Value(new_window);
+    o["num_instances"] = Value(static_cast<int64_t>(state.active_count()));
+    rw.body = Value(std::move(o)).dump();
+  });
+
+  server.route("POST", "/abort_local_requests",
+               [&](const phttp::Request&, phttp::ResponseWriter& rw) {
+    auto locals = state.remove_local_from_active();
+    for (auto& inst : locals)
+      phttp::request("POST", inst->endpoint, "/abort_request", "{\"abort_all\":true}", 2000);
+    Object o;
+    o["aborted_instances"] = Value(static_cast<int64_t>(locals.size()));
+    rw.body = Value(std::move(o)).dump();
+  });
+
+  server.route("POST", "/resume_local_instances",
+               [&](const phttp::Request&, phttp::ResponseWriter& rw) {
+    state.add_local_to_active();
+    rw.body = "{\"status\":\"ok\"}";
+  });
+}
+
+}  // namespace manager
+
+int main(int argc, char** argv) {
+  signal(SIGPIPE, SIG_IGN);
+  manager::Config cfg = manager::load_config(argc, argv);
+  manager::Manager mgr(cfg);
+  phttp::Server server;
+  manager::register_routes(server, mgr);
+
+  std::string host;
+  int port;
+  if (!phttp::split_endpoint(cfg.bind_addr, host, port)) {
+    fprintf(stderr, "bad --bind-addr %s\n", cfg.bind_addr.c_str());
+    return 1;
+  }
+  int bound = server.listen(host, port);
+  if (bound < 0) {
+    fprintf(stderr, "failed to bind %s\n", cfg.bind_addr.c_str());
+    return 1;
+  }
+  manager::log_line("listening on " + host + ":" + std::to_string(bound));
+  printf("LISTENING %d\n", bound);
+  fflush(stdout);
+  mgr.start_stats_poller();
+  server.serve();
+  return 0;
+}

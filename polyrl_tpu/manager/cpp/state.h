@@ -1,0 +1,305 @@
+// Instance registry + scheduler + weight-sender assignment.
+//
+// C++ equivalent of the reference manager's state.rs (SURVEY.md C16a):
+// remote/local instance registries with atomic telemetry, pending set,
+// active pool, quota + zero-queue round-robin scheduling
+// (state.rs:84-147), round-robin weight-sender assignment (:149-162),
+// weight-version orchestration, graceful shutdown (:224-270).
+#pragma once
+
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <set>
+#include <string>
+#include <vector>
+
+#include "balance.h"
+
+namespace manager {
+
+struct Instance {
+  std::string endpoint;          // host:port of the rollout engine HTTP server
+  bool is_local = false;         // colocated with the trainer (time-sliced)
+  int group_idx = 0;             // weight-sender group assignment
+  std::string weight_sender;     // assigned sender endpoint ("" = none yet)
+
+  // telemetry (stats poller writes, scheduler reads)
+  std::atomic<int64_t> num_running_reqs{0};
+  std::atomic<int64_t> num_queued_reqs{0};
+  std::atomic<double> last_gen_throughput{0.0};
+  std::atomic<int64_t> assigned_batches{0};
+  std::atomic<bool> updating_weight{false};
+  std::atomic<int64_t> weight_version{-1};
+  std::atomic<bool> healthy{false};
+};
+
+using InstancePtr = std::shared_ptr<Instance>;
+
+class AppState {
+ public:
+  explicit AppState(int max_assigned_batches = 4)
+      : max_assigned_batches_(max_assigned_batches) {}
+
+  // -- registration ----------------------------------------------------
+
+  // Returns assigned (weight_sender, group_idx). Instance starts pending
+  // until promote_healthy.
+  std::pair<std::string, int> register_instance(const std::string& endpoint,
+                                                bool is_local) {
+    std::lock_guard<std::mutex> g(mu_);
+    auto it = instances_.find(endpoint);
+    InstancePtr inst;
+    if (it != instances_.end()) {
+      inst = it->second;
+    } else {
+      inst = std::make_shared<Instance>();
+      inst->endpoint = endpoint;
+      instances_[endpoint] = inst;
+    }
+    inst->is_local = is_local;
+    if (inst->weight_sender.empty() && !weight_senders_.empty()) {
+      auto [sender, group] = next_sender_locked();
+      inst->weight_sender = sender;
+      inst->group_idx = group;
+    }
+    if (is_local) {
+      // local engines are trusted healthy (they registered from in-process)
+      inst->healthy = true;
+      active_.insert(endpoint);
+      cv_.notify_all();
+    } else {
+      pending_.insert(endpoint);
+    }
+    return {inst->weight_sender, inst->group_idx};
+  }
+
+  void promote_healthy(const std::string& endpoint) {
+    std::lock_guard<std::mutex> g(mu_);
+    auto it = instances_.find(endpoint);
+    if (it == instances_.end()) return;
+    it->second->healthy = true;
+    pending_.erase(endpoint);
+    // joins the ACTIVE pool only after weight bootstrap (get_receive_instances
+    // → update_weights), mirroring handlers.rs:40-86. With no senders
+    // registered (no weight fabric), it goes straight to active.
+    if (weight_senders_.empty()) {
+      active_.insert(endpoint);
+      cv_.notify_all();
+    }
+  }
+
+  void deregister(const std::string& endpoint) {
+    std::lock_guard<std::mutex> g(mu_);
+    active_.erase(endpoint);
+    pending_.erase(endpoint);
+    instances_.erase(endpoint);
+  }
+
+  InstancePtr get(const std::string& endpoint) {
+    std::lock_guard<std::mutex> g(mu_);
+    auto it = instances_.find(endpoint);
+    return it == instances_.end() ? nullptr : it->second;
+  }
+
+  std::vector<InstancePtr> all_instances() {
+    std::lock_guard<std::mutex> g(mu_);
+    std::vector<InstancePtr> out;
+    for (auto& [_, inst] : instances_) out.push_back(inst);
+    return out;
+  }
+
+  std::vector<InstancePtr> active_instances() {
+    std::lock_guard<std::mutex> g(mu_);
+    std::vector<InstancePtr> out;
+    for (auto& ep : active_) {
+      auto it = instances_.find(ep);
+      if (it != instances_.end()) out.push_back(it->second);
+    }
+    return out;
+  }
+
+  size_t active_count() {
+    std::lock_guard<std::mutex> g(mu_);
+    return active_.size();
+  }
+
+  // -- scheduling (reference next_instance_with_type, state.rs:84-147) --
+
+  // Block until an instance is available: quota not exhausted AND zero
+  // queued requests; round-robin among eligible. want_local filters by
+  // locality (-1 = any). Returns nullptr on shutdown/timeout.
+  InstancePtr next_instance(int want_local = -1, int timeout_ms = 120000) {
+    std::unique_lock<std::mutex> lk(mu_);
+    auto deadline = std::chrono::steady_clock::now() +
+                    std::chrono::milliseconds(timeout_ms);
+    while (!shutdown_) {
+      std::vector<InstancePtr> eligible;
+      for (auto& ep : active_) {
+        auto it = instances_.find(ep);
+        if (it == instances_.end()) continue;
+        auto& inst = it->second;
+        if (want_local >= 0 && inst->is_local != (want_local == 1)) continue;
+        if (inst->updating_weight.load()) continue;
+        if (inst->assigned_batches.load() >= max_assigned_batches_) continue;
+        if (inst->num_queued_reqs.load() > 0) continue;
+        eligible.push_back(inst);
+      }
+      if (!eligible.empty()) {
+        auto& pick = eligible[rr_counter_++ % eligible.size()];
+        pick->assigned_batches.fetch_add(1);
+        return pick;
+      }
+      if (cv_.wait_until(lk, deadline) == std::cv_status::timeout) return nullptr;
+    }
+    return nullptr;
+  }
+
+  // stats tick: refresh quota + wake blocked schedulers (state.rs quota
+  // reset each stats check).
+  void reset_quotas() {
+    std::lock_guard<std::mutex> g(mu_);
+    for (auto& [_, inst] : instances_) inst->assigned_batches = 0;
+    cv_.notify_all();
+  }
+
+  void notify_available() { cv_.notify_all(); }
+
+  // -- weight-version orchestration (handlers.rs:566-649) ---------------
+
+  // New trainer weights exist: drain the active pool (remote instances must
+  // re-bootstrap through the sender), keep/re-add local instances (they get
+  // weights in-process).
+  int64_t update_weight_version() {
+    std::lock_guard<std::mutex> g(mu_);
+    ++weight_version_;
+    std::set<std::string> next_active;
+    for (auto& ep : active_) {
+      auto it = instances_.find(ep);
+      if (it != instances_.end() && it->second->is_local) next_active.insert(ep);
+    }
+    active_ = std::move(next_active);
+    return weight_version_;
+  }
+
+  int64_t weight_version() {
+    std::lock_guard<std::mutex> g(mu_);
+    return weight_version_;
+  }
+
+  // Sender polls: return healthy instances whose weights are stale,
+  // CAS-marking them updating (handlers.rs:602-649).
+  std::vector<InstancePtr> get_receive_instances(const std::string& sender) {
+    std::lock_guard<std::mutex> g(mu_);
+    std::vector<InstancePtr> out;
+    for (auto& [_, inst] : instances_) {
+      if (!inst->healthy.load()) continue;
+      if (inst->is_local) continue;  // local engines get weights in-process
+      if (!sender.empty() && inst->weight_sender != sender) continue;
+      if (inst->weight_version.load() >= weight_version_) continue;
+      bool expected = false;
+      if (inst->updating_weight.compare_exchange_strong(expected, true)) {
+        out.push_back(inst);
+      }
+    }
+    return out;
+  }
+
+  // Transfer finished: record version, re-insert into the active pool,
+  // wake blocked schedulers (handlers.rs:727-786).
+  void complete_weight_update(const std::string& endpoint, int64_t version) {
+    std::lock_guard<std::mutex> g(mu_);
+    auto it = instances_.find(endpoint);
+    if (it == instances_.end()) return;
+    it->second->weight_version = version;
+    it->second->updating_weight = false;
+    active_.insert(endpoint);
+    cv_.notify_all();
+  }
+
+  void abort_weight_update(const std::string& endpoint) {
+    std::lock_guard<std::mutex> g(mu_);
+    auto it = instances_.find(endpoint);
+    if (it != instances_.end()) it->second->updating_weight = false;
+  }
+
+  // -- weight senders (launcher PUT /update_weight_senders) -------------
+
+  void set_weight_senders(std::vector<std::string> senders, int groups_per_sender) {
+    std::lock_guard<std::mutex> g(mu_);
+    weight_senders_ = std::move(senders);
+    groups_per_sender_ = std::max(groups_per_sender, 1);
+  }
+
+  std::vector<std::string> weight_senders() {
+    std::lock_guard<std::mutex> g(mu_);
+    return weight_senders_;
+  }
+
+  // -- local instance time-slicing (handlers.rs:500-513) ----------------
+
+  // Pull local instances out of the pool (trainer wants the chips back).
+  std::vector<InstancePtr> remove_local_from_active() {
+    std::lock_guard<std::mutex> g(mu_);
+    std::vector<InstancePtr> out;
+    for (auto it = active_.begin(); it != active_.end();) {
+      auto inst_it = instances_.find(*it);
+      if (inst_it != instances_.end() && inst_it->second->is_local) {
+        out.push_back(inst_it->second);
+        it = active_.erase(it);
+      } else {
+        ++it;
+      }
+    }
+    return out;
+  }
+
+  void add_local_to_active() {
+    std::lock_guard<std::mutex> g(mu_);
+    for (auto& [ep, inst] : instances_) {
+      if (inst->is_local && inst->healthy.load()) active_.insert(ep);
+    }
+    cv_.notify_all();
+  }
+
+  void shutdown() {
+    std::lock_guard<std::mutex> g(mu_);
+    shutdown_ = true;
+    cv_.notify_all();
+  }
+  bool is_shutdown() {
+    std::lock_guard<std::mutex> g(mu_);
+    return shutdown_;
+  }
+
+  LoadBalanceState balance;
+
+ private:
+  std::pair<std::string, int> next_sender_locked() {
+    // round-robin over senders × groups (state.rs:149-162)
+    size_t total = weight_senders_.size() * static_cast<size_t>(groups_per_sender_);
+    size_t idx = sender_rr_++ % std::max<size_t>(total, 1);
+    size_t sender_idx = idx / groups_per_sender_;
+    int group = static_cast<int>(idx % groups_per_sender_);
+    return {weight_senders_[sender_idx], group};
+  }
+
+  std::mutex mu_;
+  std::condition_variable cv_;
+  std::map<std::string, InstancePtr> instances_;
+  std::set<std::string> active_;
+  std::set<std::string> pending_;
+  std::vector<std::string> weight_senders_;
+  int groups_per_sender_ = 1;
+  size_t sender_rr_ = 0;
+  size_t rr_counter_ = 0;
+  int64_t weight_version_ = 0;
+  int max_assigned_batches_;
+  bool shutdown_ = false;
+};
+
+}  // namespace manager

@@ -1,0 +1,139 @@
+"""Fake rollout engine: a ~150-line HTTP server speaking the engine protocol
+(the test seam identified in SURVEY.md §4: /generate streaming NDJSON,
+/get_server_info, /health_generate, /update_weights_from_agent,
+/abort_request, /shutdown). Deliberately jax-free so manager tests are pure
+protocol tests.
+
+Failure injection: ``die_after_tokens`` makes the server emit N tokens then
+kill the stream mid-generation — exercising eviction + token-level
+continuation in the manager.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+
+class FakeEngine:
+    def __init__(self, die_after_tokens: int = -1, token_delay_s: float = 0.0,
+                 healthy_after_s: float = 0.0, start_token: int = 1000):
+        self.die_after_tokens = die_after_tokens
+        self.token_delay_s = token_delay_s
+        self.healthy_after_s = healthy_after_s
+        self.start_token = start_token
+        self.started_at = time.monotonic()
+        self.generate_calls = 0
+        self.weight_updates: list[int] = []
+        self.aborted = threading.Event()
+        self.shutdown_called = threading.Event()
+        self.server: ThreadingHTTPServer | None = None
+        self.port: int | None = None
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet
+                pass
+
+            def _json(self, code, obj):
+                body = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/health" or self.path == "/health_generate":
+                    if time.monotonic() - outer.started_at >= outer.healthy_after_s:
+                        self._json(200, {"status": "ok"})
+                    else:
+                        self._json(503, {"status": "starting"})
+                elif self.path == "/get_server_info":
+                    self._json(200, {
+                        "num_running_reqs": 0,
+                        "num_queued_reqs": 0,
+                        "last_gen_throughput": 123.0,
+                        "weight_version": outer.weight_updates[-1] if outer.weight_updates else -1,
+                    })
+                else:
+                    self._json(404, {"error": "nope"})
+
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length", 0))
+                body = json.loads(self.rfile.read(length) or b"{}")
+                if self.path == "/generate":
+                    outer.generate_calls += 1
+                    self.handle_generate(body)
+                elif self.path == "/update_weights_from_agent":
+                    outer.weight_updates.append(int(body.get("weight_version", -1)))
+                    self._json(200, {"success": True})
+                elif self.path == "/abort_request":
+                    outer.aborted.set()
+                    self._json(200, {"success": True})
+                elif self.path == "/shutdown":
+                    outer.shutdown_called.set()
+                    self._json(200, {"success": True})
+                    threading.Thread(target=outer.stop, daemon=True).start()
+                else:
+                    self._json(404, {"error": "nope"})
+
+            def handle_generate(self, body):
+                """Echo-ish generation: emits input len + i tokens, streaming."""
+                input_ids = body.get("input_ids", [])
+                sp = body.get("sampling_params", {})
+                max_new = int(sp.get("max_new_tokens", 8))
+                self.send_response(200)
+                self.send_header("Content-Type", "application/x-ndjson")
+                self.send_header("Transfer-Encoding", "chunked")
+                self.end_headers()
+
+                def chunk(line: str):
+                    data = line.encode()
+                    self.wfile.write(f"{len(data):x}\r\n".encode() + data + b"\r\n")
+                    self.wfile.flush()
+
+                emitted = 0
+                # deterministic "generation": token = start + len(input) + step
+                for i in range(max_new):
+                    if outer.die_after_tokens >= 0 and emitted >= outer.die_after_tokens:
+                        # simulate instance death: kill the socket mid-stream
+                        self.wfile.flush()
+                        self.connection.setsockopt(
+                            socket.SOL_SOCKET, socket.SO_LINGER,
+                            b"\x01\x00\x00\x00\x00\x00\x00\x00")
+                        self.connection.close()
+                        return
+                    tok = outer.start_token + len(input_ids) + i
+                    finished = i == max_new - 1
+                    line = json.dumps({
+                        "token_ids": [tok],
+                        "logprobs": [-0.5],
+                        "finished": finished,
+                        "finish_reason": "length" if finished else "",
+                    }) + "\n"
+                    chunk(line)
+                    emitted += 1
+                    if outer.token_delay_s:
+                        time.sleep(outer.token_delay_s)
+                self.wfile.write(b"0\r\n\r\n")
+
+        self._handler_cls = Handler
+
+    @property
+    def endpoint(self) -> str:
+        return f"127.0.0.1:{self.port}"
+
+    def start(self) -> "FakeEngine":
+        self.server = ThreadingHTTPServer(("127.0.0.1", 0), self._handler_cls)
+        self.port = self.server.server_address[1]
+        threading.Thread(target=self.server.serve_forever, daemon=True).start()
+        return self
+
+    def stop(self):
+        if self.server:
+            self.server.shutdown()
+            self.server = None

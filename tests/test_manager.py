@@ -1,0 +1,201 @@
+"""C++ rollout-manager protocol tests against fake engines (SURVEY.md §4:
+'a ~100-line fake SGLang suffices to test scheduling, eviction+continuation,
+time-slicing, and weight-version orchestration without GPUs/TPUs')."""
+
+import time
+
+import pytest
+
+from polyrl_tpu.manager.client import ManagerClient, spawn_rollout_manager
+from tests.fake_engine import FakeEngine
+
+
+@pytest.fixture()
+def manager():
+    proc, port = spawn_rollout_manager(
+        "127.0.0.1:0",
+        extra_args=["--health-check-interval-s", "0.1",
+                    "--stats-poll-interval-s", "0.2",
+                    "--generate-timeout-ms", "10000"])
+    client = ManagerClient(f"127.0.0.1:{port}")
+    client.wait_healthy()
+    yield client
+    proc.kill()
+
+
+def wait_active(client, n, deadline=10.0):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < deadline:
+        st = client.get_instances_status()
+        healthy = [i for i in st["instances"] if i["healthy"]]
+        if len(healthy) >= n:
+            return st
+        time.sleep(0.1)
+    raise TimeoutError(f"never saw {n} healthy instances: {client.get_instances_status()}")
+
+
+def test_health(manager):
+    assert manager.health()
+
+
+def test_register_and_generate(manager):
+    eng = FakeEngine().start()
+    try:
+        manager.register_rollout_instance(eng.endpoint)
+        wait_active(manager, 1)
+        res = manager.generate("r1", [1, 2, 3], {"max_new_tokens": 4})
+        assert res.success
+        # fake engine emits start + len(input) + i
+        assert res.output_token_ids == [1003, 1004, 1005, 1006]
+        assert res.output_token_logprobs == [-0.5] * 4
+        assert res.finish_reason == "length"
+    finally:
+        eng.stop()
+
+
+def test_eviction_and_continuation(manager):
+    """Instance dies after 2 tokens → manager evicts it and resumes the
+    request token-exactly on the healthy instance."""
+    dying = FakeEngine(die_after_tokens=2, start_token=1000).start()
+    healthy = FakeEngine(start_token=1000).start()
+    try:
+        manager.register_rollout_instance(dying.endpoint)
+        wait_active(manager, 1)
+        # occupy: send the request while only the dying engine is registered
+        manager.register_rollout_instance(healthy.endpoint)
+        wait_active(manager, 2)
+        res = None
+        # retry until the dying instance is the one picked first
+        for _ in range(6):
+            res = manager.generate("r2", [5, 6], {"max_new_tokens": 6})
+            if dying.generate_calls > 0:
+                break
+        assert res is not None and res.success
+        assert len(res.output_token_ids) == 6
+        assert len(res.output_token_logprobs) == 6
+        if dying.generate_calls and dying.shutdown_called.is_set():
+            # continuation path actually exercised: first 2 tokens from the
+            # dying engine (prompt len 2), remaining 4 from the healthy one
+            # with the extended prompt (len 4: 2 prompt + 2 generated)
+            assert res.output_token_ids[:2] == [1002, 1003]
+            assert res.output_token_ids[2:] == [1004, 1005, 1006, 1007]
+            # evicted instance is gone from the registry
+            st = manager.get_instances_status()
+            eps = [i["endpoint"] for i in st["instances"]]
+            assert dying.endpoint not in eps
+    finally:
+        dying.stop()
+        healthy.stop()
+
+
+def test_batch_generate_stream(manager):
+    eng = FakeEngine().start()
+    try:
+        manager.register_rollout_instance(eng.endpoint)
+        wait_active(manager, 1)
+        reqs = [{"rid": f"b{i}", "input_ids": [1] * (i + 1),
+                 "sampling_params": {"max_new_tokens": 3}} for i in range(4)]
+        results = list(manager.batch_generate_stream(reqs, max_local_gen_s=30))
+        assert len(results) == 4
+        assert all(r.success for r in results)
+        rids = sorted(r.rid for r in results)
+        assert rids == ["b0", "b1", "b2", "b3"]
+        for r in results:
+            assert len(r.output_token_ids) == 3
+    finally:
+        eng.stop()
+
+
+def test_weight_version_orchestration(manager):
+    """update_weight_version drains remotes; sender poll marks updating;
+    update_weights pushes to the engine and re-activates."""
+    eng = FakeEngine().start()
+    try:
+        manager.update_weight_senders(["127.0.0.1:19999"], groups_per_sender=2)
+        out = manager.register_rollout_instance(eng.endpoint)
+        assert out["weight_sender_endpoint"] == "127.0.0.1:19999"
+        time.sleep(0.5)  # health check promotes (stays out of active: sender set)
+
+        v = manager.update_weight_version()
+        assert v == 1
+        recv = manager.get_receive_instances()
+        eps = [i["endpoint"] for i in recv["instances"]]
+        assert eng.endpoint in eps
+        assert recv["weight_version"] == 1
+        # second poll: CAS prevents double-assignment
+        recv2 = manager.get_receive_instances()
+        assert [i for i in recv2["instances"]] == []
+
+        res = manager.update_weights([eng.endpoint], weight_version=1)
+        assert res["results"][0]["success"]
+        assert eng.weight_updates == [1]
+        st = manager.get_instances_status()
+        inst = [i for i in st["instances"] if i["endpoint"] == eng.endpoint][0]
+        assert inst["weight_version"] == 1
+        assert not inst["updating_weight"]
+        # now in the active pool → generate works
+        res = manager.generate("r3", [1], {"max_new_tokens": 2})
+        assert res.success
+    finally:
+        eng.stop()
+
+
+def test_local_instance_time_slicing(manager):
+    """Local instances leave the active pool after max_local_gen_s and get
+    an abort; batch still completes on the remote instance."""
+    slow_local = FakeEngine(token_delay_s=0.5, start_token=2000).start()
+    fast_remote = FakeEngine(start_token=3000).start()
+    try:
+        manager.register_local_rollout_instances([slow_local.endpoint])
+        manager.register_rollout_instance(fast_remote.endpoint)
+        wait_active(manager, 2)
+        reqs = [{"rid": f"t{i}", "input_ids": [1, 2],
+                 "sampling_params": {"max_new_tokens": 4}} for i in range(2)]
+        results = list(manager.batch_generate_stream(reqs, max_local_gen_s=1.0))
+        assert len(results) == 2
+        assert all(r.success for r in results)
+        # the local engine was told to abort
+        assert slow_local.aborted.wait(timeout=5)
+        # local engine no longer in active pool
+        st = manager.get_instances_status()
+        assert st["max_local_gen_s"] > 0
+    finally:
+        slow_local.stop()
+        fast_remote.stop()
+
+
+def test_update_metrics_balancer(manager):
+    # trainer bubble < remote bubble → window shrinks
+    out1 = manager.update_metrics(step_time_s=100.0, total_gen_time_s=40.0,
+                                  trainer_bubble_s=10.0, throughput=1000.0,
+                                  num_instances=2)
+    assert out1["max_local_gen_s"] < 150.0
+    # trainer bubble > remote bubble → window grows back
+    out2 = manager.update_metrics(step_time_s=100.0, total_gen_time_s=95.0,
+                                  trainer_bubble_s=50.0, throughput=1000.0,
+                                  num_instances=2)
+    assert out2["max_local_gen_s"] > out1["max_local_gen_s"]
+
+
+def test_unhealthy_instance_not_scheduled(manager):
+    eng = FakeEngine(healthy_after_s=3600).start()  # never healthy in test
+    try:
+        manager.register_rollout_instance(eng.endpoint)
+        time.sleep(0.5)
+        st = manager.get_instances_status()
+        inst = [i for i in st["instances"] if i["endpoint"] == eng.endpoint]
+        assert inst and not inst[0]["healthy"]
+    finally:
+        eng.stop()
+
+
+def test_shutdown_instances(manager):
+    eng = FakeEngine().start()
+    try:
+        manager.register_rollout_instance(eng.endpoint)
+        wait_active(manager, 1)
+        out = manager.shutdown_instances()
+        assert out["shutdown_count"] == 1
+        assert eng.shutdown_called.wait(timeout=5)
+    finally:
+        eng.stop()
