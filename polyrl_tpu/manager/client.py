@@ -117,6 +117,9 @@ class ManagerClient:
             payload["weight_version"] = weight_version
         return self._call("POST", "/update_weights", payload)
 
+    def abort_weight_update(self, instances: list[str]) -> dict:
+        return self._call("POST", "/abort_weight_update", {"instances": instances})
+
     def update_weight_senders(self, senders: list[str], groups_per_sender: int = 1) -> dict:
         return self._call("PUT", "/update_weight_senders",
                           {"senders": senders, "groups_per_sender": groups_per_sender})

@@ -374,6 +374,17 @@ void register_routes(phttp::Server& server, Manager& mgr) {
     rw.body = Value(std::move(top)).dump();
   });
 
+  server.route("POST", "/abort_weight_update",
+               [&](const phttp::Request& req, phttp::ResponseWriter& rw) {
+    // sender-side push failed (receiver missing / TCP error): clear the
+    // updating_weight CAS so the instance is retried on the next sender
+    // poll instead of being drained forever
+    Value body = pjson::Parser::parse(req.body);
+    for (const auto& epv : body["instances"].as_arr())
+      state.abort_weight_update(epv.as_str());
+    rw.body = "{\"status\":\"ok\"}";
+  });
+
   server.route("PUT", "/update_weight_senders",
                [&](const phttp::Request& req, phttp::ResponseWriter& rw) {
     Value body = pjson::Parser::parse(req.body);

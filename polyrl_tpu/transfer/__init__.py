@@ -1,0 +1,36 @@
+"""Weight-transfer fabric: trainer -> rollout weight sync.
+
+Layers (SURVEY §3.3):
+- ``layout``     — flat name->(shape,dtype,offset) buffer layout
+- ``tcp_engine`` — multi-stream TCP bulk transfer (cross-host / DCN)
+- ``agents``     — sender (trainer side) / receiver (rollout side) with a
+                   single JSON-over-TCP control channel
+- ``interface``  — trainer facade (pack + version + signal); colocated path
+                   is a ``device_put`` reshard
+"""
+
+from .agents import ReceiverAgent, SenderAgent
+from .interface import TransferInterface, colocated_update
+from .layout import (
+    ParamLayout,
+    alloc_buffer,
+    build_layout,
+    pack_params,
+    unflatten_like,
+    unpack_params,
+)
+from .tcp_engine import TcpTransferEngine
+
+__all__ = [
+    "ParamLayout",
+    "ReceiverAgent",
+    "SenderAgent",
+    "TcpTransferEngine",
+    "TransferInterface",
+    "alloc_buffer",
+    "build_layout",
+    "colocated_update",
+    "pack_params",
+    "unflatten_like",
+    "unpack_params",
+]

@@ -1,0 +1,392 @@
+"""Weight-transfer sender/receiver agents.
+
+TPU-native redesign of the reference's fabric (sender:
+rlboost/weight_transfer/sender_agent.py:163-693, receiver:
+receiver_agent.py:55-308). The reference bootstraps over RPyC and signals
+status over ZMQ; here both collapse into ONE newline-delimited-JSON TCP
+control channel (SURVEY §5.8 recommends collapsing the protocol diversity).
+
+Flow (mirrors §3.3 of the survey):
+- Receiver (inside each rollout server) allocates its buffer from the model
+  layout, starts N TCP listener streams, connects to its assigned sender's
+  control port and registers {instance, buffer_len, stream host/ports}.
+- Sender holds the packed flat weight buffer. Its event loop bumps the
+  version on trainer signal AND polls the manager every ``poll_s`` seconds
+  (pull model — enables late joiners, sender_agent.py:324-340):
+  /get_receive_instances -> stale instances -> parallel TCP fan-out ->
+  per-instance "transfer_done" on the control channel -> async
+  POST /update_weights so each instance rejoins the pool ASAP
+  (sender_agent.py:617-624).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import queue
+import socket
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from .layout import ParamLayout, alloc_buffer
+from .tcp_engine import ReceiverSockets, TcpTransferEngine
+
+log = logging.getLogger(__name__)
+
+
+def _send_json(sock: socket.socket, obj: dict) -> None:
+    sock.sendall((json.dumps(obj) + "\n").encode())
+
+
+class _LineReader:
+    def __init__(self, sock: socket.socket):
+        self._sock = sock
+        self._buf = b""
+
+    def read(self, timeout: float | None = None) -> dict | None:
+        self._sock.settimeout(timeout)
+        while b"\n" not in self._buf:
+            try:
+                chunk = self._sock.recv(65536)
+            except socket.timeout:
+                return None
+            if not chunk:
+                raise ConnectionError("control channel closed")
+            self._buf += chunk
+        line, self._buf = self._buf.split(b"\n", 1)
+        return json.loads(line)
+
+
+# --------------------------------------------------------------------------
+# Receiver
+# --------------------------------------------------------------------------
+
+
+class ReceiverAgent:
+    """Runs inside a rollout server; lands weight bytes into a host buffer.
+
+    Unlike the reference (mp.Process per TP-rank-0, receiver_agent.py:295),
+    this runs as a thread: ``recv_into`` releases the GIL, and the JAX server
+    is a single process per host — the buffer is handed to the engine via
+    ``unpack_params`` + ``device_put`` (the TPU analogue of the reference's
+    chunked host->GPU broadcast, patches.py:169-241).
+    """
+
+    def __init__(self, layout: ParamLayout, instance_endpoint: str,
+                 sender_endpoint: str, num_streams: int = 4,
+                 listen_host: str = "0.0.0.0", advertise_host: str | None = None):
+        self.layout = layout
+        self.buffer = alloc_buffer(layout)
+        self.instance_endpoint = instance_endpoint
+        self.sender_host, self.sender_port = _split(sender_endpoint)
+        self.sockets = ReceiverSockets(self.buffer, num_streams, listen_host)
+        self.advertise_host = advertise_host or "127.0.0.1"
+        self.version = -1
+        self._version_cv = threading.Condition()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self) -> None:
+        backoff = 0.2
+        while not self._stop.is_set():
+            try:
+                with socket.create_connection(
+                        (self.sender_host, self.sender_port), timeout=30.0) as s:
+                    backoff = 0.2
+                    _send_json(s, {
+                        "cmd": "register",
+                        "instance": self.instance_endpoint,
+                        "buffer_len": int(self.buffer.nbytes),
+                        "host": self.advertise_host,
+                        "ports": self.sockets.ports,
+                    })
+                    reader = _LineReader(s)
+                    while not self._stop.is_set():
+                        msg = reader.read(timeout=1.0)
+                        if msg is None:
+                            continue
+                        if msg.get("event") == "prepare":
+                            self.sockets.arm(int(msg["version"]))
+                            _send_json(s, {"event": "ready",
+                                           "instance": self.instance_endpoint})
+                        elif msg.get("event") == "transfer_done":
+                            if msg.get("status") != "success":
+                                log.error("transfer failed: %s", msg)
+                                continue
+                            self.sockets.wait(timeout=600.0)
+                            with self._version_cv:
+                                self.version = int(msg["version"])
+                                self._version_cv.notify_all()
+            except (OSError, ConnectionError) as exc:
+                if self._stop.is_set():
+                    return
+                log.warning("receiver control reconnect (%s)", exc)
+                time.sleep(backoff)
+                backoff = min(backoff * 2, 5.0)
+
+    def wait_for_version(self, version: int, timeout: float = 600.0) -> None:
+        """Block until weights of at least ``version`` are in the buffer
+        (the reference's 'receive_weights' wait, receiver_agent.py:257-268)."""
+        deadline = time.monotonic() + timeout
+        with self._version_cv:
+            while self.version < version:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    raise TimeoutError(
+                        f"weights v{version} not received (have v{self.version})")
+                self._version_cv.wait(left)
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.sockets.close()
+        if self._thread:
+            self._thread.join(timeout=5.0)
+
+
+# --------------------------------------------------------------------------
+# Sender
+# --------------------------------------------------------------------------
+
+
+@dataclass
+class _Registration:
+    instance: str
+    host: str
+    ports: list[int]
+    sock: socket.socket
+    lock: threading.Lock = field(default_factory=threading.Lock)
+    ready: threading.Event = field(default_factory=threading.Event)
+    pushed_version: int = -1
+
+
+class SenderAgent:
+    """Trainer-side transfer agent (thread; reference uses an mp.Process,
+    sender_agent.py:682-694 — a thread suffices since pack/send release the
+    GIL and lets the trainer overlap transfer with the next step)."""
+
+    def __init__(self, buffer: np.ndarray, manager_client=None,
+                 listen_host: str = "0.0.0.0", num_streams: int = 4,
+                 poll_s: float = 1.0, advertise_host: str | None = None):
+        self.buffer = buffer
+        self.manager = manager_client
+        self.engine = TcpTransferEngine(num_streams=num_streams)
+        self._notify_pool = ThreadPoolExecutor(max_workers=4)
+        self.poll_s = poll_s
+        self.reg_wait_s = 10.0
+        self.version = -1
+        self._regs: dict[str, _Registration] = {}
+        self._regs_lock = threading.Lock()
+        self._cmds: queue.Queue = queue.Queue()
+        self._stop = threading.Event()
+        self._buffer_lock = threading.Lock()  # held while trainer repacks
+        self._server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._server.bind((listen_host, 0))
+        self._server.listen(64)
+        self.control_port = self._server.getsockname()[1]
+        self.endpoint = f"{advertise_host or _advertise_ip()}:{self.control_port}"
+        self._threads: list[threading.Thread] = []
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> None:
+        for target in (self._accept_loop, self._event_loop):
+            t = threading.Thread(target=target, daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def stop(self) -> None:
+        self._stop.set()
+        try:
+            self._server.close()
+        except OSError:
+            pass
+        self.engine.shutdown()
+        self._notify_pool.shutdown(wait=False, cancel_futures=True)
+        for t in self._threads:
+            t.join(timeout=5.0)
+
+    # -- trainer API --------------------------------------------------------
+
+    def signal_update(self, version: int | None = None) -> int:
+        """Trainer signals new weights are packed; returns new version."""
+        self.version = version if version is not None else self.version + 1
+        self._cmds.put("update_weights")
+        return self.version
+
+    def wake(self) -> None:
+        """Kick the event loop (version/buffer already set under the lock)."""
+        self._cmds.put("update_weights")
+
+    def buffer_write_lock(self) -> threading.Lock:
+        return self._buffer_lock
+
+    # -- registration server ------------------------------------------------
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._server.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._handle_conn, args=(conn,),
+                             daemon=True).start()
+
+    def _handle_conn(self, conn: socket.socket) -> None:
+        reader = _LineReader(conn)
+        reg: _Registration | None = None
+        try:
+            while not self._stop.is_set():
+                msg = reader.read(timeout=1.0)
+                if msg is None:
+                    continue
+                if msg.get("cmd") == "register":
+                    if int(msg["buffer_len"]) != int(self.buffer.nbytes):
+                        _send_json(conn, {"event": "error",
+                                          "error": "buffer size mismatch"})
+                        return
+                    reg = _Registration(instance=msg["instance"],
+                                        host=msg["host"],
+                                        ports=list(msg["ports"]), sock=conn)
+                    with self._regs_lock:
+                        self._regs[reg.instance] = reg
+                    _send_json(conn, {"event": "registered",
+                                      "version": self.version})
+                    log.info("receiver registered: %s", reg.instance)
+                elif msg.get("event") == "ready" and reg is not None:
+                    reg.ready.set()
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            if reg is not None:
+                with self._regs_lock:
+                    if self._regs.get(reg.instance) is reg:
+                        del self._regs[reg.instance]
+
+    # -- event loop (pull model) --------------------------------------------
+
+    def _event_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self._cmds.get(timeout=self.poll_s)
+            except queue.Empty:
+                pass  # idle poll — late joiners (sender_agent.py:324-340)
+            if self._stop.is_set():
+                return
+            if self.version < 0:
+                continue
+            try:
+                self._check_and_update_receivers()
+            except Exception:  # noqa: BLE001 — keep the loop alive
+                log.exception("weight push round failed")
+
+    def _stale_instances(self, version: int) -> list[str]:
+        if self.manager is None:
+            with self._regs_lock:
+                return [i for i, r in self._regs.items()
+                        if r.pushed_version < version]
+        resp = self.manager.get_receive_instances(self.endpoint)
+        return [i["endpoint"] if isinstance(i, dict) else i
+                for i in resp.get("instances", [])]
+
+    def _wait_registration(self, instance: str) -> _Registration | None:
+        """Bootstrap race: the manager may hand us an instance whose receiver
+        hasn't connected yet (the reference's wait_for_receiver_registration,
+        sender_agent.py:342-351)."""
+        deadline = time.monotonic() + self.reg_wait_s
+        while time.monotonic() < deadline and not self._stop.is_set():
+            with self._regs_lock:
+                reg = self._regs.get(instance)
+            if reg is not None:
+                return reg
+            time.sleep(0.05)
+        return None
+
+    def _check_and_update_receivers(self) -> None:
+        # version is read under the buffer lock so a concurrent repack
+        # (version bump + pack, interface.py) can never interleave: we either
+        # see the old buffer with the old version or the new with the new.
+        with self._buffer_lock:
+            version = self.version
+            stale = self._stale_instances(version)
+            if not stale:
+                return
+            threads = [threading.Thread(target=self._push_instance,
+                                        args=(i, version), daemon=True)
+                       for i in stale]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+
+    def _abort_on_manager(self, instance: str) -> None:
+        """Clear the manager's updating_weight CAS so the instance is
+        retried next poll instead of being drained forever."""
+        if self.manager is not None:
+            self._notify_pool.submit(self.manager.abort_weight_update,
+                                     [instance])
+
+    def _push_instance(self, instance: str, version: int) -> None:
+        reg = self._wait_registration(instance)
+        if reg is None:
+            log.error("no receiver registration for %s; skipping push", instance)
+            self._abort_on_manager(instance)
+            return
+        self._push_one(reg, version)
+
+    def _push_one(self, reg: _Registration, version: int) -> None:
+        try:
+            with reg.lock:
+                reg.ready.clear()
+                _send_json(reg.sock, {"event": "prepare", "version": version})
+                if not reg.ready.wait(timeout=60.0):
+                    raise TimeoutError("receiver did not arm listeners")
+                t0 = time.monotonic()
+                batch = self.engine.transfer_submit_write(
+                    reg.host, reg.ports, self.buffer, round_id=version)
+                batch.result(timeout=600.0)
+                dt = time.monotonic() - t0
+                _send_json(reg.sock, {"event": "transfer_done",
+                                      "status": "success", "version": version})
+            reg.pushed_version = version
+            mbps = self.buffer.nbytes / max(dt, 1e-9) / 1e6
+            log.info("pushed v%d to %s: %.0f MB/s", version, reg.instance, mbps)
+            if self.manager is not None:
+                # async notify so the instance rejoins the pool without the
+                # trainer's next pack blocking on the engine's weight load
+                # (sender_agent.py:617-624)
+                self._notify_pool.submit(
+                    self.manager.update_weights, [reg.instance], version)
+        except Exception as exc:  # noqa: BLE001
+            log.error("push to %s failed: %s", reg.instance, exc)
+            self._abort_on_manager(reg.instance)
+            try:
+                _send_json(reg.sock, {"event": "transfer_done",
+                                      "status": "failure", "version": version,
+                                      "error": str(exc)})
+            except OSError:
+                pass
+
+
+def _split(endpoint: str) -> tuple[str, int]:
+    host, port = endpoint.rsplit(":", 1)
+    return host, int(port)
+
+
+def _advertise_ip() -> str:
+    try:
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        s.connect(("8.8.8.8", 80))
+        ip = s.getsockname()[0]
+        s.close()
+        return ip
+    except OSError:
+        return "127.0.0.1"

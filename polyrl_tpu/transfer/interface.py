@@ -1,0 +1,72 @@
+"""Trainer-side weight-transfer facade.
+
+TPU-native equivalent of the reference's FSDPInterface
+(rlboost/weight_transfer/fsdp_interface.py:47-233): computes the flat
+layout from the param pytree, owns the packed host buffer and the sender
+agent, and per update (a) bumps the manager's weight version (which
+atomically drains the active pool, fsdp_interface.py:80-95), (b) gathers
+params to host into the buffer, (c) signals the sender agent.
+
+Two paths:
+- ``TransferInterface`` — cross-host (DCN) push over the TCP fabric, for
+  disaggregated rollout pools.
+- ``colocated_update`` — in-slice reshard: ``jax.device_put`` with the
+  rollout mesh sharding (the TPU analogue of the reference's NCCL TP
+  broadcast, which disappears into GSPMD).
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Any
+
+from .agents import SenderAgent
+from .layout import ParamLayout, alloc_buffer, build_layout, pack_params
+
+log = logging.getLogger(__name__)
+
+
+class TransferInterface:
+    def __init__(self, params_template: Any, manager_client=None,
+                 num_streams: int = 4, poll_s: float = 1.0,
+                 advertise_host: str | None = None):
+        self.layout: ParamLayout = build_layout(params_template)
+        self.buffer = alloc_buffer(self.layout)
+        self.sender = SenderAgent(self.buffer, manager_client=manager_client,
+                                  num_streams=num_streams, poll_s=poll_s,
+                                  advertise_host=advertise_host)
+        self.manager = manager_client
+        self.sender.start()
+        if manager_client is not None:
+            manager_client.update_weight_senders([self.sender.endpoint])
+
+    def update_weights_with_agent(self, params: Any) -> int:
+        """Push new weights: version bump -> pack -> signal sender.
+
+        The manager version bump, the pack, and the sender's version are all
+        set under the sender's buffer lock: the poll loop reads (version,
+        buffer) under the same lock, so it can never pair the new version
+        with the old bytes or vice versa.
+        """
+        t0 = time.monotonic()
+        with self.sender.buffer_write_lock():
+            if self.manager is not None:
+                version = self.manager.update_weight_version()
+            else:
+                version = self.sender.version + 1
+            pack_params(params, self.layout, self.buffer)
+            self.sender.version = version
+        self.sender.wake()
+        log.info("packed weights v%d (%.0f MB) in %.2fs", version,
+                 self.buffer.nbytes / 1e6, time.monotonic() - t0)
+        return version
+
+    def close(self) -> None:
+        self.sender.stop()
+
+
+def colocated_update(engine, params: Any, version: int | None = None) -> None:
+    """In-process hand-off to a colocated rollout engine (device_put with the
+    engine's shardings — SURVEY §2.2: 'TP broadcast disappears into GSPMD')."""
+    engine.update_weights(params, version=version)

@@ -1,0 +1,135 @@
+"""Flat parameter layout: name -> (shape, dtype, offset) over one buffer.
+
+TPU-native equivalent of the reference's flat meta layout computed from the
+FSDP state dict (reference: rlboost/weight_transfer/fsdp_interface.py:141-154
+builds meta tensors; sender_agent.py:235-309 sizes one contiguous buffer).
+Here the source of truth is a JAX param pytree: we flatten it with tree
+paths, lay entries out contiguously (64-byte aligned so receivers can view
+slices as arrays cheaply), and pack/unpack through host numpy views.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass
+from typing import Any
+
+import jax
+import numpy as np
+
+ALIGN = 64
+
+
+def _dtype_name(dt) -> str:
+    return np.dtype(dt).name if not str(dt).startswith("bfloat16") else "bfloat16"
+
+
+def _np_dtype(name: str):
+    if name == "bfloat16":
+        import ml_dtypes
+
+        return np.dtype(ml_dtypes.bfloat16)
+    return np.dtype(name)
+
+
+def _path_str(path) -> str:
+    parts = []
+    for p in path:
+        if hasattr(p, "key"):
+            parts.append(str(p.key))
+        elif hasattr(p, "idx"):
+            parts.append(str(p.idx))
+        else:
+            parts.append(str(p))
+    return ".".join(parts)
+
+
+@dataclass(frozen=True)
+class Entry:
+    name: str
+    shape: tuple[int, ...]
+    dtype: str  # numpy dtype name, or "bfloat16"
+    offset: int
+    nbytes: int
+
+
+@dataclass(frozen=True)
+class ParamLayout:
+    entries: tuple[Entry, ...]
+    total_bytes: int
+
+    def by_name(self) -> dict[str, Entry]:
+        return {e.name: e for e in self.entries}
+
+    def to_json(self) -> str:
+        return json.dumps({
+            "total_bytes": self.total_bytes,
+            "entries": [
+                [e.name, list(e.shape), e.dtype, e.offset, e.nbytes]
+                for e in self.entries
+            ],
+        })
+
+    @staticmethod
+    def from_json(s: str) -> "ParamLayout":
+        d = json.loads(s)
+        entries = tuple(
+            Entry(n, tuple(sh), dt, off, nb) for n, sh, dt, off, nb in d["entries"]
+        )
+        return ParamLayout(entries, d["total_bytes"])
+
+
+def build_layout(params: Any) -> ParamLayout:
+    """Compute the flat layout from a pytree of arrays/ShapeDtypeStructs."""
+    leaves = jax.tree_util.tree_flatten_with_path(params)[0]
+    entries = []
+    offset = 0
+    for path, leaf in leaves:
+        name = _path_str(path)
+        shape = tuple(int(s) for s in leaf.shape)
+        dtype = _dtype_name(leaf.dtype)
+        nbytes = int(np.prod(shape, dtype=np.int64)) * _np_dtype(dtype).itemsize
+        entries.append(Entry(name, shape, dtype, offset, nbytes))
+        offset += (nbytes + ALIGN - 1) // ALIGN * ALIGN
+    return ParamLayout(tuple(entries), offset)
+
+
+def alloc_buffer(layout: ParamLayout) -> np.ndarray:
+    """One contiguous uint8 host buffer for the whole layout."""
+    return np.zeros(layout.total_bytes, dtype=np.uint8)
+
+
+def pack_params(params: Any, layout: ParamLayout, buffer: np.ndarray) -> None:
+    """Gather params to host and copy into the buffer at layout offsets.
+
+    Device->host transfers run via ``jax.device_get`` on the whole tree at
+    once (batched DMA), mirroring the reference's non-blocking GPU->shm copy
+    loop (fsdp_interface.py:186-207).
+    """
+    host = jax.device_get(params)
+    leaves = jax.tree_util.tree_flatten_with_path(host)[0]
+    by_name = layout.by_name()
+    for path, leaf in leaves:
+        e = by_name[_path_str(path)]
+        arr = np.asarray(leaf)
+        view = buffer[e.offset : e.offset + e.nbytes].view(_np_dtype(e.dtype))
+        view[:] = arr.reshape(-1)
+
+
+def unpack_params(buffer: np.ndarray, layout: ParamLayout) -> dict[str, np.ndarray]:
+    """Zero-copy views into the buffer, name -> ndarray."""
+    out = {}
+    for e in layout.entries:
+        out[e.name] = (
+            buffer[e.offset : e.offset + e.nbytes]
+            .view(_np_dtype(e.dtype))
+            .reshape(e.shape)
+        )
+    return out
+
+
+def unflatten_like(template: Any, named: dict[str, np.ndarray]) -> Any:
+    """Rebuild a pytree with ``template``'s structure from named arrays."""
+    paths, treedef = jax.tree_util.tree_flatten_with_path(template)
+    leaves = [named[_path_str(p)] for p, _ in paths]
+    return jax.tree_util.tree_unflatten(treedef, leaves)

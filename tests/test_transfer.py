@@ -1,0 +1,253 @@
+"""Weight-transfer fabric tests (SURVEY §4: 'the weight fabric runs on
+localhost sockets by design — exercised with two processes and a small
+tensor dict'; here sender/receiver run as threads in one process, the wire
+is real TCP)."""
+
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from polyrl_tpu.manager.client import ManagerClient, spawn_rollout_manager
+from polyrl_tpu.transfer import (
+    ReceiverAgent,
+    SenderAgent,
+    TcpTransferEngine,
+    TransferInterface,
+    build_layout,
+    pack_params,
+    unflatten_like,
+    unpack_params,
+)
+from polyrl_tpu.transfer.layout import ParamLayout, alloc_buffer
+from polyrl_tpu.transfer.tcp_engine import ReceiverSockets, split_ranges
+from tests.fake_engine import FakeEngine
+
+
+def small_params(seed=0):
+    k = jax.random.PRNGKey(seed)
+    ks = jax.random.split(k, 4)
+    return {
+        "embed": {"w": jax.random.normal(ks[0], (17, 8), jnp.float32)},
+        "layers": {
+            "0": {"wq": jax.random.normal(ks[1], (8, 8), jnp.bfloat16),
+                  "wk": jax.random.normal(ks[2], (8, 4), jnp.bfloat16)},
+        },
+        "norm": jax.random.normal(ks[3], (8,), jnp.float32),
+    }
+
+
+def assert_tree_equal(a, b):
+    fa = jax.tree_util.tree_leaves(a)
+    fb = jax.tree_util.tree_leaves(b)
+    assert len(fa) == len(fb)
+    for x, y in zip(fa, fb):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+# -- layout -----------------------------------------------------------------
+
+
+def test_layout_roundtrip():
+    params = small_params()
+    layout = build_layout(params)
+    assert layout.total_bytes % 64 == 0
+    buf = alloc_buffer(layout)
+    pack_params(params, layout, buf)
+    named = unpack_params(buf, layout)
+    rebuilt = unflatten_like(params, named)
+    assert_tree_equal(params, rebuilt)
+    # serialization roundtrip
+    l2 = ParamLayout.from_json(layout.to_json())
+    assert l2 == layout
+
+
+def test_layout_names_stable():
+    layout = build_layout(small_params())
+    names = [e.name for e in layout.entries]
+    assert "embed.w" in names and "layers.0.wq" in names and "norm" in names
+
+
+def test_split_ranges():
+    assert split_ranges(10, 3) == [(0, 4), (4, 3), (7, 3)]
+    assert split_ranges(2, 8) == [(0, 1), (1, 1)]  # only non-empty ranges
+    total = sum(ln for _, ln in split_ranges(1 << 20, 7))
+    assert total == 1 << 20
+
+
+# -- raw TCP engine ---------------------------------------------------------
+
+
+def test_tcp_engine_transfer():
+    rng = np.random.default_rng(0)
+    src = rng.integers(0, 256, 1 << 20, dtype=np.uint8)
+    dst = np.zeros_like(src)
+    rx = ReceiverSockets(dst, num_streams=4, host="127.0.0.1")
+    try:
+        rx.arm(1)
+        eng = TcpTransferEngine(num_streams=4)
+        batch = eng.transfer_submit_write("127.0.0.1", rx.ports, src, round_id=1)
+        batch.result(timeout=30.0)
+        rx.wait(timeout=30.0)
+        np.testing.assert_array_equal(src, dst)
+        # second round over the same persistent listeners
+        src2 = rng.integers(0, 256, 1 << 20, dtype=np.uint8)
+        rx.arm(2)
+        eng.transfer_submit_write("127.0.0.1", rx.ports, src2, round_id=2)
+        rx.wait(timeout=30.0)
+        np.testing.assert_array_equal(src2, dst)
+    finally:
+        rx.close()
+
+
+# -- sender/receiver agents (no manager) ------------------------------------
+
+
+def test_agents_direct_push():
+    params = small_params(1)
+    layout = build_layout(params)
+    buf = alloc_buffer(layout)
+    sender = SenderAgent(buf, manager_client=None, listen_host="127.0.0.1",
+                         num_streams=2, poll_s=0.1, advertise_host="127.0.0.1")
+    sender.start()
+    rx = ReceiverAgent(layout, "inst-1", sender.endpoint, num_streams=2,
+                       listen_host="127.0.0.1", advertise_host="127.0.0.1")
+    rx.start()
+    try:
+        with sender.buffer_write_lock():
+            pack_params(params, layout, buf)
+        v = sender.signal_update()
+        rx.wait_for_version(v, timeout=30.0)
+        got = unflatten_like(params, unpack_params(rx.buffer, rx.layout))
+        assert_tree_equal(params, got)
+
+        # second push with new weights reuses the same sockets
+        params2 = small_params(2)
+        with sender.buffer_write_lock():
+            pack_params(params2, layout, buf)
+        v2 = sender.signal_update()
+        rx.wait_for_version(v2, timeout=30.0)
+        got2 = unflatten_like(params2, unpack_params(rx.buffer, rx.layout))
+        assert_tree_equal(params2, got2)
+    finally:
+        rx.stop()
+        sender.stop()
+
+
+def test_receiver_buffer_size_mismatch_rejected():
+    layout = build_layout(small_params())
+    buf = alloc_buffer(layout)
+    sender = SenderAgent(buf, manager_client=None, listen_host="127.0.0.1",
+                         num_streams=1, poll_s=0.1, advertise_host="127.0.0.1")
+    sender.start()
+    bad_layout = build_layout({"x": jnp.zeros((3,), jnp.float32)})
+    rx = ReceiverAgent(bad_layout, "bad", sender.endpoint, num_streams=1,
+                       listen_host="127.0.0.1", advertise_host="127.0.0.1")
+    rx.start()
+    try:
+        time.sleep(0.5)
+        assert "bad" not in sender._regs
+    finally:
+        rx.stop()
+        sender.stop()
+
+
+# -- full orchestration through the C++ manager -----------------------------
+
+
+@pytest.fixture()
+def manager():
+    proc, port = spawn_rollout_manager(
+        "127.0.0.1:0",
+        extra_args=["--health-check-interval-s", "0.1",
+                    "--stats-poll-interval-s", "0.2"])
+    client = ManagerClient(f"127.0.0.1:{port}")
+    client.wait_healthy()
+    yield client
+    proc.kill()
+
+
+def test_push_failure_aborts_and_retries(manager):
+    """If the receiver isn't registered when the manager hands the instance
+    to the sender, the sender aborts the CAS (POST /abort_weight_update) so
+    the instance is retried on a later poll — not drained forever."""
+    params = small_params(4)
+    iface = TransferInterface(params, manager_client=manager,
+                              num_streams=2, poll_s=0.1,
+                              advertise_host="127.0.0.1")
+    iface.sender.reg_wait_s = 0.3
+    eng = FakeEngine().start()
+    rx = None
+    try:
+        out = manager.register_rollout_instance(eng.endpoint)
+        time.sleep(0.5)  # health check promotes
+        v = iface.update_weights_with_agent(params)  # no receiver yet -> fails
+        time.sleep(1.0)  # at least one failed push round (reg_wait 0.3s)
+        # without /abort_weight_update the CAS would stay set and the
+        # instance would never be returned by get_receive_instances again —
+        # the retry below would time out. The abort makes retries possible:
+        rx = ReceiverAgent(iface.layout, eng.endpoint,
+                           out["weight_sender_endpoint"], num_streams=2,
+                           listen_host="127.0.0.1", advertise_host="127.0.0.1")
+        rx.start()
+        rx.wait_for_version(v, timeout=30.0)
+        got = unflatten_like(params, unpack_params(rx.buffer, rx.layout))
+        assert_tree_equal(params, got)
+    finally:
+        if rx is not None:
+            rx.stop()
+        eng.stop()
+        iface.close()
+
+
+def test_end_to_end_weight_sync(manager):
+    """SURVEY §3.3 end to end: trainer packs -> version bump drains pool ->
+    sender polls /get_receive_instances -> TCP push -> manager
+    /update_weights -> instance notified -> rejoins active pool."""
+    params = small_params(3)
+    iface = TransferInterface(params, manager_client=manager,
+                              num_streams=2, poll_s=0.1,
+                              advertise_host="127.0.0.1")
+    eng = FakeEngine().start()
+    rx = None
+    try:
+        out = manager.register_rollout_instance(eng.endpoint)
+        assert out["weight_sender_endpoint"] == iface.sender.endpoint
+        # the rollout server would spawn its receiver on registration:
+        rx = ReceiverAgent(iface.layout, eng.endpoint,
+                           out["weight_sender_endpoint"], num_streams=2,
+                           listen_host="127.0.0.1", advertise_host="127.0.0.1")
+        rx.start()
+        time.sleep(0.5)  # health check promotes the instance
+
+        v = iface.update_weights_with_agent(params)
+        rx.wait_for_version(v, timeout=30.0)
+        got = unflatten_like(params, unpack_params(rx.buffer, rx.layout))
+        assert_tree_equal(params, got)
+
+        # manager notified the instance and re-activated it
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < 10.0:
+            if eng.weight_updates == [v]:
+                break
+            time.sleep(0.1)
+        assert eng.weight_updates == [v]
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < 10.0:
+            st = manager.get_instances_status()
+            inst = [i for i in st["instances"] if i["endpoint"] == eng.endpoint]
+            if inst and inst[0]["weight_version"] == v and not inst[0]["updating_weight"]:
+                break
+            time.sleep(0.1)
+        else:
+            raise AssertionError(f"instance never re-activated: {st}")
+        res = manager.generate("wr1", [1, 2], {"max_new_tokens": 2})
+        assert res.success
+    finally:
+        if rx is not None:
+            rx.stop()
+        eng.stop()
+        iface.close()
