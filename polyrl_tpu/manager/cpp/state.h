@@ -210,15 +210,20 @@ class AppState {
   }
 
   // Transfer finished: record version, re-insert into the active pool,
-  // wake blocked schedulers (handlers.rs:727-786).
+  // wake blocked schedulers (handlers.rs:727-786). Invariant: only an
+  // instance at the CURRENT version may re-enter the active pool — a push
+  // that raced with a newer update_weight_version stays drained and is
+  // re-pushed on the sender's next poll.
   void complete_weight_update(const std::string& endpoint, int64_t version) {
     std::lock_guard<std::mutex> g(mu_);
     auto it = instances_.find(endpoint);
     if (it == instances_.end()) return;
     it->second->weight_version = version;
     it->second->updating_weight = false;
-    active_.insert(endpoint);
-    cv_.notify_all();
+    if (version >= weight_version_) {
+      active_.insert(endpoint);
+      cv_.notify_all();
+    }
   }
 
   void abort_weight_update(const std::string& endpoint) {

@@ -1,0 +1,7 @@
+"""Rollout: inference engine + step decoder + HTTP serving layer."""
+
+from .engine import GenerationOutput, RolloutEngine
+from .sampling import SamplingParams
+from .stepper import StepDecoder
+
+__all__ = ["GenerationOutput", "RolloutEngine", "SamplingParams", "StepDecoder"]

@@ -25,6 +25,21 @@ class SamplingParams:
     max_new_tokens: int = 128
     stop_token_ids: tuple[int, ...] = ()
 
+    def group_key(self) -> tuple:
+        """Batching key: requests differing only in max_new_tokens can share
+        one compiled step fn (per-row budgets are a traced arg)."""
+        return (self.temperature, self.top_p, self.top_k, self.stop_token_ids)
+
+    @staticmethod
+    def from_dict(d: dict) -> "SamplingParams":
+        return SamplingParams(
+            temperature=float(d.get("temperature", 1.0)),
+            top_p=float(d.get("top_p", 1.0)),
+            top_k=int(d.get("top_k", 0)),
+            max_new_tokens=int(d.get("max_new_tokens", 128)),
+            stop_token_ids=tuple(d.get("stop_token_ids", ())),
+        )
+
 
 def apply_top_k(logits: jnp.ndarray, k: int) -> jnp.ndarray:
     if k <= 0:

@@ -1,0 +1,113 @@
+"""Rollout server launcher: ``python -m polyrl_tpu.rollout.serve``.
+
+TPU-native equivalent of the reference's rollout-node launch path
+(rlboost/sglang/launch_server.py:21-43 + patched_launch_server,
+patches.py:513-543): build the engine, register with the rollout manager
+(receiving the assigned weight-sender endpoint), spawn the weight-receiver
+agent, then serve until shutdown.
+
+The receiver's buffer layout is derived from THIS server's own model params
+— the same scheme as the reference, where the TpWorker builds meta tensors
+from its own model on bootstrap (patches.py:169-183); the sender validates
+compatibility via the buffer-length handshake.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import time
+
+log = logging.getLogger(__name__)
+
+
+def create_server(model: str, manager_endpoint: str | None = None,
+                  host: str = "0.0.0.0", port: int = 0,
+                  advertise_host: str = "127.0.0.1",
+                  dtype: str = "bfloat16", seed: int = 0,
+                  transfer_streams: int = 4,
+                  batch_buckets: tuple[int, ...] | None = None,
+                  prompt_buckets: tuple[int, ...] | None = None,
+                  is_local: bool = False,
+                  model_overrides: dict | None = None):
+    """Build engine + server, register with the manager, attach receiver."""
+    import jax
+    import jax.numpy as jnp
+
+    from polyrl_tpu.models import decoder
+    from polyrl_tpu.rollout.engine import RolloutEngine
+    from polyrl_tpu.rollout.server import RolloutServer
+
+    cfg = decoder.get_config(model, dtype=getattr(jnp, dtype),
+                             **(model_overrides or {}))
+    params = jax.jit(lambda: decoder.init_params(jax.random.PRNGKey(seed), cfg))()
+    kwargs = {}
+    if batch_buckets:
+        kwargs["batch_buckets"] = tuple(batch_buckets)
+    if prompt_buckets:
+        kwargs["prompt_buckets"] = tuple(prompt_buckets)
+    engine = RolloutEngine(cfg, params, pad_token_id=0,
+                           kv_cache_dtype=getattr(jnp, dtype), **kwargs)
+    server = RolloutServer(engine, host=host, port=port,
+                           advertise_host=advertise_host).start()
+
+    if manager_endpoint:
+        register_with_manager(server, manager_endpoint, is_local=is_local,
+                              transfer_streams=transfer_streams)
+    return server
+
+
+def register_with_manager(server, manager_endpoint: str,
+                          is_local: bool = False,
+                          transfer_streams: int = 4) -> None:
+    """POST /register_rollout_instance; spawn the receiver agent pointed at
+    the assigned weight sender (reference §3.2 startup flow)."""
+    from polyrl_tpu.manager.client import ManagerClient
+    from polyrl_tpu.transfer.agents import ReceiverAgent
+    from polyrl_tpu.transfer.layout import build_layout
+
+    client = ManagerClient(manager_endpoint)
+    if is_local:
+        client.register_local_rollout_instances([server.endpoint])
+        return
+    out = client.register_rollout_instance(server.endpoint)
+    sender_ep = out.get("weight_sender_endpoint") or ""
+    if sender_ep:
+        layout = build_layout(server.engine.params)
+        advertise = server.endpoint.rsplit(":", 1)[0]
+        server.receiver = ReceiverAgent(
+            layout, server.endpoint, sender_ep,
+            num_streams=transfer_streams, advertise_host=advertise)
+        server.receiver.start()
+        log.info("receiver agent attached to sender %s", sender_ep)
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(description="polyrl-tpu rollout server")
+    p.add_argument("--model", default="qwen3-1.7b")
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=30000)
+    p.add_argument("--advertise-host", default="127.0.0.1")
+    p.add_argument("--manager-endpoint", default=None,
+                   help="host:port of the rollout manager")
+    p.add_argument("--dtype", default="bfloat16")
+    p.add_argument("--is-local", action="store_true",
+                   help="register as a colocated (time-sliced) instance")
+    p.add_argument("--transfer-streams", type=int, default=4)
+    args = p.parse_args()
+
+    logging.basicConfig(level=logging.INFO)
+    server = create_server(args.model, args.manager_endpoint, host=args.host,
+                           port=args.port, advertise_host=args.advertise_host,
+                           dtype=args.dtype, is_local=args.is_local,
+                           transfer_streams=args.transfer_streams)
+    log.info("rollout server on %s", server.endpoint)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        server.stop()
+
+
+if __name__ == "__main__":
+    main()

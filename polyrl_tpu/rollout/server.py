@@ -1,0 +1,313 @@
+"""HTTP rollout server: the TPU-native replacement for the reference's
+patched SGLang server (SURVEY §2.2 L2 surface; launch path §3.2).
+
+Speaks exactly the protocol the C++ manager consumes:
+- POST /generate                 — streaming NDJSON, one line per token with
+                                   token_ids + logprobs + finish_reason
+                                   (reference handlers.rs:152-328)
+- GET  /health, /health_generate — registration-time health gate
+                                   (instance_manager.rs:5-37)
+- GET  /get_server_info          — queue-depth + throughput telemetry
+                                   (patches.py:423-425)
+- POST /abort_request            — mid-decode abort (local time-slicing,
+                                   handlers.rs:500-513)
+- POST /update_weights_from_agent— load pushed weights from the receiver
+                                   buffer into the live engine
+                                   (patches.py:137-357)
+- POST /release|resume_memory_occupation, /flush_cache, /shutdown
+
+Serving model: requests land in an admission queue; a batching loop groups
+compatible requests (same sampling group) into bucketed batches and drives
+``StepDecoder.generate_stream``, fanning tokens out to per-request queues —
+a continuous-batching-lite scheduler (full paged/continuous batching is the
+planned upgrade, SURVEY §7 step 2).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import queue
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any
+
+import jax
+import numpy as np
+
+from polyrl_tpu.rollout.sampling import SamplingParams
+from polyrl_tpu.rollout.stepper import StepDecoder
+
+log = logging.getLogger(__name__)
+
+_SENTINEL = object()
+
+
+@dataclasses.dataclass
+class _PendingRequest:
+    rid: str
+    input_ids: list[int]
+    sampling: SamplingParams
+    out: queue.Queue
+    abort: threading.Event
+
+
+class RolloutServer:
+    """Wraps a RolloutEngine + StepDecoder behind the manager protocol."""
+
+    def __init__(self, engine, host: str = "0.0.0.0", port: int = 0,
+                 max_batch: int | None = None, batch_wait_s: float = 0.01,
+                 advertise_host: str = "127.0.0.1"):
+        self.engine = engine
+        self.stepper = StepDecoder(engine)
+        self.max_batch = max_batch or max(engine.batch_buckets)
+        self.batch_wait_s = batch_wait_s
+        self._queue: "queue.Queue[_PendingRequest]" = queue.Queue()
+        self._aborts: dict[str, threading.Event] = {}
+        self._aborts_lock = threading.Lock()
+        self._stop = threading.Event()
+        self._paused = threading.Event()  # release_memory_occupation
+        self.receiver = None  # ReceiverAgent, attached by serve.py
+        self._weight_lock = threading.Lock()
+        self._loop_thread: threading.Thread | None = None
+
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def _json(self, code: int, obj: dict) -> None:
+                body = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path in ("/health", "/health_generate"):
+                    self._json(200, {"status": "ok"})
+                elif self.path == "/get_server_info":
+                    self._json(200, outer.server_info())
+                else:
+                    self._json(404, {"error": f"no route {self.path}"})
+
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length", 0))
+                body = json.loads(self.rfile.read(length) or b"{}")
+                if self.path == "/generate":
+                    self.handle_generate(body)
+                elif self.path == "/update_weights_from_agent":
+                    ok, err = outer.update_weights_from_agent(
+                        int(body.get("weight_version", -1)))
+                    self._json(200 if ok else 500,
+                               {"success": ok, "error": err})
+                elif self.path == "/abort_request":
+                    outer.abort_request(body.get("rid"))
+                    self._json(200, {"success": True})
+                elif self.path == "/flush_cache":
+                    self._json(200, {"success": True})
+                elif self.path == "/release_memory_occupation":
+                    outer.release_memory()
+                    self._json(200, {"success": True})
+                elif self.path == "/resume_memory_occupation":
+                    outer.resume_memory()
+                    self._json(200, {"success": True})
+                elif self.path == "/shutdown":
+                    self._json(200, {"success": True})
+                    threading.Thread(target=outer.stop, daemon=True).start()
+                else:
+                    self._json(404, {"error": f"no route {self.path}"})
+
+            def handle_generate(self, body: dict) -> None:
+                rid = str(body.get("rid", f"req-{time.monotonic_ns()}"))
+                input_ids = [int(t) for t in body.get("input_ids", [])]
+                sp = SamplingParams.from_dict(body.get("sampling_params", {}))
+                out_q = outer.submit(rid, input_ids, sp)
+
+                self.send_response(200)
+                self.send_header("Content-Type", "application/x-ndjson")
+                self.send_header("Transfer-Encoding", "chunked")
+                self.end_headers()
+
+                def chunk(line: str) -> None:
+                    data = line.encode()
+                    self.wfile.write(f"{len(data):x}\r\n".encode() + data + b"\r\n")
+                    self.wfile.flush()
+
+                try:
+                    while True:
+                        item = out_q.get()
+                        if item is _SENTINEL:
+                            break
+                        chunk(json.dumps(item) + "\n")
+                    self.wfile.write(b"0\r\n\r\n")
+                except (BrokenPipeError, ConnectionResetError):
+                    outer.abort_request(rid)
+                finally:
+                    outer._drop_abort(rid)
+
+        self._http = ThreadingHTTPServer((host, port), Handler)
+        self.port = self._http.server_address[1]
+        self.endpoint = f"{advertise_host}:{self.port}"
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self) -> "RolloutServer":
+        self._loop_thread = threading.Thread(target=self._batch_loop, daemon=True)
+        self._loop_thread.start()
+        threading.Thread(target=self._http.serve_forever, daemon=True).start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self.receiver is not None:
+            self.receiver.stop()
+        self._http.shutdown()
+
+    # -- request admission & batching loop ----------------------------------
+
+    def submit(self, rid: str, input_ids: list[int],
+               sp: SamplingParams) -> queue.Queue:
+        out: queue.Queue = queue.Queue()
+        abort = threading.Event()
+        with self._aborts_lock:
+            self._aborts[rid] = abort
+        self._queue.put(_PendingRequest(rid, input_ids, sp, out, abort))
+        return out
+
+    def abort_request(self, rid: str | None) -> None:
+        """Abort one request, or ALL running requests when rid is None/'' —
+        the manager's local time-slice abort (handlers.rs:500-513)."""
+        with self._aborts_lock:
+            if rid:
+                ev = self._aborts.get(rid)
+                if ev is not None:
+                    ev.set()
+            else:
+                for ev in self._aborts.values():
+                    ev.set()
+
+    def _drop_abort(self, rid: str) -> None:
+        with self._aborts_lock:
+            self._aborts.pop(rid, None)
+
+    def _batch_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                first = self._queue.get(timeout=0.2)
+            except queue.Empty:
+                continue
+            if self._paused.is_set():
+                # engine yielded HBM to the trainer: finish aborted, requeue
+                self._queue.put(first)
+                time.sleep(0.05)
+                continue
+            batch = [first]
+            deadline = time.monotonic() + self.batch_wait_s
+            key = first.sampling.group_key()
+            leftover: list[_PendingRequest] = []
+            while len(batch) < self.max_batch:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    break
+                try:
+                    req = self._queue.get(timeout=left)
+                except queue.Empty:
+                    break
+                if req.sampling.group_key() == key:
+                    batch.append(req)
+                else:
+                    leftover.append(req)
+            for req in leftover:
+                self._queue.put(req)
+            self.engine.num_queued = self._queue.qsize()
+            try:
+                self._run_batch(batch)
+            except Exception as exc:  # noqa: BLE001 — fail the whole batch
+                log.exception("batch failed")
+                for req in batch:
+                    req.out.put({"token_ids": [], "logprobs": [],
+                                 "finished": True, "finish_reason": "error",
+                                 "error": str(exc)})
+                    req.out.put(_SENTINEL)
+
+    def _run_batch(self, batch: list[_PendingRequest]) -> None:
+        t0 = time.monotonic()
+        self.engine.num_running = len(batch)
+        prompts = [r.input_ids for r in batch]
+        limits = [r.sampling.max_new_tokens for r in batch]
+        flags = [r.abort for r in batch]
+        total = 0
+        with self._weight_lock:
+            stream = self.stepper.generate_stream(
+                prompts, batch[0].sampling, max_new=limits, abort_flags=flags)
+            for ev in stream:
+                req = batch[ev["row"]]
+                if ev["token"] is None:  # abort without a token this step
+                    req.out.put({"token_ids": [], "logprobs": [],
+                                 "finished": True, "finish_reason": "abort"})
+                else:
+                    total += 1
+                    req.out.put({
+                        "token_ids": [ev["token"]],
+                        "logprobs": [ev["logprob"]],
+                        "finished": ev["done"],
+                        "finish_reason": ev["finish_reason"],
+                    })
+                if ev["done"]:
+                    req.out.put(_SENTINEL)
+        dt = time.monotonic() - t0
+        self.engine.last_gen_throughput = total / dt if dt > 0 else 0.0
+        self.engine.num_running = 0
+
+    # -- telemetry / weights / memory ---------------------------------------
+
+    def server_info(self) -> dict:
+        return {
+            "num_running_reqs": self.engine.num_running,
+            "num_queued_reqs": self._queue.qsize(),
+            "last_gen_throughput": self.engine.last_gen_throughput,
+            "weight_version": self.engine.weight_version,
+        }
+
+    def update_weights_from_agent(self, version: int) -> tuple[bool, str]:
+        """Load weights v``version`` from the receiver buffer into the live
+        engine (TPU analogue of the reference's chunked host->GPU broadcast
+        load, patches.py:169-241: here one sharded device_put, GSPMD handles
+        distribution)."""
+        if self.receiver is None:
+            # in-process updates (colocated): trainer calls
+            # engine.update_weights directly; just ack the version
+            self.engine.weight_version = version
+            return True, ""
+        try:
+            from polyrl_tpu.transfer.layout import unflatten_like, unpack_params
+
+            self.receiver.wait_for_version(version, timeout=600.0)
+            named = unpack_params(self.receiver.buffer, self.receiver.layout)
+            new_params = unflatten_like(self.engine.params, named)
+            with self._weight_lock:  # not mid-batch
+                old = self.engine.params
+                self.engine.params = jax.tree_util.tree_map(
+                    lambda o, n: jax.device_put(
+                        np.asarray(n).astype(o.dtype), o.sharding), old,
+                    new_params)
+                self.engine.weight_version = version
+            return True, ""
+        except Exception as exc:  # noqa: BLE001
+            log.exception("weight load failed")
+            return False, str(exc)
+
+    def release_memory(self) -> None:
+        self._paused.set()
+        self.engine.release_memory()
+
+    def resume_memory(self) -> None:
+        self.engine.resume_memory()
+        self._paused.clear()

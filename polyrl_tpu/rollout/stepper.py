@@ -1,0 +1,173 @@
+"""Step-wise decode: jitted prefill + one-token decode step.
+
+The fused ``RolloutEngine.generate`` while-loop is the throughput path; this
+stepper is the SERVING path — the host drives one jitted step per token so
+the HTTP server can stream ``output_token_logprobs`` as they are produced,
+honor mid-decode aborts, and let the manager's token-level continuation see
+partial outputs (reference: SGLang's streaming /generate consumed at
+handlers.rs:215-251; abort_request at sglang_http_async_engine.py:286-298).
+
+Shape discipline: one compiled (prefill, step) pair per
+(batch_bucket, prompt_bucket, new_bucket, sampling-group); the KV cache is
+sized pb + nb and written at a traced index, so every step reuses the same
+executable.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from polyrl_tpu.models import decoder
+from polyrl_tpu.rollout.engine import next_bucket
+from polyrl_tpu.rollout.sampling import SamplingParams, sample_token
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class StepState:
+    """Device-resident decode state between steps (a pytree, so it flows
+    through jit boundaries and donation)."""
+
+    step: jax.Array          # scalar int32
+    done: jax.Array          # [bb] bool
+    last_logits: jax.Array   # [bb, V]
+    cache: Any
+    cache_mask: jax.Array    # [bb, pb+nb]
+    prompt_len: jax.Array    # [bb] int32
+    rng: jax.Array
+
+
+class StepDecoder:
+    def __init__(self, engine, new_buckets: tuple[int, ...] = (64, 128, 256, 512,
+                                                              1024, 2048, 4096)):
+        self.engine = engine
+        self.cfg = engine.cfg
+        self.new_buckets = new_buckets
+        self._prefill: dict = {}
+        self._step: dict = {}
+
+    # -- compiled pieces ----------------------------------------------------
+
+    def _build_prefill(self, bb: int, pb: int, nb: int):
+        cfg = self.cfg
+        kv_dtype = self.engine.kv_cache_dtype
+        max_total = pb + nb
+
+        def prefill(params, ids, mask, rng):
+            positions = jnp.maximum(jnp.cumsum(mask, axis=-1) - 1, 0).astype(jnp.int32)
+            cache = decoder.make_cache(cfg, bb, max_total, dtype=kv_dtype)
+            cache_mask = jnp.concatenate(
+                [mask, jnp.zeros((bb, nb), mask.dtype)], axis=-1)
+            logits, cache = decoder.forward(
+                params, cfg, ids, positions, cache_mask, cache=cache, write_idx=0)
+            prompt_len = jnp.sum(mask, axis=-1).astype(jnp.int32)
+            done = prompt_len == 0  # batch-padding rows start done
+            return StepState(jnp.int32(0), done, logits[:, -1, :], cache,
+                             cache_mask, prompt_len, rng)
+
+        return jax.jit(prefill)
+
+    def _build_step(self, bb: int, pb: int, nb: int, sp: SamplingParams):
+        pad = self.engine.pad_token_id
+        cfg = self.cfg
+        stop_ids = jnp.asarray(sp.stop_token_ids or (-1,), dtype=jnp.int32)
+
+        def step(params, st: StepState, abort_mask, row_limit):
+            rng, sub = jax.random.split(st.rng)
+            done = st.done | abort_mask
+            token, logp = sample_token(st.last_logits, sub, sp)
+            token = jnp.where(done, pad, token)
+            logp = jnp.where(done, 0.0, logp)
+            hit_stop = jnp.any(token[:, None] == stop_ids[None, :], axis=-1)
+            new_done = done | hit_stop | (st.step + 1 >= row_limit)
+
+            write_idx = pb + st.step
+            cache_mask = jax.lax.dynamic_update_slice(
+                st.cache_mask,
+                jnp.where(done, 0.0, 1.0).astype(st.cache_mask.dtype)[:, None],
+                (0, write_idx))
+            pos = (st.prompt_len + st.step)[:, None]
+            step_logits, cache = decoder.forward(
+                params, cfg, token[:, None], pos, cache_mask,
+                cache=st.cache, write_idx=write_idx)
+            new_state = StepState(st.step + 1, new_done, step_logits[:, 0, :],
+                                  cache, cache_mask, st.prompt_len, rng)
+            return new_state, token, logp, new_done
+
+        return jax.jit(step, donate_argnums=(1,))
+
+    # -- host-driven streaming generate ------------------------------------
+
+    def generate_stream(self, prompt_ids: list[list[int]],
+                        sampling: SamplingParams,
+                        max_new: list[int] | None = None,
+                        rng: jax.Array | None = None,
+                        abort_flags: list | None = None):
+        """Yields per-step dicts {row, token, logprob, done, finish_reason}.
+
+        ``max_new`` allows per-row budgets (continuation shrinks
+        max_new_tokens — utils.rs:256-291); ``abort_flags`` is a list of
+        ``threading.Event``-likes checked between steps.
+        """
+        n = len(prompt_ids)
+        bb = next_bucket(n, self.engine.batch_buckets)
+        pb = next_bucket(max(len(p) for p in prompt_ids), self.engine.prompt_buckets)
+        limits = max_new if max_new is not None else [sampling.max_new_tokens] * n
+        nb = next_bucket(max(limits), self.new_buckets)
+
+        ids = np.full((bb, pb), self.engine.pad_token_id, np.int32)
+        mask = np.zeros((bb, pb), np.float32)
+        for i, p in enumerate(prompt_ids):
+            ids[i, pb - len(p):] = np.asarray(p, np.int32)
+            mask[i, pb - len(p):] = 1.0
+        row_limit = np.zeros((bb,), np.int32)
+        row_limit[:n] = np.asarray(limits, np.int32)
+
+        pkey = (bb, pb, nb)
+        if pkey not in self._prefill:
+            self._prefill[pkey] = self._build_prefill(bb, pb, nb)
+        skey = (bb, pb, nb, sampling.group_key())
+        if skey not in self._step:
+            self._step[skey] = self._build_step(bb, pb, nb, sampling)
+
+        rng = rng if rng is not None else jax.random.PRNGKey(
+            np.random.randint(0, 2**31 - 1))
+        state = self._prefill[pkey](self.engine.params, ids, mask, rng)
+        row_limit_dev = jnp.asarray(row_limit)
+
+        prev_done = np.zeros((bb,), bool)
+        prev_done[n:] = True
+        stop_set = set(sampling.stop_token_ids)
+        max_steps = int(max(limits))
+        for _ in range(max_steps):
+            abort = np.zeros((bb,), bool)
+            if abort_flags is not None:
+                for i in range(n):
+                    if abort_flags[i] is not None and abort_flags[i].is_set():
+                        abort[i] = True
+            state, token, logp, done = self._step[skey](
+                self.engine.params, state, jnp.asarray(abort), row_limit_dev)
+            token_h, logp_h, done_h = (np.asarray(token), np.asarray(logp),
+                                       np.asarray(done))
+            for i in range(n):
+                if prev_done[i]:
+                    continue
+                if abort[i]:
+                    yield {"row": i, "token": None, "logprob": None,
+                           "done": True, "finish_reason": "abort"}
+                    continue
+                t = int(token_h[i])
+                fin = bool(done_h[i])
+                reason = ""
+                if fin:
+                    reason = "stop" if t in stop_set else "length"
+                yield {"row": i, "token": t, "logprob": float(logp_h[i]),
+                       "done": fin, "finish_reason": reason}
+            prev_done = done_h | abort
+            if prev_done.all():
+                break

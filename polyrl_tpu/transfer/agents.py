@@ -86,6 +86,7 @@ class ReceiverAgent:
         self.sockets = ReceiverSockets(self.buffer, num_streams, listen_host)
         self.advertise_host = advertise_host or "127.0.0.1"
         self.version = -1
+        self.error: str | None = None
         self._version_cv = threading.Condition()
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
@@ -114,7 +115,7 @@ class ReceiverAgent:
                         if msg is None:
                             continue
                         if msg.get("event") == "prepare":
-                            self.sockets.arm(int(msg["version"]))
+                            self.sockets.arm(int(msg["round"]))
                             _send_json(s, {"event": "ready",
                                            "instance": self.instance_endpoint})
                         elif msg.get("event") == "transfer_done":
@@ -125,6 +126,13 @@ class ReceiverAgent:
                             with self._version_cv:
                                 self.version = int(msg["version"])
                                 self._version_cv.notify_all()
+                        elif msg.get("event") == "error":
+                            # permanent rejection (e.g. layout/buffer-size
+                            # mismatch): surface loudly, stop retrying
+                            self.error = str(msg.get("error", "unknown"))
+                            log.error("sender rejected registration: %s",
+                                      self.error)
+                            return
             except (OSError, ConnectionError) as exc:
                 if self._stop.is_set():
                     return
@@ -138,11 +146,14 @@ class ReceiverAgent:
         deadline = time.monotonic() + timeout
         with self._version_cv:
             while self.version < version:
+                if self.error is not None:
+                    raise ConnectionError(
+                        f"receiver registration rejected: {self.error}")
                 left = deadline - time.monotonic()
                 if left <= 0:
                     raise TimeoutError(
                         f"weights v{version} not received (have v{self.version})")
-                self._version_cv.wait(left)
+                self._version_cv.wait(min(left, 1.0))
 
     def stop(self) -> None:
         self._stop.set()
@@ -186,7 +197,15 @@ class SenderAgent:
         self._regs_lock = threading.Lock()
         self._cmds: queue.Queue = queue.Queue()
         self._stop = threading.Event()
-        self._buffer_lock = threading.Lock()  # held while trainer repacks
+        # (buffer, version) pairing protocol: a push round snapshots both
+        # under _cv with _inflight+=1; a swap/pack waits for _inflight==0.
+        # Packing into a DIFFERENT (back) buffer overlaps with in-flight
+        # rounds — only the pointer swap synchronizes (the reference gets
+        # this overlap from its agent process, sender_agent.py:682-694).
+        self._cv = threading.Condition()
+        self._inflight = 0
+        self._packing = False
+        self._round_counter = 0  # unique per push attempt (stale-stream guard)
         self._server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._server.bind((listen_host, 0))
@@ -217,17 +236,46 @@ class SenderAgent:
     # -- trainer API --------------------------------------------------------
 
     def signal_update(self, version: int | None = None) -> int:
-        """Trainer signals new weights are packed; returns new version."""
-        self.version = version if version is not None else self.version + 1
+        """Trainer signals new weights are packed (in-place into
+        ``self.buffer``); returns the new version."""
+        with self._cv:
+            while self._inflight > 0:
+                self._cv.wait()
+            self.version = version if version is not None else self.version + 1
+            v = self.version
         self._cmds.put("update_weights")
-        return self.version
+        return v
 
-    def wake(self) -> None:
-        """Kick the event loop (version/buffer already set under the lock)."""
+    def swap_buffer(self, new_buffer: np.ndarray, version: int) -> np.ndarray:
+        """Atomically install a freshly packed buffer; returns the old one
+        (double-buffering: the caller packs the next update into it)."""
+        with self._cv:
+            while self._inflight > 0:
+                self._cv.wait()
+            old, self.buffer = self.buffer, new_buffer
+            self.version = version
         self._cmds.put("update_weights")
+        return old
 
-    def buffer_write_lock(self) -> threading.Lock:
-        return self._buffer_lock
+    class _PackGuard:
+        def __init__(self, sender: "SenderAgent"):
+            self._s = sender
+
+        def __enter__(self):
+            with self._s._cv:
+                while self._s._inflight > 0 or self._s._packing:
+                    self._s._cv.wait()
+                self._s._packing = True
+
+        def __exit__(self, *exc):
+            with self._s._cv:
+                self._s._packing = False
+                self._s._cv.notify_all()
+
+    def buffer_write_lock(self) -> "_PackGuard":
+        """Guard for packing in place into ``self.buffer`` (direct mode);
+        blocks while a push round is in flight and vice versa."""
+        return SenderAgent._PackGuard(self)
 
     # -- registration server ------------------------------------------------
 
@@ -311,21 +359,30 @@ class SenderAgent:
         return None
 
     def _check_and_update_receivers(self) -> None:
-        # version is read under the buffer lock so a concurrent repack
-        # (version bump + pack, interface.py) can never interleave: we either
-        # see the old buffer with the old version or the new with the new.
-        with self._buffer_lock:
+        # snapshot (buffer, version) atomically; the round holds an inflight
+        # ref so swaps/packs wait, but packing the BACK buffer proceeds in
+        # parallel with the sends.
+        with self._cv:
+            while self._packing:
+                self._cv.wait()
             version = self.version
+            buffer = self.buffer
+            self._inflight += 1
+        try:
             stale = self._stale_instances(version)
             if not stale:
                 return
             threads = [threading.Thread(target=self._push_instance,
-                                        args=(i, version), daemon=True)
+                                        args=(i, version, buffer), daemon=True)
                        for i in stale]
             for t in threads:
                 t.start()
             for t in threads:
                 t.join()
+        finally:
+            with self._cv:
+                self._inflight -= 1
+                self._cv.notify_all()
 
     def _abort_on_manager(self, instance: str) -> None:
         """Clear the manager's updating_weight CAS so the instance is
@@ -334,30 +391,36 @@ class SenderAgent:
             self._notify_pool.submit(self.manager.abort_weight_update,
                                      [instance])
 
-    def _push_instance(self, instance: str, version: int) -> None:
+    def _push_instance(self, instance: str, version: int,
+                       buffer: np.ndarray) -> None:
         reg = self._wait_registration(instance)
         if reg is None:
             log.error("no receiver registration for %s; skipping push", instance)
             self._abort_on_manager(instance)
             return
-        self._push_one(reg, version)
+        self._push_one(reg, version, buffer)
 
-    def _push_one(self, reg: _Registration, version: int) -> None:
+    def _push_one(self, reg: _Registration, version: int,
+                  buffer: np.ndarray) -> None:
+        with self._cv:
+            self._round_counter += 1
+            round_id = self._round_counter
         try:
             with reg.lock:
                 reg.ready.clear()
-                _send_json(reg.sock, {"event": "prepare", "version": version})
+                _send_json(reg.sock, {"event": "prepare", "version": version,
+                                      "round": round_id})
                 if not reg.ready.wait(timeout=60.0):
                     raise TimeoutError("receiver did not arm listeners")
                 t0 = time.monotonic()
                 batch = self.engine.transfer_submit_write(
-                    reg.host, reg.ports, self.buffer, round_id=version)
+                    reg.host, reg.ports, buffer, round_id=round_id)
                 batch.result(timeout=600.0)
                 dt = time.monotonic() - t0
                 _send_json(reg.sock, {"event": "transfer_done",
                                       "status": "success", "version": version})
             reg.pushed_version = version
-            mbps = self.buffer.nbytes / max(dt, 1e-9) / 1e6
+            mbps = buffer.nbytes / max(dt, 1e-9) / 1e6
             log.info("pushed v%d to %s: %.0f MB/s", version, reg.instance, mbps)
             if self.manager is not None:
                 # async notify so the instance rejoins the pool without the

@@ -32,8 +32,11 @@ class TransferInterface:
                  num_streams: int = 4, poll_s: float = 1.0,
                  advertise_host: str | None = None):
         self.layout: ParamLayout = build_layout(params_template)
-        self.buffer = alloc_buffer(self.layout)
-        self.sender = SenderAgent(self.buffer, manager_client=manager_client,
+        # double buffer: pack into _back while the sender pushes from its
+        # front buffer; only the pointer swap synchronizes
+        self._back = alloc_buffer(self.layout)
+        self.sender = SenderAgent(alloc_buffer(self.layout),
+                                  manager_client=manager_client,
                                   num_streams=num_streams, poll_s=poll_s,
                                   advertise_host=advertise_host)
         self.manager = manager_client
@@ -42,24 +45,25 @@ class TransferInterface:
             manager_client.update_weight_senders([self.sender.endpoint])
 
     def update_weights_with_agent(self, params: Any) -> int:
-        """Push new weights: version bump -> pack -> signal sender.
+        """Push new weights: pack (overlapped) -> version bump -> swap.
 
-        The manager version bump, the pack, and the sender's version are all
-        set under the sender's buffer lock: the poll loop reads (version,
-        buffer) under the same lock, so it can never pair the new version
-        with the old bytes or vice versa.
+        The pack lands in the back buffer and overlaps any in-flight push
+        round; the manager version bump drains the active pool
+        (fsdp_interface.py:80-95); the atomic swap installs the new
+        (buffer, version) pair — the sender's poll loop snapshots both
+        together, and the manager only re-activates instances that reach the
+        CURRENT version, so a racing old-version push can never leave an
+        instance serving stale weights.
         """
         t0 = time.monotonic()
-        with self.sender.buffer_write_lock():
-            if self.manager is not None:
-                version = self.manager.update_weight_version()
-            else:
-                version = self.sender.version + 1
-            pack_params(params, self.layout, self.buffer)
-            self.sender.version = version
-        self.sender.wake()
+        pack_params(params, self.layout, self._back)
+        if self.manager is not None:
+            version = self.manager.update_weight_version()
+        else:
+            version = self.sender.version + 1
+        self._back = self.sender.swap_buffer(self._back, version)
         log.info("packed weights v%d (%.0f MB) in %.2fs", version,
-                 self.buffer.nbytes / 1e6, time.monotonic() - t0)
+                 self._back.nbytes / 1e6, time.monotonic() - t0)
         return version
 
     def close(self) -> None:

@@ -93,6 +93,7 @@ class ReceiverSockets:
                 conn, _ = listener.accept()
             except OSError:
                 return  # closed
+            round_id = None
             try:
                 with conn:
                     _tune(conn)
@@ -122,8 +123,12 @@ class ReceiverSockets:
                             self._done.set()
             except Exception as exc:  # noqa: BLE001 — reported to waiter
                 with self._lock:
-                    self._errors.append(str(exc))
-                    self._done.set()
+                    # only fail the round this stream belongs to — a dangling
+                    # connection from an aborted round must not poison the
+                    # retry's accounting
+                    if round_id == self._round:
+                        self._errors.append(str(exc))
+                        self._done.set()
 
     def wait(self, timeout: float | None = None) -> None:
         if not self._done.wait(timeout):

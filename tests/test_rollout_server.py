@@ -1,0 +1,224 @@
+"""HTTP rollout server tests: the REAL engine behind the manager protocol
+(SURVEY §3.2 serving path, §3.4 request path). One module-scoped server so
+the tiny-model compile is paid once."""
+
+import http.client
+import json
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from polyrl_tpu.manager.client import ManagerClient, spawn_rollout_manager
+from polyrl_tpu.rollout.serve import create_server, register_with_manager
+from polyrl_tpu.transfer import TransferInterface
+
+MODEL_KW = dict(
+    model="tiny", dtype="float32",
+    batch_buckets=(4,), prompt_buckets=(16,),
+    model_overrides={"vocab_size": 256},
+)
+
+
+@pytest.fixture(scope="module")
+def server():
+    srv = create_server(host="127.0.0.1", **MODEL_KW)
+    yield srv
+    srv.stop()
+
+
+def post_generate(endpoint: str, rid: str, input_ids, sampling_params,
+                  timeout=120.0):
+    """Stream POST /generate, returning (lines, merged tokens/logprobs)."""
+    host, port = endpoint.rsplit(":", 1)
+    conn = http.client.HTTPConnection(host, int(port), timeout=timeout)
+    body = json.dumps({"rid": rid, "input_ids": list(input_ids),
+                       "sampling_params": sampling_params})
+    conn.request("POST", "/generate", body,
+                 {"Content-Type": "application/json"})
+    resp = conn.getresponse()
+    assert resp.status == 200
+    lines = []
+    buf = b""
+    while True:
+        chunk = resp.read1(65536)
+        if not chunk:
+            break
+        buf += chunk
+        while b"\n" in buf:
+            line, buf = buf.split(b"\n", 1)
+            if line.strip():
+                lines.append(json.loads(line))
+    conn.close()
+    tokens, logps = [], []
+    for ln in lines:
+        tokens.extend(ln.get("token_ids", []))
+        logps.extend(ln.get("logprobs", []))
+    return lines, tokens, logps
+
+
+def get_json(endpoint: str, path: str) -> dict:
+    host, port = endpoint.rsplit(":", 1)
+    conn = http.client.HTTPConnection(host, int(port), timeout=10.0)
+    conn.request("GET", path)
+    resp = conn.getresponse()
+    out = json.loads(resp.read())
+    conn.close()
+    return out
+
+
+def test_health_and_info(server):
+    assert get_json(server.endpoint, "/health")["status"] == "ok"
+    info = get_json(server.endpoint, "/get_server_info")
+    assert {"num_running_reqs", "num_queued_reqs", "last_gen_throughput",
+            "weight_version"} <= set(info)
+
+
+def test_generate_streams_tokens(server):
+    lines, tokens, logps = post_generate(
+        server.endpoint, "g1", [1, 2, 3],
+        {"max_new_tokens": 6, "temperature": 0.0})
+    assert len(tokens) == 6
+    assert len(logps) == 6
+    assert all(lp <= 0.0 for lp in logps)
+    # one NDJSON line per token (streaming, not one blob)
+    assert len(lines) == 6
+    assert lines[-1]["finished"] and lines[-1]["finish_reason"] == "length"
+    assert all(not ln["finished"] for ln in lines[:-1])
+
+
+def test_greedy_determinism(server):
+    _, t1, _ = post_generate(server.endpoint, "d1", [5, 6, 7],
+                             {"max_new_tokens": 5, "temperature": 0.0})
+    _, t2, _ = post_generate(server.endpoint, "d2", [5, 6, 7],
+                             {"max_new_tokens": 5, "temperature": 0.0})
+    assert t1 == t2
+
+
+def test_concurrent_requests_batched(server):
+    """4 concurrent requests with the same sampling group share one batch."""
+    results = {}
+
+    def worker(i):
+        results[i] = post_generate(
+            server.endpoint, f"c{i}", [i + 1, i + 2],
+            {"max_new_tokens": 4, "temperature": 0.0})
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    assert len(results) == 4
+    for i in range(4):
+        _, tokens, _ = results[i]
+        assert len(tokens) == 4
+
+
+def test_abort_request(server):
+    """Abort lands mid-decode: stream ends early with finish_reason abort."""
+    out = {}
+
+    def worker():
+        out["res"] = post_generate(
+            server.endpoint, "ab1", [9],
+            {"max_new_tokens": 512, "temperature": 0.0})
+
+    t = threading.Thread(target=worker)
+    t.start()
+    time.sleep(1.0)  # let a few steps run
+    host, port = server.endpoint.rsplit(":", 1)
+    conn = http.client.HTTPConnection(host, int(port), timeout=10)
+    conn.request("POST", "/abort_request", json.dumps({"rid": "ab1"}),
+                 {"Content-Type": "application/json"})
+    assert conn.getresponse().status == 200
+    conn.close()
+    t.join(timeout=60)
+    assert "res" in out
+    lines, tokens, _ = out["res"]
+    assert lines[-1]["finish_reason"] == "abort"
+    assert len(tokens) < 512
+
+
+def test_manager_routes_through_real_server(server):
+    proc, port = spawn_rollout_manager(
+        "127.0.0.1:0",
+        extra_args=["--health-check-interval-s", "0.1",
+                    "--stats-poll-interval-s", "0.2",
+                    "--generate-timeout-ms", "120000"])
+    try:
+        mgr = ManagerClient(f"127.0.0.1:{port}")
+        mgr.wait_healthy()
+        mgr.register_rollout_instance(server.endpoint)
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < 10:
+            st = mgr.get_instances_status()
+            if any(i["healthy"] for i in st["instances"]):
+                break
+            time.sleep(0.1)
+        res = mgr.generate("m1", [1, 2, 3], {"max_new_tokens": 4,
+                                             "temperature": 0.0})
+        assert res.success, res.error
+        assert len(res.output_token_ids) == 4
+        assert len(res.output_token_logprobs) == 4
+
+        reqs = [{"rid": f"mb{i}", "input_ids": [1, i + 1],
+                 "sampling_params": {"max_new_tokens": 3, "temperature": 0.0}}
+                for i in range(3)]
+        results = list(mgr.batch_generate_stream(reqs, max_local_gen_s=60))
+        assert len(results) == 3
+        assert all(r.success for r in results)
+    finally:
+        proc.kill()
+
+
+def test_weight_update_through_fabric(server):
+    """Full §3.3 with the REAL engine: trainer packs new params -> TCP push
+    -> manager /update_weights -> server loads from receiver buffer ->
+    greedy output changes, weight_version advances."""
+    _, before, _ = post_generate(server.endpoint, "w0", [3, 1, 4],
+                                 {"max_new_tokens": 4, "temperature": 0.0})
+    proc, port = spawn_rollout_manager(
+        "127.0.0.1:0",
+        extra_args=["--health-check-interval-s", "0.1",
+                    "--stats-poll-interval-s", "0.2"])
+    iface = None
+    try:
+        mgr = ManagerClient(f"127.0.0.1:{port}")
+        mgr.wait_healthy()
+        iface = TransferInterface(server.engine.params, manager_client=mgr,
+                                  num_streams=2, poll_s=0.1,
+                                  advertise_host="127.0.0.1")
+        register_with_manager(server, f"127.0.0.1:{port}", transfer_streams=2)
+        assert server.receiver is not None
+        time.sleep(0.5)  # health check
+
+        new_params = jax.tree_util.tree_map(
+            lambda x: x + 0.05, jax.device_get(server.engine.params))
+        v = iface.update_weights_with_agent(new_params)
+
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < 30:
+            if server.engine.weight_version == v:
+                break
+            time.sleep(0.2)
+        assert server.engine.weight_version == v
+
+        _, after, _ = post_generate(server.endpoint, "w1", [3, 1, 4],
+                                    {"max_new_tokens": 4, "temperature": 0.0})
+        assert after != before  # weights actually changed the model
+        # engine params match what the trainer sent
+        got = jax.device_get(server.engine.params)
+        want = jax.device_get(new_params)
+        for a, b in zip(jax.tree_util.tree_leaves(got),
+                        jax.tree_util.tree_leaves(want)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
+    finally:
+        if iface is not None:
+            iface.close()
+        if server.receiver is not None:
+            server.receiver.stop()
+            server.receiver = None
+        proc.kill()
