@@ -223,9 +223,11 @@ def apply_rope(x: jnp.ndarray, cos: jnp.ndarray, sin: jnp.ndarray) -> jnp.ndarra
 # -- forward ----------------------------------------------------------------
 
 
-def _layer_forward(cfg, x, lp, cos, sin, mask, layer_cache):
+def _layer_forward(cfg, x, lp, cos, sin, mask, layer_cache, attn_fn=None):
     """One decoder layer. layer_cache: None or (k_cache, v_cache) [B, S, Hkv, D]
-    already containing past KV; this layer writes its new KV at write_idx."""
+    already containing past KV; this layer writes its new KV at write_idx.
+    ``attn_fn``: optional sequence-parallel attention (Ulysses/ring,
+    polyrl_tpu.parallel.sequence) used on the no-cache (training) path."""
     b, t, d = x.shape
     hq, hkv, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim_
 
@@ -245,6 +247,9 @@ def _layer_forward(cfg, x, lp, cos, sin, mask, layer_cache):
         v_full = jax.lax.dynamic_update_slice(v_cache, v.astype(v_cache.dtype), (0, write_idx, 0, 0))
         attn_out = attention(q, k_full, v_full, mask=mask)
         new_cache = (k_full, v_full)
+    elif attn_fn is not None:
+        attn_out = attn_fn(q, k, v)  # SP impl applies causal+pad internally
+        new_cache = None
     else:
         attn_out = attention(q, k, v, mask=mask)
         new_cache = None
@@ -268,6 +273,7 @@ def forward(
     cache: tuple | None = None,      # (k, v) each [L, B, S, Hkv, D]
     write_idx: int | jnp.ndarray = 0,
     remat: bool = False,
+    attn_fn=None,                    # SP attention (parallel.sequence), no-cache path only
 ) -> tuple[jnp.ndarray, tuple | None]:
     """Returns (logits [B, T, V] float32, new_cache or None).
 
@@ -282,9 +288,12 @@ def forward(
     cos, sin = rope_cos_sin(cfg, positions)
 
     if cache is None:
-        # causal within the chunk + padding mask
-        cm = causal_mask(t, t)  # [T, T]
-        mask = cm[None, None, :, :] & (attn_mask[:, None, None, :] > 0)
+        if attn_fn is not None:
+            mask = None  # SP attention builds causal+pad masks per block
+        else:
+            # causal within the chunk + padding mask
+            cm = causal_mask(t, t)  # [T, T]
+            mask = cm[None, None, :, :] & (attn_mask[:, None, None, :] > 0)
     else:
         # left-padded layout: cache slot order == temporal order, so the
         # causal constraint is expressed in slot indices, not positions.
@@ -297,8 +306,13 @@ def forward(
     layers = params["layers"]
 
     if cache is None:
+        layer_attn = None
+        if attn_fn is not None:
+            layer_attn = lambda q, k, v: attn_fn(q, k, v, attn_mask)  # noqa: E731
+
         def body(x, lp):
-            x, _ = _layer_forward(cfg, x, lp, cos, sin, mask, None)
+            x, _ = _layer_forward(cfg, x, lp, cos, sin, mask, None,
+                                  attn_fn=layer_attn)
             return x, None
         if remat:
             body = jax.checkpoint(body)

@@ -1,0 +1,13 @@
+"""Parallelism: device mesh axes + sequence/context parallel attention."""
+
+from .mesh import AXES, BATCH_SPEC, DP, FSDP, SP, TP, MeshConfig, make_mesh
+from .sequence import (
+    make_ring_attention,
+    make_sp_attention,
+    make_ulysses_attention,
+)
+
+__all__ = [
+    "AXES", "BATCH_SPEC", "DP", "FSDP", "SP", "TP", "MeshConfig", "make_mesh",
+    "make_ring_attention", "make_sp_attention", "make_ulysses_attention",
+]

@@ -1,0 +1,147 @@
+"""Sequence/context parallelism: Ulysses all-to-all + ring attention.
+
+The reference's long-context training mechanism is verl's Ulysses SP —
+sequences sliced along length across ranks, attention computed by
+all-to-all head exchange (SURVEY §5.7, ``stream_fsdp_workers.py:91``,
+``stream_dp_actor.py:37``). The reference has no ring attention; SURVEY §2.3
+calls for providing ring attention over ICI as the TPU-idiomatic context
+parallelism for the very-long-context regime.
+
+Both primitives run under ``shard_map`` over the ``sp`` mesh axis and share
+one signature: q/k/v are [B, T, H, D] logically-global arrays sharded
+P(batch, sp, None, None); ``token_mask`` is [B, T] validity (left-pad
+aware); causal masking over GLOBAL positions is applied internally.
+
+- Ulysses: all-to-all redistributes heads<->sequence so each rank computes
+  full-sequence attention for H/sp heads — one cheap ICI all-to-all each
+  way, best when H >= sp.
+- Ring: K/V blocks rotate around the sp ring via ``ppermute`` with online
+  (flash-style) softmax accumulation — memory O(T/sp) per rank, scales to
+  sequences no single chip can hold.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from polyrl_tpu.ops.attention import attention, causal_mask, repeat_kv
+from polyrl_tpu.parallel.mesh import DP, FSDP, SP
+
+_NEG = -0.7 * float(jnp.finfo(jnp.float32).max)  # finite -inf (no exp NaNs)
+
+
+def _maybe_repeat_kv(k, v, hq: int, sp: int):
+    """GQA: if KV heads don't split evenly over sp, expand to Q heads."""
+    hkv = k.shape[2]
+    if hkv % sp != 0:
+        n_rep = hq // hkv
+        k, v = repeat_kv(k, n_rep), repeat_kv(v, n_rep)
+    return k, v
+
+
+# --------------------------------------------------------------------------
+# Ulysses
+# --------------------------------------------------------------------------
+
+
+def make_ulysses_attention(mesh: Mesh, axis: str = SP,
+                           batch_axes=(DP, FSDP)):
+    """Returns attn_fn(q, k, v, token_mask) -> out, all [B, T, H, D] with the
+    seq dim sharded over ``axis``. Ulysses ≙ all-to-all head redistribution
+    (verl's FSDPUlyssesShardingManager equivalent)."""
+    sp = mesh.shape[axis]
+
+    def inner(q, k, v, token_mask):
+        # local: q [B, Ts, Hq, D]; all_to_all -> [B, T, Hq/sp, D]
+        hq = q.shape[2]
+        k, v = _maybe_repeat_kv(k, v, hq, sp)
+        q_g = lax.all_to_all(q, axis, split_axis=2, concat_axis=1, tiled=True)
+        k_g = lax.all_to_all(k, axis, split_axis=2, concat_axis=1, tiled=True)
+        v_g = lax.all_to_all(v, axis, split_axis=2, concat_axis=1, tiled=True)
+        mask_g = lax.all_gather(token_mask, axis, axis=1, tiled=True)  # [B, T]
+        t = q_g.shape[1]
+        mask = causal_mask(t, t)[None, None, :, :] & (mask_g[:, None, None, :] > 0)
+        out = attention(q_g, k_g, v_g, mask=mask)        # [B, T, Hq/sp, D]
+        return lax.all_to_all(out, axis, split_axis=1, concat_axis=2, tiled=True)
+
+    qkv_spec = P(batch_axes, axis, None, None)
+    mask_spec = P(batch_axes, axis)
+    return jax.shard_map(inner, mesh=mesh,
+                         in_specs=(qkv_spec, qkv_spec, qkv_spec, mask_spec),
+                         out_specs=qkv_spec, check_vma=False)
+
+
+# --------------------------------------------------------------------------
+# Ring attention
+# --------------------------------------------------------------------------
+
+
+def make_ring_attention(mesh: Mesh, axis: str = SP, batch_axes=(DP, FSDP)):
+    """Returns attn_fn(q, k, v, token_mask) -> out. Blockwise attention with
+    K/V rotating over the sp ring (ppermute) and online-softmax merging —
+    the TPU context-parallel mode SURVEY §2.3 calls for."""
+    sp = mesh.shape[axis]
+    perm = [(i, (i + 1) % sp) for i in range(sp)]
+
+    def inner(q, k, v, token_mask):
+        b, tq, hq, d = q.shape
+        k, v = _maybe_repeat_kv(k, v, hq, sp)
+        if k.shape[2] != hq:  # evenly divisible GQA: still expand locally —
+            k, v = repeat_kv(k, hq // k.shape[2]), repeat_kv(v, hq // k.shape[2])
+        scale = d ** -0.5
+        idx = lax.axis_index(axis)
+        q32 = q.astype(jnp.float32) * scale
+        q_pos = idx * tq + jnp.arange(tq)  # global positions of local Q rows
+
+        m = jnp.full((b, hq, tq), _NEG, jnp.float32)
+        l = jnp.zeros((b, hq, tq), jnp.float32)
+        o = jnp.zeros((b, tq, hq, d), jnp.float32)
+        k_cur, v_cur, mask_cur = k, v, token_mask
+
+        for step in range(sp):
+            src = (idx - step) % sp  # block id currently held
+            tk = k_cur.shape[1]
+            logits = jnp.einsum("bqhd,bkhd->bhqk", q32,
+                                k_cur.astype(jnp.float32))
+            kv_pos = src * tk + jnp.arange(tk)
+            ok = (kv_pos[None, :] <= q_pos[:, None])[None, None, :, :]
+            ok = ok & (mask_cur[:, None, None, :] > 0)
+            logits = jnp.where(ok, logits, _NEG)
+            m_new = jnp.maximum(m, logits.max(axis=-1))
+            p = jnp.exp(logits - m_new[..., None])
+            p = jnp.where(ok, p, 0.0)
+            corr = jnp.exp(m - m_new)
+            l = l * corr + p.sum(axis=-1)
+            o = o * corr.transpose(0, 2, 1)[..., None] + jnp.einsum(
+                "bhqk,bkhd->bqhd", p, v_cur.astype(jnp.float32))
+            m = m_new
+            if step < sp - 1:
+                k_cur = lax.ppermute(k_cur, axis, perm)
+                v_cur = lax.ppermute(v_cur, axis, perm)
+                mask_cur = lax.ppermute(mask_cur, axis, perm)
+
+        denom = jnp.maximum(l, 1e-30).transpose(0, 2, 1)[..., None]
+        return (o / denom).astype(q.dtype)
+
+    qkv_spec = P(batch_axes, axis, None, None)
+    mask_spec = P(batch_axes, axis)
+    return jax.shard_map(inner, mesh=mesh,
+                         in_specs=(qkv_spec, qkv_spec, qkv_spec, mask_spec),
+                         out_specs=qkv_spec, check_vma=False)
+
+
+def make_sp_attention(mesh: Mesh, mode: str, axis: str = SP,
+                      batch_axes=(DP, FSDP)):
+    """Dispatch: 'ulysses' | 'ring' | 'dense' (None)."""
+    if mode == "ulysses":
+        return make_ulysses_attention(mesh, axis, batch_axes)
+    if mode == "ring":
+        return make_ring_attention(mesh, axis, batch_axes)
+    if mode in ("dense", "none", None):
+        return None
+    raise ValueError(f"unknown sp attention mode {mode!r}")

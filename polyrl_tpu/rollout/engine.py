@@ -41,6 +41,17 @@ def next_bucket(n: int, buckets: tuple[int, ...]) -> int:
     raise ValueError(f"{n} exceeds largest bucket {buckets[-1]}")
 
 
+def pack_left_padded(prompt_ids, pad_token_id: int, bb: int, pb: int):
+    """Left-pad prompts into [bb, pb] (ids, mask) — shared by the fused and
+    streaming decode paths so padding semantics can't drift."""
+    ids = np.full((bb, pb), pad_token_id, np.int32)
+    mask = np.zeros((bb, pb), np.float32)
+    for i, p in enumerate(prompt_ids):
+        ids[i, pb - len(p):] = np.asarray(p, np.int32)
+        mask[i, pb - len(p):] = 1.0
+    return ids, mask
+
+
 @dataclasses.dataclass
 class GenerationOutput:
     """Per-request result mirroring the fields the reference's manager
@@ -174,11 +185,7 @@ class RolloutEngine:
         max_prompt = max(len(p) for p in prompt_ids)
         pb = next_bucket(max_prompt, self.prompt_buckets)
 
-        ids = np.full((bb, pb), self.pad_token_id, np.int32)
-        mask = np.zeros((bb, pb), np.float32)
-        for i, p in enumerate(prompt_ids):
-            ids[i, pb - len(p):] = np.asarray(p, np.int32)
-            mask[i, pb - len(p):] = 1.0
+        ids, mask = pack_left_padded(prompt_ids, self.pad_token_id, bb, pb)
 
         key = (bb, pb, sampling)
         if key not in self._compiled:

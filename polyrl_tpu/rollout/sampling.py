@@ -36,7 +36,9 @@ class SamplingParams:
             temperature=float(d.get("temperature", 1.0)),
             top_p=float(d.get("top_p", 1.0)),
             top_k=int(d.get("top_k", 0)),
-            max_new_tokens=int(d.get("max_new_tokens", 128)),
+            # clamp: 0/negative budgets would yield an empty stream and hang
+            # the serving handler waiting for tokens that never come
+            max_new_tokens=max(int(d.get("max_new_tokens", 128)), 1),
             stop_token_ids=tuple(d.get("stop_token_ids", ())),
         )
 

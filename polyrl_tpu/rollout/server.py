@@ -177,6 +177,14 @@ class RolloutServer:
         out: queue.Queue = queue.Queue()
         abort = threading.Event()
         with self._aborts_lock:
+            if rid in self._aborts:
+                # duplicate in-flight rid: reject — a second registration
+                # would orphan the first request's abort event
+                out.put({"token_ids": [], "logprobs": [], "finished": True,
+                         "finish_reason": "error",
+                         "error": f"duplicate rid {rid!r} in flight"})
+                out.put(_SENTINEL)
+                return out
             self._aborts[rid] = abort
         self._queue.put(_PendingRequest(rid, input_ids, sp, out, abort))
         return out
@@ -198,20 +206,31 @@ class RolloutServer:
             self._aborts.pop(rid, None)
 
     def _batch_loop(self) -> None:
+        # requests pulled but not matching the current batch's sampling
+        # group wait here and are served FIRST next round (no starvation
+        # behind a sustained stream of another group)
+        held: list[_PendingRequest] = []
         while not self._stop.is_set():
-            try:
-                first = self._queue.get(timeout=0.2)
-            except queue.Empty:
-                continue
+            if held:
+                first = held.pop(0)
+            else:
+                try:
+                    first = self._queue.get(timeout=0.2)
+                except queue.Empty:
+                    continue
             if self._paused.is_set():
-                # engine yielded HBM to the trainer: finish aborted, requeue
-                self._queue.put(first)
+                # engine yielded HBM to the trainer: wait for resume
+                held.insert(0, first)
                 time.sleep(0.05)
                 continue
             batch = [first]
             deadline = time.monotonic() + self.batch_wait_s
             key = first.sampling.group_key()
-            leftover: list[_PendingRequest] = []
+            matched, unmatched = [], []
+            for req in held:
+                (matched if req.sampling.group_key() == key else unmatched).append(req)
+            batch.extend(matched[: self.max_batch - 1])
+            held = unmatched + matched[self.max_batch - 1 :]
             while len(batch) < self.max_batch:
                 left = deadline - time.monotonic()
                 if left <= 0:
@@ -223,10 +242,7 @@ class RolloutServer:
                 if req.sampling.group_key() == key:
                     batch.append(req)
                 else:
-                    leftover.append(req)
-            for req in leftover:
-                self._queue.put(req)
-            self.engine.num_queued = self._queue.qsize()
+                    held.append(req)
             try:
                 self._run_batch(batch)
             except Exception as exc:  # noqa: BLE001 — fail the whole batch
@@ -244,6 +260,7 @@ class RolloutServer:
         limits = [r.sampling.max_new_tokens for r in batch]
         flags = [r.abort for r in batch]
         total = 0
+        closed = [False] * len(batch)
         with self._weight_lock:
             stream = self.stepper.generate_stream(
                 prompts, batch[0].sampling, max_new=limits, abort_flags=flags)
@@ -262,6 +279,15 @@ class RolloutServer:
                     })
                 if ev["done"]:
                     req.out.put(_SENTINEL)
+                    closed[ev["row"]] = True
+        # defense in depth: every handler MUST see a sentinel or it blocks
+        # its HTTP thread forever
+        for req, done in zip(batch, closed):
+            if not done:
+                req.out.put({"token_ids": [], "logprobs": [], "finished": True,
+                             "finish_reason": "error",
+                             "error": "stream ended without completion"})
+                req.out.put(_SENTINEL)
         dt = time.monotonic() - t0
         self.engine.last_gen_throughput = total / dt if dt > 0 else 0.0
         self.engine.num_running = 0

@@ -23,7 +23,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from polyrl_tpu.models import decoder
-from polyrl_tpu.rollout.engine import next_bucket
+from polyrl_tpu.rollout.engine import next_bucket, pack_left_padded
 from polyrl_tpu.rollout.sampling import SamplingParams, sample_token
 
 
@@ -120,11 +120,7 @@ class StepDecoder:
         limits = max_new if max_new is not None else [sampling.max_new_tokens] * n
         nb = next_bucket(max(limits), self.new_buckets)
 
-        ids = np.full((bb, pb), self.engine.pad_token_id, np.int32)
-        mask = np.zeros((bb, pb), np.float32)
-        for i, p in enumerate(prompt_ids):
-            ids[i, pb - len(p):] = np.asarray(p, np.int32)
-            mask[i, pb - len(p):] = 1.0
+        ids, mask = pack_left_padded(prompt_ids, self.engine.pad_token_id, bb, pb)
         row_limit = np.zeros((bb,), np.int32)
         row_limit[:n] = np.asarray(limits, np.int32)
 

@@ -64,9 +64,13 @@ def make_optimizer(cfg: ActorConfig, total_steps: int = 0) -> optax.GradientTran
 
 
 def _model_logprobs_entropy(params, model_cfg, input_ids, positions, attn_mask,
-                            responses, response_mask, remat, compute_entropy):
-    """Forward over [B, T_total]; logprobs of response tokens [B, T_resp]."""
-    logits, _ = decoder.forward(params, model_cfg, input_ids, positions, attn_mask, remat=remat)
+                            responses, response_mask, remat, compute_entropy,
+                            attn_fn=None):
+    """Forward over [B, T_total]; logprobs of response tokens [B, T_resp].
+    ``attn_fn``: optional sequence-parallel attention (Ulysses/ring) for
+    long-context training (SURVEY §5.7)."""
+    logits, _ = decoder.forward(params, model_cfg, input_ids, positions,
+                                attn_mask, remat=remat, attn_fn=attn_fn)
     t_resp = responses.shape[1]
     # logits at position i predict token i+1: responses occupy the last
     # t_resp positions of input_ids, so their predictors are shifted one left.
@@ -85,10 +89,12 @@ class StreamActor:
         cfg: ActorConfig,
         params: Any,
         mesh=None,
+        attn_fn=None,
     ):
         self.model_cfg = model_cfg
         self.cfg = cfg
         self.mesh = mesh
+        self.attn_fn = attn_fn
         self.params = params
         self.optimizer = make_optimizer(cfg)
         self.opt_state = self.optimizer.init(params)
@@ -104,7 +110,7 @@ class StreamActor:
             params, self.model_cfg,
             batch["input_ids"], batch["positions"], batch["attention_mask"],
             batch["responses"], batch["response_mask"],
-            cfg.remat, cfg.entropy_coeff != 0.0,
+            cfg.remat, cfg.entropy_coeff != 0.0, attn_fn=self.attn_fn,
         )
         loss_fn = core_algos.get_policy_loss_fn(cfg.policy_loss)
         pg_loss, clipfrac, approx_kl, clipfrac_lower = loss_fn(
@@ -171,7 +177,8 @@ class StreamActor:
         if compute_entropy not in self._logprob_fns:
             self._logprob_fns[compute_entropy] = jax.jit(
                 partial(_model_logprobs_entropy, remat=False,
-                        compute_entropy=compute_entropy),
+                        compute_entropy=compute_entropy,
+                        attn_fn=self.attn_fn),
                 static_argnums=(1,),
             )
         return self._logprob_fns[compute_entropy](
@@ -189,11 +196,12 @@ class ReferencePolicy:
     holding deleted buffers after the first optimizer step.
     """
 
-    def __init__(self, model_cfg: decoder.ModelConfig, params: Any):
+    def __init__(self, model_cfg: decoder.ModelConfig, params: Any, attn_fn=None):
         self.model_cfg = model_cfg
         self.params = jax.tree_util.tree_map(jnp.copy, params)
         self._fn = jax.jit(
-            partial(_model_logprobs_entropy, remat=False, compute_entropy=False),
+            partial(_model_logprobs_entropy, remat=False, compute_entropy=False,
+                    attn_fn=attn_fn),
             static_argnums=(1,),
         )
 

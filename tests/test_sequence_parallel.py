@@ -1,0 +1,123 @@
+"""Sequence-parallel attention tests on the 8-device CPU mesh (SURVEY §4:
+pjit sharding and collectives exercised host-side). Ulysses and ring must
+match dense attention bit-for-tolerance, including left-padding and GQA."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from polyrl_tpu.models import decoder
+from polyrl_tpu.ops.attention import attention, causal_mask
+from polyrl_tpu.parallel import mesh as meshlib
+from polyrl_tpu.parallel.sequence import (
+    make_ring_attention,
+    make_sp_attention,
+    make_ulysses_attention,
+)
+
+
+@pytest.fixture(scope="module")
+def sp_mesh(devices8):
+    # dp=1, fsdp=2, tp=1, sp=4 — sequence axis genuinely multi-device
+    return meshlib.make_mesh(meshlib.MeshConfig(dp=1, fsdp=2, tp=1, sp=4),
+                             devices8)
+
+
+def dense_reference(q, k, v, token_mask):
+    t = q.shape[1]
+    mask = causal_mask(t, t)[None, None, :, :] & (token_mask[:, None, None, :] > 0)
+    return attention(q, k, v, mask=mask)
+
+
+def make_qkv(rng, b=4, t=32, hq=8, hkv=8, d=16, left_pad=0):
+    q = jnp.asarray(rng.normal(size=(b, t, hq, d)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(b, t, hkv, d)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(b, t, hkv, d)), jnp.float32)
+    mask = np.ones((b, t), np.float32)
+    if left_pad:
+        mask[:, :left_pad] = 0.0
+    return q, k, v, jnp.asarray(mask)
+
+
+@pytest.mark.parametrize("mode", ["ulysses", "ring"])
+@pytest.mark.parametrize("hkv,left_pad", [(8, 0), (2, 0), (8, 5)])
+def test_sp_attention_matches_dense(sp_mesh, rng, mode, hkv, left_pad):
+    q, k, v, tmask = make_qkv(rng, hkv=hkv, left_pad=left_pad)
+    want = dense_reference(q, k, v, tmask)
+    # padded rows produce garbage outputs in both impls (masked-everything
+    # rows); only compare valid positions
+    fn = make_sp_attention(sp_mesh, mode)
+    spec = NamedSharding(sp_mesh, P(("dp", "fsdp"), "sp", None, None))
+    mspec = NamedSharding(sp_mesh, P(("dp", "fsdp"), "sp"))
+    args = (jax.device_put(q, spec), jax.device_put(k, spec),
+            jax.device_put(v, spec), jax.device_put(tmask, mspec))
+    got = jax.jit(fn)(*args)
+    valid = np.asarray(tmask)[:, :, None, None] > 0
+    np.testing.assert_allclose(np.where(valid, np.asarray(got), 0),
+                               np.where(valid, np.asarray(want), 0),
+                               rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("mode", ["ulysses", "ring"])
+def test_sp_attention_grads_match_dense(sp_mesh, rng, mode):
+    q, k, v, tmask = make_qkv(rng, b=2, t=16, hq=4, hkv=4, d=8)
+    fn = make_sp_attention(sp_mesh, mode)
+
+    def loss_sp(q, k, v):
+        return (fn(q, k, v, tmask) ** 2).sum()
+
+    def loss_dense(q, k, v):
+        return (dense_reference(q, k, v, tmask) ** 2).sum()
+
+    spec = NamedSharding(sp_mesh, P(("dp", "fsdp"), "sp", None, None))
+    qs, ks, vs = (jax.device_put(x, spec) for x in (q, k, v))
+    g_sp = jax.jit(jax.grad(loss_sp, argnums=(0, 1, 2)))(qs, ks, vs)
+    g_dense = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_sp, g_dense):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=5e-4, atol=5e-5)
+
+
+@pytest.mark.parametrize("mode", ["ulysses", "ring"])
+def test_decoder_forward_with_sp_attention(sp_mesh, rng, mode):
+    """Full model forward with seq sharded over sp == dense single-logical
+    forward (the verl Ulysses seam, stream_dp_actor.py:37)."""
+    cfg = decoder.get_config("tiny", dtype=jnp.float32, vocab_size=128)
+    params = decoder.init_params(jax.random.PRNGKey(0), cfg)
+    b, t = 4, 32
+    ids = jnp.asarray(rng.integers(0, 128, (b, t)), jnp.int32)
+    pos = jnp.broadcast_to(jnp.arange(t), (b, t)).astype(jnp.int32)
+    mask = jnp.ones((b, t), jnp.float32)
+
+    want, _ = decoder.forward(params, cfg, ids, pos, mask)
+
+    attn_fn = make_sp_attention(sp_mesh, mode)
+    dspec = NamedSharding(sp_mesh, P(("dp", "fsdp"), "sp"))
+    rspec = NamedSharding(sp_mesh, P())
+    params_s = jax.tree_util.tree_map(lambda x: jax.device_put(x, rspec), params)
+    ids_s = jax.device_put(ids, dspec)
+    pos_s = jax.device_put(pos, dspec)
+    mask_s = jax.device_put(mask, dspec)
+
+    got, _ = jax.jit(
+        lambda p, i, po, m: decoder.forward(p, cfg, i, po, m, attn_fn=attn_fn)
+    )(params_s, ids_s, pos_s, mask_s)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_ring_memory_is_blockwise(sp_mesh, rng):
+    """Ring attention never materializes the [T, T] score matrix per rank —
+    sanity-check it compiles and runs at a length where the full dense mask
+    would be 64x the block size."""
+    q, k, v, tmask = make_qkv(rng, b=2, t=512, hq=4, hkv=4, d=8)
+    fn = make_ring_attention(sp_mesh)
+    spec = NamedSharding(sp_mesh, P(("dp", "fsdp"), "sp", None, None))
+    mspec = NamedSharding(sp_mesh, P(("dp", "fsdp"), "sp"))
+    out = jax.jit(fn)(jax.device_put(q, spec), jax.device_put(k, spec),
+                      jax.device_put(v, spec), jax.device_put(tmask, mspec))
+    want = dense_reference(q, k, v, tmask)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
