@@ -335,6 +335,110 @@ def forward(
     return logits, new_cache
 
 
+# -- paged KV (continuous batching) -----------------------------------------
+
+
+def make_paged_pools(cfg: ModelConfig, num_pages: int, page_size: int,
+                     dtype=None) -> tuple:
+    """Paged KV pool: (k, v) each [L, num_pages, page_size, Hkv, D].
+
+    Page 0 is reserved as the null page — inactive slots and padding scatter
+    their garbage KV there so every decode step has uniform static shapes
+    (the TPU answer to SGLang's paged allocator, SURVEY.md §2.2 row 1)."""
+    dtype = dtype or cfg.dtype
+    shape = (cfg.num_layers, num_pages, page_size, cfg.num_kv_heads, cfg.head_dim_)
+    return (jnp.zeros(shape, dtype=dtype), jnp.zeros(shape, dtype=dtype))
+
+
+def forward_paged_decode(
+    params: dict,
+    cfg: ModelConfig,
+    tokens: jnp.ndarray,      # [S] int32 — one new token per slot
+    positions: jnp.ndarray,   # [S] int32 — absolute position of that token
+    pools: tuple,             # (k, v) each [L, N, page_size, Hkv, D]
+    page_table: jnp.ndarray,  # [S, P] int32
+    seq_lens: jnp.ndarray,    # [S] int32 tokens already in cache (== positions)
+    attn_fn=None,
+) -> tuple[jnp.ndarray, tuple]:
+    """One decode step for every slot at once: write the new token's KV into
+    each slot's current page, then paged-attend over [0, seq_len]. Returns
+    (logits [S, V] f32, updated pools). Static shapes regardless of the mix
+    of live requests — the continuous-batching hot loop."""
+    from polyrl_tpu.ops.paged_attention import paged_attention
+
+    attn_fn = attn_fn or paged_attention
+    s = tokens.shape[0]
+    hq, hkv, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim_
+    page_size = pools[0].shape[2]
+
+    x = params["embed"][tokens]  # [S, d]
+    cos, sin = rope_cos_sin(cfg, positions[:, None])  # [S, 1, hd/2]
+    write_page = page_table[jnp.arange(s), seq_lens // page_size]  # [S]
+    write_off = seq_lens % page_size
+    attn_lens = seq_lens + 1  # include the token written this step
+
+    layers = params["layers"]
+
+    def body(x, scanned):
+        lp, kp, vp = scanned
+        h = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
+        q = (h @ lp["wq"]).reshape(s, 1, hq, hd)
+        k = (h @ lp["wk"]).reshape(s, 1, hkv, hd)
+        v = (h @ lp["wv"]).reshape(s, 1, hkv, hd)
+        if cfg.use_qk_norm:
+            q = rms_norm(q, lp["q_norm"], cfg.rms_norm_eps)
+            k = rms_norm(k, lp["k_norm"], cfg.rms_norm_eps)
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+        kp = kp.at[write_page, write_off].set(k[:, 0].astype(kp.dtype))
+        vp = vp.at[write_page, write_off].set(v[:, 0].astype(vp.dtype))
+        attn_out = attn_fn(q[:, 0], kp, vp, page_table, attn_lens)  # [S, Hq, D]
+        x = x + attn_out.reshape(s, hq * hd) @ lp["wo"]
+        h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
+        gate = jax.nn.silu((h @ lp["w_gate"]).astype(jnp.float32)).astype(h.dtype)
+        x = x + (gate * (h @ lp["w_up"])) @ lp["w_down"]
+        return x, (kp, vp)
+
+    x, (k_pools, v_pools) = jax.lax.scan(body, x, (layers, pools[0], pools[1]))
+    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    head = params["embed"].T if cfg.tie_word_embeddings else params["lm_head"]
+    logits = jnp.einsum("sd,dv->sv", x, head, preferred_element_type=jnp.float32)
+    return logits, (k_pools, v_pools)
+
+
+def prefill_into_pages(
+    params: dict,
+    cfg: ModelConfig,
+    ids: jnp.ndarray,         # [pb] int32 right-padded prompt
+    prompt_len: jnp.ndarray,  # scalar int32
+    pools: tuple,
+    page_ids: jnp.ndarray,    # [pb // page_size] int32 (0-padded past prompt)
+) -> tuple[tuple, jnp.ndarray]:
+    """Prefill one prompt and scatter its KV into the slot's pages. Returns
+    (updated pools, last-token logits [V] f32). Padding positions write into
+    the null page / the tail of the last real page — never attended (masking
+    is by seq_len everywhere)."""
+    page_size = pools[0].shape[2]
+    pb = ids.shape[0]
+    n_pg = pb // page_size
+    layers = cfg.num_layers
+    hkv, hd = cfg.num_kv_heads, cfg.head_dim_
+
+    mask = (jnp.arange(pb) < prompt_len).astype(jnp.float32)[None]
+    positions = jnp.arange(pb, dtype=jnp.int32)[None]
+    cache = make_cache(cfg, 1, pb, dtype=pools[0].dtype)
+    logits, (k_new, v_new) = forward(
+        params, cfg, ids[None], positions, mask, cache=cache, write_idx=0)
+
+    k_r = k_new[:, 0].reshape(layers, n_pg, page_size, hkv, hd)
+    v_r = v_new[:, 0].reshape(layers, n_pg, page_size, hkv, hd)
+    k_pools = pools[0].at[:, page_ids].set(k_r.astype(pools[0].dtype))
+    v_pools = pools[1].at[:, page_ids].set(v_r.astype(pools[1].dtype))
+    last_logits = jax.lax.dynamic_index_in_dim(
+        logits[0], jnp.maximum(prompt_len - 1, 0), axis=0, keepdims=False)
+    return (k_pools, v_pools), last_logits
+
+
 def make_cache(cfg: ModelConfig, batch: int, max_len: int, dtype=None) -> tuple:
     """Allocate a zeroed KV cache: (k, v) each [L, B, S, Hkv, D]."""
     dtype = dtype or cfg.dtype

@@ -1,0 +1,188 @@
+"""Paged decode attention: one query token per sequence over a paged KV pool.
+
+TPU-native replacement for the reference's SGLang paged-KV CUDA decode
+kernels (SURVEY.md §2.2 native-census row 1). The KV cache is a pool of
+fixed-size pages shared by all running sequences; each sequence owns an
+ordered page list (its row of ``page_table``). This is what makes
+continuous batching work: sequences of wildly different lengths share one
+static-shaped pool, so ONE compiled decode step serves every mix of
+requests — no shape buckets, no recompilation as requests come and go.
+
+Two implementations with identical semantics:
+
+- ``paged_attention_ref`` — jnp gather + dense softmax. XLA-compilable
+  everywhere; the correctness oracle and the CPU-test path.
+- ``paged_attention_pallas`` — Pallas TPU kernel. Grid (seq, kv_head,
+  page); the page table is a scalar-prefetch operand, so each grid step's
+  BlockSpec index_map DMAs exactly the page it needs from HBM into VMEM
+  (automatic double-buffering from the pipeline emitter). Online softmax
+  accumulates in VMEM scratch across the page axis; invalid pages are
+  skipped with ``pl.when`` (their index_map points at the reserved null
+  page 0, whose DMA cost is the price of a uniform grid).
+
+Layout notes (why these shapes):
+- pools are [num_pages, page_size, Hkv, D]: page_size×D are the tiled
+  (sublane×lane) dims of each DMA; Hkv is a grid axis so one kernel
+  instance streams a [page_size, D] tile — MXU-shaped for the q·kᵀ matmul.
+- q is pre-reshaped to [S, Hkv, rep, D] (rep = GQA group size): the kernel
+  computes a [rep, page_size] logits tile per page — contraction over D
+  lands on the MXU without any in-kernel head regrouping.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+NEG_INF = float(np.finfo(np.float32).min)
+
+
+def paged_attention_ref(
+    q: jnp.ndarray,        # [S, Hq, D]
+    k_pool: jnp.ndarray,   # [N_pages, page_size, Hkv, D]
+    v_pool: jnp.ndarray,   # [N_pages, page_size, Hkv, D]
+    page_table: jnp.ndarray,  # [S, P] int32 page ids (0 = null page ok)
+    seq_lens: jnp.ndarray,    # [S] int32 valid tokens per sequence
+    scale: float | None = None,
+) -> jnp.ndarray:
+    """Gather-based oracle. Returns [S, Hq, D] in q.dtype."""
+    s, hq, d = q.shape
+    n_pages, ps, hkv, _ = k_pool.shape
+    p = page_table.shape[1]
+    rep = hq // hkv
+    scale = scale if scale is not None else d ** -0.5
+
+    k = k_pool[page_table].reshape(s, p * ps, hkv, d)  # [S, T, Hkv, D]
+    v = v_pool[page_table].reshape(s, p * ps, hkv, d)
+    qr = q.reshape(s, hkv, rep, d).astype(jnp.float32)
+
+    logits = jnp.einsum("shrd,sthd->shrt", qr, k.astype(jnp.float32)) * scale
+    pos = jnp.arange(p * ps)[None, :]  # [1, T]
+    valid = pos < jnp.maximum(seq_lens, 1)[:, None]  # clamp: empty rows stay finite
+    logits = jnp.where(valid[:, None, None, :], logits, NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("shrt,sthd->shrd", probs, v.astype(jnp.float32))
+    return out.reshape(s, hq, d).astype(q.dtype)
+
+
+def _paged_attn_kernel(page_tbl_ref, seq_lens_ref,  # scalar prefetch
+                       q_ref,      # [1, 1, rep, D]
+                       k_ref,      # [1, page_size, 1, D]
+                       v_ref,      # [1, page_size, 1, D]
+                       out_ref,    # [1, 1, rep, D]
+                       m_ref, l_ref, acc_ref,  # VMEM scratch
+                       *, page_size: int, scale: float):
+    import jax.experimental.pallas as pl
+
+    s = pl.program_id(0)
+    p = pl.program_id(2)
+    seq_len = seq_lens_ref[s]
+    n_pages = (jnp.maximum(seq_len, 1) + page_size - 1) // page_size
+
+    @pl.when(p == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    @pl.when(p < n_pages)
+    def _work():
+        q = q_ref[0, 0].astype(jnp.float32)          # [rep, D]
+        k = k_ref[0, :, 0, :].astype(jnp.float32)    # [page_size, D]
+        v = v_ref[0, :, 0, :].astype(jnp.float32)    # [page_size, D]
+
+        logits = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # [rep, page_size]
+        pos = p * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, logits.shape, 1)
+        logits = jnp.where(pos < jnp.maximum(seq_len, 1), logits, NEG_INF)
+
+        rep = logits.shape[0]
+        m_prev = m_ref[:rep, :1]                       # [rep, 1]
+        l_prev = l_ref[:rep, :1]
+        m_cur = jnp.max(logits, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        probs = jnp.exp(logits - m_new)                # [rep, page_size]
+        l_new = alpha * l_prev + jnp.sum(probs, axis=-1, keepdims=True)
+        pv = jax.lax.dot_general(
+            probs, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)        # [rep, D]
+        acc_ref[:rep, :] = acc_ref[:rep, :] * alpha + pv
+        m_ref[:rep, :1] = m_new
+        l_ref[:rep, :1] = l_new
+
+    @pl.when(p == n_pages - 1)
+    def _finish():
+        rep = out_ref.shape[2]
+        out_ref[0, 0] = (
+            acc_ref[:rep, :] / jnp.maximum(l_ref[:rep, :1], 1e-30)
+        ).astype(out_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("scale", "interpret"))
+def paged_attention_pallas(
+    q: jnp.ndarray,
+    k_pool: jnp.ndarray,
+    v_pool: jnp.ndarray,
+    page_table: jnp.ndarray,
+    seq_lens: jnp.ndarray,
+    scale: float | None = None,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    s, hq, d = q.shape
+    n_pool, page_size, hkv, _ = k_pool.shape
+    p = page_table.shape[1]
+    rep = hq // hkv
+    scale = scale if scale is not None else d ** -0.5
+    rep_pad = max(rep, 8)  # f32 sublane tile
+
+    qr = q.reshape(s, hkv, rep, d)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(s, hkv, p),
+        in_specs=[
+            pl.BlockSpec((1, 1, rep, d), lambda si, hi, pi, pt, sl: (si, hi, 0, 0)),
+            pl.BlockSpec((1, page_size, 1, d),
+                         lambda si, hi, pi, pt, sl: (pt[si, pi], 0, hi, 0)),
+            pl.BlockSpec((1, page_size, 1, d),
+                         lambda si, hi, pi, pt, sl: (pt[si, pi], 0, hi, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, rep, d),
+                               lambda si, hi, pi, pt, sl: (si, hi, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((rep_pad, 128), jnp.float32),  # m (col 0 used)
+            pltpu.VMEM((rep_pad, 128), jnp.float32),  # l
+            pltpu.VMEM((rep_pad, d), jnp.float32),    # acc
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(_paged_attn_kernel, page_size=page_size, scale=scale),
+        out_shape=jax.ShapeDtypeStruct((s, hkv, rep, d), q.dtype),
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )(page_table.astype(jnp.int32), seq_lens.astype(jnp.int32), qr, k_pool, v_pool)
+    return out.reshape(s, hq, d)
+
+
+def paged_attention(q, k_pool, v_pool, page_table, seq_lens, scale=None):
+    """Dispatch: Pallas on TPU, gather oracle elsewhere (interpret-mode Pallas
+    is exercised in tests; the oracle is faster for CPU test runs). Override
+    with POLYRL_PAGED_ATTN=ref|pallas."""
+    impl = os.environ.get("POLYRL_PAGED_ATTN", "")
+    if impl == "ref":
+        return paged_attention_ref(q, k_pool, v_pool, page_table, seq_lens, scale)
+    if impl == "pallas" or jax.default_backend() == "tpu":
+        return paged_attention_pallas(
+            q, k_pool, v_pool, page_table, seq_lens, scale,
+            interpret=jax.default_backend() != "tpu")
+    return paged_attention_ref(q, k_pool, v_pool, page_table, seq_lens, scale)

@@ -1,0 +1,506 @@
+"""Continuous-batching rollout engine over a paged KV pool.
+
+TPU-native equivalent of SGLang's continuous-batching scheduler + paged KV
+runtime that the reference builds its rollout layer on (SURVEY.md §2.2
+native-census row 1; queue-depth telemetry patches.py:423-425; abort
+sglang_http_async_engine.py:286-298). Design:
+
+- ONE compiled decode step for every request mix: a fixed array of ``S``
+  slots; per-slot sampling params (temperature/top-p/top-k/stop tokens) are
+  traced arrays, so admission never recompiles (contrast the bucketed v0
+  ``StepDecoder`` which compiles per sampling group).
+- Paged KV: slots own page lists from a shared pool
+  (``decoder.make_paged_pools``); attention is
+  ``ops.paged_attention`` (Pallas on TPU). No shape buckets in decode.
+- Admission: prompts prefill one-at-a-time into their slot's pages
+  (compiled per prompt bucket), then join the decode batch — requests
+  stream in and out continuously.
+- The host loop uploads the small per-slot control arrays each step and
+  fetches (token, logprob, done) — the same per-token host round-trip the
+  streaming serving path already pays, now amortized over all slots.
+
+Weight hot-swap = atomic ``self.params`` swap between steps (buffer shapes
+and shardings unchanged → no recompilation), mirroring the reference's
+update_weights_from_tensor contract. ``release_memory`` frees the KV pool
+when idle — the TPU analogue of SGLang's release_memory_occupation for
+colocated time-slicing.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import logging
+import queue
+import threading
+import time
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from polyrl_tpu.models import decoder
+from polyrl_tpu.rollout.engine import next_bucket
+from polyrl_tpu.rollout.sampling import SamplingParams, sample_token_vec
+
+log = logging.getLogger(__name__)
+
+STREAM_END = object()  # terminal marker on every request's output queue
+
+MAX_STOP_TOKENS = 8
+
+
+@dataclasses.dataclass
+class _Request:
+    rid: str
+    input_ids: list[int]
+    sampling: SamplingParams
+    out: queue.Queue
+    abort: Any  # threading.Event-like or None
+
+
+@dataclasses.dataclass
+class _SlotInfo:
+    req: _Request
+    pages: list[int]
+    stop_set: set
+
+
+class PageAllocator:
+    """Free-list allocator over pages 1..n-1 (page 0 = reserved null page)."""
+
+    def __init__(self, num_pages: int):
+        self.num_pages = num_pages
+        self._free = list(range(num_pages - 1, 0, -1))
+
+    @property
+    def free_count(self) -> int:
+        return len(self._free)
+
+    def alloc(self, n: int) -> list[int] | None:
+        if n > len(self._free):
+            return None
+        out = self._free[-n:]
+        del self._free[-n:]
+        return out
+
+    def free(self, pages: list[int]) -> None:
+        self._free.extend(pages)
+
+
+class CBEngine:
+    """Continuous-batching engine; drop-in serving backend for RolloutServer."""
+
+    def __init__(
+        self,
+        cfg: decoder.ModelConfig,
+        params: Any,
+        max_slots: int = 64,
+        page_size: int = 64,
+        num_pages: int | None = None,
+        max_seq_len: int = 8192,
+        prompt_buckets: tuple[int, ...] = (128, 256, 512, 1024, 2048, 4096),
+        kv_cache_dtype=jnp.bfloat16,
+        pad_token_id: int = 0,
+        seed: int = 0,
+    ):
+        assert all(b % page_size == 0 for b in prompt_buckets), \
+            "prompt buckets must be page-aligned"
+        self.cfg = cfg
+        self.params = params
+        self.max_slots = max_slots
+        self.page_size = page_size
+        self.max_seq_len = max_seq_len
+        self.pages_per_slot = -(-max_seq_len // page_size)
+        # default pool: enough for half the slots at full length + slack
+        self.num_pages = num_pages or (max_slots * self.pages_per_slot // 2 + 1)
+        self.prompt_buckets = prompt_buckets
+        self.kv_cache_dtype = kv_cache_dtype
+        self.pad_token_id = pad_token_id
+
+        s, p = max_slots, self.pages_per_slot
+        self._page_table = np.zeros((s, p), np.int32)
+        self._seq_lens = np.zeros((s,), np.int32)
+        self._last_tokens = np.full((s,), pad_token_id, np.int32)
+        self._n_generated = np.zeros((s,), np.int32)
+        self._budgets = np.zeros((s,), np.int32)
+        self._active = np.zeros((s,), bool)
+        self._temps = np.ones((s,), np.float32)
+        self._top_ps = np.ones((s,), np.float32)
+        self._top_ks = np.zeros((s,), np.int32)
+        self._stop_table = np.full((s, MAX_STOP_TOKENS), -1, np.int32)
+        self._slots: list[_SlotInfo | None] = [None] * s
+
+        self.allocator = PageAllocator(self.num_pages)
+        self._pools = decoder.make_paged_pools(
+            cfg, self.num_pages, page_size, dtype=kv_cache_dtype)
+        self._rng = jax.random.PRNGKey(seed)
+
+        self._queue: "queue.Queue[_Request]" = queue.Queue()
+        self._pending: collections.deque = collections.deque()
+        self._stop = threading.Event()
+        self._paused = threading.Event()
+        self._idle = threading.Event()
+        self._idle.set()
+        # serializes pool use (admit/step) against release_memory freeing it
+        self._pool_lock = threading.Lock()
+        self._loop_thread: threading.Thread | None = None
+
+        self._step_fns: dict = {}
+        self._prefill_fns: dict = {}
+
+        # serving telemetry (server_info contract)
+        self.weight_version = 0
+        self.num_running = 0
+        self.num_queued = 0
+        self.last_gen_throughput = 0.0
+        self._tok_window: collections.deque = collections.deque(maxlen=64)
+
+    # -- compiled pieces ----------------------------------------------------
+
+    def _get_step(self, use_filters: bool):
+        if use_filters not in self._step_fns:
+            cfg, pad = self.cfg, self.pad_token_id
+
+            def step(params, kp, vp, rng, page_table, seq_lens, last_tokens,
+                     n_generated, budgets, active, temps, top_ps, top_ks,
+                     stop_table):
+                logits, (kp, vp) = decoder.forward_paged_decode(
+                    params, cfg, last_tokens, seq_lens, (kp, vp),
+                    page_table, seq_lens)
+                rng, sub = jax.random.split(rng)
+                token, logp = sample_token_vec(
+                    logits, sub, temps, top_ps, top_ks, use_filters=use_filters)
+                n_gen = n_generated + active.astype(jnp.int32)
+                hit_stop = jnp.any(token[:, None] == stop_table, axis=-1)
+                done = active & (hit_stop | (n_gen >= budgets))
+                token = jnp.where(active, token, pad)
+                logp = jnp.where(active, logp, 0.0)
+                return kp, vp, rng, token, logp, done
+
+            self._step_fns[use_filters] = jax.jit(
+                step, donate_argnums=(1, 2), static_argnames=())
+        return self._step_fns[use_filters]
+
+    def _get_prefill(self, pb: int):
+        if pb not in self._prefill_fns:
+            cfg = self.cfg
+
+            def prefill(params, kp, vp, ids, prompt_len, page_ids, rng,
+                        temp, top_p, top_k):
+                (kp, vp), last_logits = decoder.prefill_into_pages(
+                    params, cfg, ids, prompt_len, (kp, vp), page_ids)
+                rng, sub = jax.random.split(rng)
+                token, logp = sample_token_vec(
+                    last_logits[None], sub, temp[None], top_p[None],
+                    top_k[None], use_filters=True)
+                return kp, vp, rng, token[0], logp[0]
+
+            self._prefill_fns[pb] = jax.jit(prefill, donate_argnums=(1, 2))
+        return self._prefill_fns[pb]
+
+    # -- submission API (server-facing) -------------------------------------
+
+    def submit(self, rid: str, input_ids: list[int], sampling: SamplingParams,
+               out: queue.Queue | None = None, abort=None) -> queue.Queue:
+        out = out if out is not None else queue.Queue()
+        self._queue.put(_Request(rid, list(input_ids), sampling, out, abort))
+        self.num_queued = self._queue.qsize() + len(self._pending)
+        return out
+
+    def start(self) -> "CBEngine":
+        if self._loop_thread is None:
+            self._loop_thread = threading.Thread(target=self._loop, daemon=True)
+            self._loop_thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._loop_thread is not None:
+            self._loop_thread.join(timeout=10.0)
+        # every in-flight and queued request must still see a terminal line +
+        # STREAM_END or its HTTP handler thread blocks forever
+        self._fail_all("engine shutdown")
+        self._drain_queue()
+        while self._pending:
+            self._emit_error(self._pending.popleft(), "engine shutdown")
+
+    # -- weight / memory lifecycle ------------------------------------------
+
+    def update_weights(self, params: Any, version: int | None = None) -> None:
+        # atomic ref swap; the loop picks it up on its next step (shapes and
+        # shardings identical → the compiled step keeps working)
+        self.params = params
+        self.weight_version = self.weight_version + 1 if version is None else version
+
+    def release_memory(self) -> None:
+        """Pause serving and, once the decode batch drains, free the KV pool
+        (real HBM release for colocated time-slicing — the manager aborts
+        in-flight requests first, handlers.rs:500-513)."""
+        self._paused.set()
+        if self._idle.wait(timeout=30.0):
+            with self._pool_lock:
+                if not self._active.any():
+                    self._pools = None
+
+    def resume_memory(self) -> None:
+        with self._pool_lock:
+            if self._pools is None:
+                self._pools = decoder.make_paged_pools(
+                    self.cfg, self.num_pages, self.page_size,
+                    dtype=self.kv_cache_dtype)
+        self._paused.clear()
+
+    # -- engine loop ---------------------------------------------------------
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self._loop_iter()
+            except Exception:  # noqa: BLE001 — loop must survive anything:
+                # a dead loop wedges every connected HTTP handler forever
+                log.exception("engine iteration failed; resetting")
+                self._recover()
+
+    def _loop_iter(self) -> None:
+        if self._paused.is_set():
+            self._idle.set()
+            time.sleep(0.02)
+            return
+        self._drain_queue()
+        if not self._pending and not self._active.any():
+            self._idle.set()
+            try:
+                self._pending.append(self._queue.get(timeout=0.05))
+            except queue.Empty:
+                pass
+            return
+        self._idle.clear()
+        with self._pool_lock:
+            if self._paused.is_set():  # raced with release_memory
+                return
+            self._admit()
+            if self._active.any():
+                self._step_once()
+            elif self._pending:
+                time.sleep(0.005)  # pending but blocked on pages/slots
+
+    def _recover(self) -> None:
+        """After any jit failure the pools may have been donated to the dead
+        call; fail everything and reallocate so serving can continue."""
+        self._fail_all("engine error")
+        with self._pool_lock:
+            self._pools = decoder.make_paged_pools(
+                self.cfg, self.num_pages, self.page_size,
+                dtype=self.kv_cache_dtype)
+
+    def _drain_queue(self) -> None:
+        while True:
+            try:
+                self._pending.append(self._queue.get_nowait())
+            except queue.Empty:
+                break
+        self.num_queued = len(self._pending)
+
+    def _admit(self) -> None:
+        while self._pending:
+            free_slots = np.flatnonzero(~self._active & np.asarray(
+                [s is None for s in self._slots]))
+            if len(free_slots) == 0:
+                return
+            req = self._pending[0]
+            if req.abort is not None and req.abort.is_set():
+                self._pending.popleft()
+                self._emit_abort(req)
+                continue
+            n_prompt = len(req.input_ids)
+            if n_prompt == 0 or n_prompt > min(self.max_seq_len - 1,
+                                               self.prompt_buckets[-1]):
+                self._pending.popleft()
+                self._emit_error(req, f"prompt length {n_prompt} unsupported")
+                continue
+            budget = min(req.sampling.max_new_tokens,
+                         self.max_seq_len - n_prompt)
+            n_pages = -(-(n_prompt + budget) // self.page_size)
+            pages = self.allocator.alloc(n_pages)
+            if pages is None:
+                return  # head-of-line waits for pages to free
+            self._pending.popleft()
+            try:
+                self._prefill_request(int(free_slots[0]), req, pages, budget)
+            except Exception:
+                self.allocator.free(pages)
+                self._emit_error(req, "prefill failed")
+                raise  # pools may be donation-poisoned: let _recover reset
+        self.num_queued = len(self._pending)
+
+    def _prefill_request(self, slot: int, req: _Request, pages: list[int],
+                         budget: int) -> None:
+        n_prompt = len(req.input_ids)
+        pb = next_bucket(n_prompt, self.prompt_buckets)
+        n_prompt_pages = -(-n_prompt // self.page_size)
+        page_ids = np.zeros((pb // self.page_size,), np.int32)
+        page_ids[:n_prompt_pages] = pages[:n_prompt_pages]
+        ids = np.full((pb,), self.pad_token_id, np.int32)
+        ids[:n_prompt] = req.input_ids
+
+        sp = req.sampling
+        fn = self._get_prefill(pb)
+        kp, vp, self._rng, token, logp = fn(
+            self.params, self._pools[0], self._pools[1], jnp.asarray(ids),
+            jnp.int32(n_prompt), jnp.asarray(page_ids), self._rng,
+            jnp.float32(sp.temperature), jnp.float32(sp.top_p),
+            jnp.int32(sp.top_k))
+        self._pools = (kp, vp)
+        token, logp = int(token), float(logp)
+
+        stop_set = set(sp.stop_token_ids)
+        finished = token in stop_set or budget <= 1
+        reason = ("stop" if token in stop_set else
+                  "length" if finished else "")
+        req.out.put({"token_ids": [token], "logprobs": [logp],
+                     "finished": finished, "finish_reason": reason})
+        self._count_tokens(1)
+        if finished:
+            req.out.put(STREAM_END)
+            self.allocator.free(pages)
+            return
+
+        row = np.zeros((self.pages_per_slot,), np.int32)
+        row[:len(pages)] = pages
+        self._page_table[slot] = row
+        self._seq_lens[slot] = n_prompt
+        self._last_tokens[slot] = token
+        self._n_generated[slot] = 1
+        self._budgets[slot] = budget
+        self._active[slot] = True
+        self._temps[slot] = sp.temperature
+        self._top_ps[slot] = sp.top_p
+        self._top_ks[slot] = sp.top_k
+        # device table holds the first MAX_STOP_TOKENS in request order
+        # (deterministic); the host check in _step_once covers any overflow
+        stops = np.full((MAX_STOP_TOKENS,), -1, np.int32)
+        for i, t in enumerate(sp.stop_token_ids[:MAX_STOP_TOKENS]):
+            stops[i] = t
+        self._stop_table[slot] = stops
+        self._slots[slot] = _SlotInfo(req, pages, stop_set)
+
+    def _step_once(self) -> None:
+        # host-side aborts flip slots inactive BEFORE the step
+        for i, info in enumerate(self._slots):
+            if info is None or not self._active[i]:
+                continue
+            if info.req.abort is not None and info.req.abort.is_set():
+                self._active[i] = False
+                self._emit_abort(info.req, emit_line=True)
+                self._finalize(i)
+
+        if not self._active.any():
+            return
+        use_filters = bool(np.any(
+            (self._top_ps[self._active] < 1.0) | (self._top_ks[self._active] > 0)))
+        fn = self._get_step(use_filters)
+        kp, vp, self._rng, token, logp, done = fn(
+            self.params, self._pools[0], self._pools[1], self._rng,
+            jnp.asarray(self._page_table), jnp.asarray(self._seq_lens),
+            jnp.asarray(self._last_tokens), jnp.asarray(self._n_generated),
+            jnp.asarray(self._budgets), jnp.asarray(self._active),
+            jnp.asarray(self._temps), jnp.asarray(self._top_ps),
+            jnp.asarray(self._top_ks), jnp.asarray(self._stop_table))
+        self._pools = (kp, vp)
+        token = np.asarray(token)
+        logp = np.asarray(logp)
+        done = np.asarray(done)
+
+        n_emitted = 0
+        for i in np.flatnonzero(self._active):
+            info = self._slots[i]
+            t = int(token[i])
+            # host check is authoritative: covers stop tokens beyond the
+            # MAX_STOP_TOKENS device table
+            fin = bool(done[i]) or t in info.stop_set
+            reason = ""
+            if fin:
+                reason = "stop" if t in info.stop_set else "length"
+            info.req.out.put({"token_ids": [t], "logprobs": [float(logp[i])],
+                              "finished": fin, "finish_reason": reason})
+            n_emitted += 1
+            self._seq_lens[i] += 1
+            self._last_tokens[i] = t
+            self._n_generated[i] += 1
+            if fin:
+                info.req.out.put(STREAM_END)
+                self._active[i] = False
+                self._finalize(i)
+        self._count_tokens(n_emitted)
+        self.num_running = int(self._active.sum())
+
+    def _finalize(self, slot: int) -> None:
+        info = self._slots[slot]
+        if info is not None:
+            self.allocator.free(info.pages)
+        self._slots[slot] = None
+        self._page_table[slot] = 0
+        self._seq_lens[slot] = 0
+        self._last_tokens[slot] = self.pad_token_id
+        self._n_generated[slot] = 0
+        self._budgets[slot] = 0
+
+    # -- emission helpers ----------------------------------------------------
+
+    def _emit_abort(self, req: _Request, emit_line: bool = True) -> None:
+        if emit_line:
+            req.out.put({"token_ids": [], "logprobs": [], "finished": True,
+                         "finish_reason": "abort"})
+        req.out.put(STREAM_END)
+
+    def _emit_error(self, req: _Request, msg: str) -> None:
+        req.out.put({"token_ids": [], "logprobs": [], "finished": True,
+                     "finish_reason": "error", "error": msg})
+        req.out.put(STREAM_END)
+
+    def _fail_all(self, msg: str) -> None:
+        for i in np.flatnonzero(self._active):
+            info = self._slots[i]
+            self._active[i] = False
+            if info is not None:
+                self._emit_error(info.req, msg)
+            self._finalize(i)
+
+    def _count_tokens(self, n: int) -> None:
+        now = time.monotonic()
+        self._tok_window.append((now, n))
+        horizon = now - 10.0
+        toks = sum(c for t, c in self._tok_window if t >= horizon)
+        t_old = min((t for t, _ in self._tok_window if t >= horizon), default=now)
+        dt = now - t_old
+        self.last_gen_throughput = toks / dt if dt > 0 else 0.0
+
+    # -- convenience (tests / bench) ----------------------------------------
+
+    def generate(self, prompt_ids: list[list[int]], sampling: SamplingParams,
+                 timeout: float = 300.0) -> list[dict]:
+        """Synchronous batch generate: submit all, run the loop inline if not
+        started, collect full sequences. Returns per-prompt dicts with
+        token_ids / logprobs / finish_reason."""
+        outs = [self.submit(f"gen-{i}", p, sampling)
+                for i, p in enumerate(prompt_ids)]
+        self.start()
+        results = []
+        deadline = time.monotonic() + timeout
+        for out_q in outs:
+            toks: list[int] = []
+            lps: list[float] = []
+            reason = "error"
+            while True:
+                item = out_q.get(timeout=max(0.0, deadline - time.monotonic()))
+                if item is STREAM_END:
+                    break
+                toks.extend(item["token_ids"])
+                lps.extend(item["logprobs"])
+                if item["finished"]:
+                    reason = item["finish_reason"]
+            results.append({"token_ids": toks, "logprobs": lps,
+                            "finish_reason": reason})
+        return results

@@ -63,6 +63,46 @@ def apply_top_p(logits: jnp.ndarray, p: float) -> jnp.ndarray:
     return jnp.where(logits < threshold, NEG_INF, logits)
 
 
+def sample_token_vec(
+    logits: jnp.ndarray,  # [S, V] f32
+    rng: jax.Array,
+    temps: jnp.ndarray,   # [S] f32; 0 = greedy
+    top_ps: jnp.ndarray,  # [S] f32; 1 = disabled
+    top_ks: jnp.ndarray,  # [S] int32; 0 = disabled
+    use_filters: bool = True,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Per-row sampling params as TRACED arrays — the continuous-batching
+    engine mixes requests with different sampling configs in one compiled
+    step (the reference gets this from SGLang's per-request sampler). Set
+    ``use_filters=False`` (static) to skip the two [S, V] sorts when every
+    live request runs plain temperature sampling."""
+    greedy_tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    greedy_logp = jnp.take_along_axis(
+        jax.nn.log_softmax(logits, axis=-1), greedy_tok[:, None], axis=-1)[:, 0]
+
+    scaled = logits / jnp.maximum(temps, 1e-6)[:, None]
+    if use_filters:
+        v = logits.shape[-1]
+        sorted_desc = jnp.sort(scaled, axis=-1)[:, ::-1]
+        idx_k = jnp.clip(top_ks - 1, 0, v - 1)
+        thr_k = jnp.take_along_axis(sorted_desc, idx_k[:, None], axis=-1)
+        scaled = jnp.where((top_ks[:, None] > 0) & (scaled < thr_k), NEG_INF, scaled)
+        sorted2 = jnp.sort(scaled, axis=-1)[:, ::-1]
+        probs = jax.nn.softmax(sorted2, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        kept = jnp.sum(cum - probs < top_ps[:, None], axis=-1, keepdims=True)
+        thr_p = jnp.take_along_axis(sorted2, jnp.maximum(kept - 1, 0), axis=-1)
+        scaled = jnp.where(scaled < thr_p, NEG_INF, scaled)
+    logp_all = jax.nn.log_softmax(scaled, axis=-1)
+    tok = jax.random.categorical(rng, scaled, axis=-1).astype(jnp.int32)
+    logp = jnp.take_along_axis(logp_all, tok[:, None], axis=-1)[:, 0]
+
+    is_greedy = temps <= 0.0
+    token = jnp.where(is_greedy, greedy_tok, tok)
+    logp = jnp.where(is_greedy, greedy_logp, logp)
+    return token, logp
+
+
 def sample_token(
     logits: jnp.ndarray,  # [B, V] f32
     rng: jax.Array,

@@ -29,25 +29,42 @@ def create_server(model: str, manager_endpoint: str | None = None,
                   batch_buckets: tuple[int, ...] | None = None,
                   prompt_buckets: tuple[int, ...] | None = None,
                   is_local: bool = False,
-                  model_overrides: dict | None = None):
-    """Build engine + server, register with the manager, attach receiver."""
+                  model_overrides: dict | None = None,
+                  backend: str = "cb",
+                  max_slots: int = 64,
+                  page_size: int = 64,
+                  max_seq_len: int = 16384,
+                  num_pages: int | None = None):
+    """Build engine + server, register with the manager, attach receiver.
+
+    ``backend="cb"`` (default) serves with the paged continuous-batching
+    engine; ``backend="step"`` keeps the bucketed v0 StepDecoder path."""
     import jax
     import jax.numpy as jnp
 
     from polyrl_tpu.models import decoder
+    from polyrl_tpu.rollout.cb_engine import CBEngine
     from polyrl_tpu.rollout.engine import RolloutEngine
     from polyrl_tpu.rollout.server import RolloutServer
 
     cfg = decoder.get_config(model, dtype=getattr(jnp, dtype),
                              **(model_overrides or {}))
     params = jax.jit(lambda: decoder.init_params(jax.random.PRNGKey(seed), cfg))()
-    kwargs = {}
-    if batch_buckets:
-        kwargs["batch_buckets"] = tuple(batch_buckets)
-    if prompt_buckets:
-        kwargs["prompt_buckets"] = tuple(prompt_buckets)
-    engine = RolloutEngine(cfg, params, pad_token_id=0,
-                           kv_cache_dtype=getattr(jnp, dtype), **kwargs)
+    if backend == "cb":
+        engine = CBEngine(
+            cfg, params, pad_token_id=0, kv_cache_dtype=getattr(jnp, dtype),
+            max_slots=max_slots, page_size=page_size, max_seq_len=max_seq_len,
+            num_pages=num_pages,
+            prompt_buckets=tuple(prompt_buckets) if prompt_buckets
+            else (128, 256, 512, 1024, 2048, 4096), seed=seed)
+    else:
+        kwargs = {}
+        if batch_buckets:
+            kwargs["batch_buckets"] = tuple(batch_buckets)
+        if prompt_buckets:
+            kwargs["prompt_buckets"] = tuple(prompt_buckets)
+        engine = RolloutEngine(cfg, params, pad_token_id=0,
+                               kv_cache_dtype=getattr(jnp, dtype), **kwargs)
     server = RolloutServer(engine, host=host, port=port,
                            advertise_host=advertise_host).start()
 
@@ -94,13 +111,21 @@ def main() -> None:
     p.add_argument("--is-local", action="store_true",
                    help="register as a colocated (time-sliced) instance")
     p.add_argument("--transfer-streams", type=int, default=4)
+    p.add_argument("--backend", default="cb", choices=("cb", "step"),
+                   help="cb = paged continuous batching, step = bucketed v0")
+    p.add_argument("--max-slots", type=int, default=64)
+    p.add_argument("--page-size", type=int, default=64)
+    p.add_argument("--max-seq-len", type=int, default=16384)
     args = p.parse_args()
 
     logging.basicConfig(level=logging.INFO)
     server = create_server(args.model, args.manager_endpoint, host=args.host,
                            port=args.port, advertise_host=args.advertise_host,
                            dtype=args.dtype, is_local=args.is_local,
-                           transfer_streams=args.transfer_streams)
+                           transfer_streams=args.transfer_streams,
+                           backend=args.backend, max_slots=args.max_slots,
+                           page_size=args.page_size,
+                           max_seq_len=args.max_seq_len)
     log.info("rollout server on %s", server.endpoint)
     try:
         while True:

@@ -37,12 +37,15 @@ from typing import Any
 import jax
 import numpy as np
 
+from polyrl_tpu.rollout.cb_engine import STREAM_END
 from polyrl_tpu.rollout.sampling import SamplingParams
 from polyrl_tpu.rollout.stepper import StepDecoder
 
 log = logging.getLogger(__name__)
 
-_SENTINEL = object()
+# one terminal marker shared with the CB engine so either backend can feed
+# the same per-request output queues
+_SENTINEL = STREAM_END
 
 
 @dataclasses.dataclass
@@ -61,8 +64,12 @@ class RolloutServer:
                  max_batch: int | None = None, batch_wait_s: float = 0.01,
                  advertise_host: str = "127.0.0.1"):
         self.engine = engine
-        self.stepper = StepDecoder(engine)
-        self.max_batch = max_batch or max(engine.batch_buckets)
+        # backend dispatch: a CBEngine admits requests itself (continuous
+        # batching); the v0 RolloutEngine is driven through StepDecoder by
+        # this server's grouping batch loop
+        self.cb = hasattr(engine, "submit")
+        self.stepper = None if self.cb else StepDecoder(engine)
+        self.max_batch = max_batch or max(getattr(engine, "batch_buckets", (64,)))
         self.batch_wait_s = batch_wait_s
         self._queue: "queue.Queue[_PendingRequest]" = queue.Queue()
         self._aborts: dict[str, threading.Event] = {}
@@ -159,13 +166,18 @@ class RolloutServer:
     # -- lifecycle ----------------------------------------------------------
 
     def start(self) -> "RolloutServer":
-        self._loop_thread = threading.Thread(target=self._batch_loop, daemon=True)
-        self._loop_thread.start()
+        if self.cb:
+            self.engine.start()
+        else:
+            self._loop_thread = threading.Thread(target=self._batch_loop, daemon=True)
+            self._loop_thread.start()
         threading.Thread(target=self._http.serve_forever, daemon=True).start()
         return self
 
     def stop(self) -> None:
         self._stop.set()
+        if self.cb:
+            self.engine.stop()
         if self.receiver is not None:
             self.receiver.stop()
         self._http.shutdown()
@@ -186,7 +198,10 @@ class RolloutServer:
                 out.put(_SENTINEL)
                 return out
             self._aborts[rid] = abort
-        self._queue.put(_PendingRequest(rid, input_ids, sp, out, abort))
+        if self.cb:
+            self.engine.submit(rid, input_ids, sp, out=out, abort=abort)
+        else:
+            self._queue.put(_PendingRequest(rid, input_ids, sp, out, abort))
         return out
 
     def abort_request(self, rid: str | None) -> None:
@@ -297,7 +312,8 @@ class RolloutServer:
     def server_info(self) -> dict:
         return {
             "num_running_reqs": self.engine.num_running,
-            "num_queued_reqs": self._queue.qsize(),
+            "num_queued_reqs": (self.engine.num_queued if self.cb
+                                else self._queue.qsize()),
             "last_gen_throughput": self.engine.last_gen_throughput,
             "weight_version": self.engine.weight_version,
         }

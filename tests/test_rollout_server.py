@@ -22,9 +22,12 @@ MODEL_KW = dict(
 )
 
 
-@pytest.fixture(scope="module")
-def server():
-    srv = create_server(host="127.0.0.1", **MODEL_KW)
+@pytest.fixture(scope="module", params=["step", "cb"])
+def server(request):
+    kw = dict(MODEL_KW)
+    if request.param == "cb":
+        kw.update(page_size=8, max_slots=4, max_seq_len=1024)
+    srv = create_server(host="127.0.0.1", backend=request.param, **kw)
     yield srv
     srv.stop()
 
