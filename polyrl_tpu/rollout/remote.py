@@ -1,0 +1,159 @@
+"""RemoteRollout — the trainer-side adapter for disaggregated generation.
+
+TPU-native equivalent of the reference's C5 ``SGLangRolloutRemote`` +
+C7 ``StreamingBatchIterator`` (``sglang_rollout_remote.py:227-508``,
+``stream_batch_iter.py:19-103``): the trainer hands it the unrolled prompt
+batch (n samples per prompt); it streams the batch through the manager's
+``/batch_generate_requests`` NDJSON endpoint and yields *complete prompt
+groups* as soon as they finish — at least ``min_emit`` trajectories per
+yield — so training on early ibatches overlaps generation of later ones
+(the streaming overlap that is PolyRL's core idea, SURVEY.md §3.1).
+
+Group integrity: GRPO/RLOO advantages are group-relative, so a group whose
+members are split across ibatches would silently normalize against a
+partial group. Groups are emitted whole; a group containing a permanently
+failed request (manager exhausted its 5 continuation retries) is dropped
+with a warning — the trainer's stream accounting tolerates a short batch.
+
+Weight push rides the transfer fabric (C10-C13 equivalents in
+``polyrl_tpu.transfer``): ``update_weights`` bumps the manager's weight
+version (draining the active pool) and hands the params to the sender
+agent, returning the new version.
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+import time
+from typing import Any, Iterator
+
+import numpy as np
+
+from polyrl_tpu.manager.client import GenerateResult, ManagerClient
+from polyrl_tpu.rollout.sampling import SamplingParams
+
+log = logging.getLogger(__name__)
+
+
+class RemoteRollout:
+    def __init__(
+        self,
+        manager: ManagerClient,
+        transfer=None,               # TransferInterface (trainer-side fabric)
+        local_server=None,           # colocated RolloutServer (time-sliced)
+        pad_token_id: int = 0,
+    ):
+        self.manager = manager
+        self.transfer = transfer
+        self.local_server = local_server
+        self.pad_token_id = pad_token_id
+        self.weight_version = 0
+        self.last_gen_throughput = 0.0
+        self.dropped_groups = 0
+
+    # -- streaming generation ------------------------------------------------
+
+    def generate_stream(
+        self,
+        prompt_ids: list[list[int]],
+        sampling: SamplingParams,
+        group_size: int,
+        min_emit: int,
+        max_local_gen_s: float | None = None,
+    ) -> Iterator[list[tuple[int, GenerateResult]]]:
+        """Yield lists of (original_index, result) covering whole groups,
+        ≥ ``min_emit`` entries per yield (except the final remainder).
+        Requests ``i*group_size .. (i+1)*group_size-1`` form group ``i``.
+        ``min_emit`` need not divide by group_size — emission granularity is
+        whole groups, the threshold just gates when to flush."""
+        assert len(prompt_ids) % group_size == 0
+        reqs = [{"rid": str(i), "input_ids": list(p),
+                 "sampling_params": {
+                     "temperature": sampling.temperature,
+                     "top_p": sampling.top_p,
+                     "top_k": sampling.top_k,
+                     "max_new_tokens": sampling.max_new_tokens,
+                     "stop_token_ids": list(sampling.stop_token_ids),
+                 }}
+                for i, p in enumerate(prompt_ids)]
+
+        q: "queue.Queue[Any]" = queue.Queue()
+
+        def reader() -> None:
+            # drains the NDJSON stream so the manager is never backpressured
+            # by training compute (reference stream_batch_iter drain loop)
+            try:
+                for res in self.manager.batch_generate_stream(
+                        reqs, max_local_gen_s=max_local_gen_s):
+                    q.put(res)
+                q.put(None)
+            except Exception as exc:  # noqa: BLE001
+                q.put(exc)
+
+        t = threading.Thread(target=reader, daemon=True)
+        t.start()
+        gen_t0 = time.monotonic()
+        n_tokens = 0
+
+        groups: dict[int, list[tuple[int, GenerateResult]]] = {}
+        failed_groups: set[int] = set()
+        pending: list[tuple[int, GenerateResult]] = []
+        while True:
+            item = q.get()
+            if item is None:
+                break
+            if isinstance(item, Exception):
+                raise item
+            res: GenerateResult = item
+            idx = int(res.rid)
+            g = idx // group_size
+            if g in failed_groups:
+                continue
+            if not res.success:
+                log.warning("group %d dropped: request %d failed: %s",
+                            g, idx, res.error)
+                failed_groups.add(g)
+                groups.pop(g, None)
+                self.dropped_groups += 1
+                continue
+            n_tokens += len(res.output_token_ids)
+            groups.setdefault(g, []).append((idx, res))
+            if len(groups[g]) == group_size:
+                pending.extend(sorted(groups.pop(g)))
+                if len(pending) >= min_emit:
+                    yield pending
+                    pending = []
+        if groups:  # stream ended with incomplete groups (should not happen)
+            log.warning("%d groups incomplete at stream end", len(groups))
+            self.dropped_groups += len(groups)
+        elapsed = time.monotonic() - gen_t0
+        self.last_gen_throughput = n_tokens / elapsed if elapsed > 0 else 0.0
+        if pending:
+            yield pending
+
+    # -- weight + metrics plane ----------------------------------------------
+
+    def update_weights(self, params: Any, version: int | None = None) -> int:
+        """Push new weights to every rollout instance through the fabric
+        (§3.3 end-to-end). Falls back to a bare version bump when no fabric
+        is attached (pure local serving)."""
+        if self.transfer is not None:
+            self.weight_version = self.transfer.update_weights_with_agent(params)
+        else:
+            self.weight_version = self.manager.update_weight_version()
+            if self.local_server is not None:
+                self.local_server.engine.update_weights(
+                    params, version=self.weight_version)
+        return self.weight_version
+
+    def update_metrics(self, **stats) -> dict:
+        """Feed step stats to the manager's adaptive balancer; returns its
+        response incl. the next local-generation budget (handlers.rs:867-901
+        equivalent)."""
+        try:
+            return self.manager.update_metrics(**stats)
+        except Exception:  # noqa: BLE001 — metrics are best-effort
+            log.exception("update_metrics failed")
+            return {}

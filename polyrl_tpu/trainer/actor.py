@@ -172,6 +172,24 @@ class StreamActor:
         )
         return metrics
 
+    def flush_opt_step(self) -> dict:
+        """Apply accumulated grads without new data — the stream trainer's
+        final flush when a short batch (dropped groups) ends mid-minibatch."""
+        if not hasattr(self, "_flush_fn"):
+            optimizer = self.optimizer
+
+            def flush(params, opt_state, accum_grads):
+                updates, opt_state = optimizer.update(accum_grads, opt_state, params)
+                params = optax.apply_updates(params, updates)
+                gn = optax.global_norm(accum_grads)
+                accum_grads = jax.tree_util.tree_map(jnp.zeros_like, accum_grads)
+                return params, opt_state, accum_grads, gn
+
+            self._flush_fn = jax.jit(flush, donate_argnums=(0, 1, 2))
+        self.params, self.opt_state, self.accum_grads, gn = self._flush_fn(
+            self.params, self.opt_state, self.accum_grads)
+        return {"actor/grad_norm": gn}
+
     def compute_log_prob(self, batch: dict, compute_entropy: bool = True):
         """Old-logprob pass (no grad). Returns (logprobs, entropy|None)."""
         if compute_entropy not in self._logprob_fns:

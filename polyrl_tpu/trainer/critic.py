@@ -117,6 +117,23 @@ class StreamCritic:
         )
         return metrics
 
+    def flush_opt_step(self) -> dict:
+        """Apply accumulated grads without new data (see StreamActor)."""
+        if not hasattr(self, "_flush_fn"):
+            optimizer = self.optimizer
+
+            def flush(params, opt_state, accum):
+                updates, opt_state = optimizer.update(accum, opt_state, params)
+                params = optax.apply_updates(params, updates)
+                gn = optax.global_norm(accum)
+                accum = jax.tree_util.tree_map(jnp.zeros_like, accum)
+                return params, opt_state, accum, gn
+
+            self._flush_fn = jax.jit(flush, donate_argnums=(0, 1, 2))
+        self.params, self.opt_state, self.accum_grads, gn = self._flush_fn(
+            self.params, self.opt_state, self.accum_grads)
+        return {"critic/grad_norm": gn}
+
     def compute_values(self, batch: dict) -> jnp.ndarray:
         if self._value_fn is None:
             self._value_fn = jax.jit(

@@ -9,10 +9,15 @@ cumulative minibatch boundaries (reference :500-568); weights push to the
 rollout engine after each step (:571-575); metrics feed the balancer
 (:691-704).
 
-v0 runs colocated & synchronous (the reference's ``main_ppo`` baseline
-semantics, SURVEY.md §3.5) against the in-process RolloutEngine; the
-disaggregated path swaps in the manager client without changing this loop's
-accounting.
+Two rollout modes behind one loop:
+- **colocated** (reference ``main_ppo`` baseline, SURVEY.md §3.5): an
+  in-process engine generates the full batch, then ibatches are slices.
+- **disaggregated streaming** (the reference's headline mode): a
+  ``RemoteRollout`` yields group-complete ibatches while later groups are
+  still generating on the elastic pool — training overlaps generation, the
+  trainer-bubble time is measured and fed to the manager's adaptive
+  balancer, which returns the next local-generation budget
+  (stream_ray_trainer.py:691-704 ⇄ handlers.rs:867-901).
 """
 
 from __future__ import annotations
@@ -28,10 +33,23 @@ from polyrl_tpu.data.batch import TensorBatch
 from polyrl_tpu.models import decoder
 from polyrl_tpu.ops import core_algos
 from polyrl_tpu.rollout.engine import RolloutEngine
+from polyrl_tpu.rollout.remote import RemoteRollout
 from polyrl_tpu.rollout.sampling import SamplingParams
 from polyrl_tpu.trainer.actor import ActorConfig, ReferencePolicy, StreamActor
 from polyrl_tpu.trainer.critic import CriticConfig, StreamCritic
 from polyrl_tpu.utils.metrics import MetricsTracker, marked_timer
+
+
+class _ResultView:
+    """Adapt a manager GenerateResult to the engine-output field names the
+    assembly code consumes."""
+
+    __slots__ = ("output_ids", "output_token_logprobs")
+
+    def __init__(self, res):
+        self.output_ids = np.asarray(res.output_token_ids, np.int32)
+        self.output_token_logprobs = np.asarray(res.output_token_logprobs,
+                                                np.float32)
 
 
 @dataclasses.dataclass
@@ -105,15 +123,17 @@ class StreamRLTrainer:
         self.ref_policy = ref_policy
         self.logger = logger
         self.global_step = 0
+        # local-generation budget from the manager's balancer (None until the
+        # first update_metrics round trip; manager default applies)
+        self._max_local_gen_s: float | None = None
         if cfg.adv_estimator == "gae" and critic is None:
             raise ValueError("GAE requires a critic")
 
     # -- rollout → TensorBatch -------------------------------------------
 
-    def _generate_batch(self, records: list[dict], rng) -> TensorBatch:
-        """Unroll n samples per prompt, generate, reassemble fixed-shape
-        arrays (the reference's preprocess/postprocess,
-        sglang_rollout_remote.py:227-391)."""
+    def _prepare_prompts(self, records: list[dict]):
+        """Unroll n samples per prompt (reference preprocess,
+        sglang_rollout_remote.py:198-225)."""
         cfg = self.cfg
         prompts, gts, sources = [], [], []
         for rec in records:
@@ -122,14 +142,21 @@ class StreamRLTrainer:
                 prompts.append(ids)
                 gts.append(rec.get("ground_truth", ""))
                 sources.append(rec.get("data_source", ""))
+        return prompts, gts, sources
 
-        sampling = SamplingParams(
+    def _sampling(self) -> SamplingParams:
+        cfg = self.cfg
+        return SamplingParams(
             temperature=cfg.temperature, top_p=cfg.top_p, top_k=cfg.top_k,
             max_new_tokens=cfg.max_response_length,
             stop_token_ids=(self.tokenizer.eos_token_id,),
         )
-        outs = self.rollout.generate(prompts, sampling, rng=rng)
 
+    def _assemble_batch(self, prompts, gts, sources, outs, group_ids) -> TensorBatch:
+        """Reassemble fixed-shape arrays (the reference's postprocess,
+        sglang_rollout_remote.py:318-391). ``outs`` expose ``output_ids`` and
+        ``output_token_logprobs``; ``group_ids`` are batch-local dense ids."""
+        cfg = self.cfg
         n = len(prompts)
         tp, tr = cfg.max_prompt_length, cfg.max_response_length
         pad = self.rollout.pad_token_id
@@ -142,14 +169,14 @@ class StreamRLTrainer:
             lp = len(p)
             input_ids[i, tp - lp : tp] = p
             attention_mask[i, tp - lp : tp] = 1.0
-            r = o.output_ids[:tr]
+            r = np.asarray(o.output_ids[:tr])
             input_ids[i, tp : tp + len(r)] = r
             attention_mask[i, tp : tp + len(r)] = 1.0
             responses[i, : len(r)] = r
             response_mask[i, : len(r)] = 1.0
-            rollout_log_probs[i, : len(r)] = o.output_token_logprobs[: len(r)]
+            rollout_log_probs[i, : len(r)] = np.asarray(
+                o.output_token_logprobs[: len(r)])
         positions = np.maximum(attention_mask.cumsum(axis=-1) - 1, 0).astype(np.int32)
-        group_ids = np.repeat(np.arange(len(records), dtype=np.int32), cfg.rollout_n)
 
         return TensorBatch.from_dict(
             tensors={
@@ -159,11 +186,37 @@ class StreamRLTrainer:
                 "responses": responses,
                 "response_mask": response_mask,
                 "rollout_log_probs": rollout_log_probs,
-                "group_ids": group_ids,
+                "group_ids": np.asarray(group_ids, np.int32),
             },
-            non_tensors={"ground_truth": gts, "data_source": sources},
+            non_tensors={"ground_truth": list(gts), "data_source": list(sources)},
             meta_info={"global_step": self.global_step},
         )
+
+    def _ibatch_iter(self, records: list[dict], rng, metrics: MetricsTracker):
+        """Yield TensorBatch ibatches. Colocated: generate all, slice.
+        Remote: stream group-complete chunks while generation continues."""
+        cfg = self.cfg
+        prompts, gts, sources = self._prepare_prompts(records)
+        if isinstance(self.rollout, RemoteRollout):
+            stream = self.rollout.generate_stream(
+                prompts, self._sampling(), group_size=cfg.rollout_n,
+                min_emit=cfg.min_stream_batch_size,
+                max_local_gen_s=self._max_local_gen_s)
+            for chunk in stream:
+                idxs = [i for i, _ in chunk]
+                outs = [_ResultView(r) for _, r in chunk]
+                raw_gids = np.asarray([i // cfg.rollout_n for i in idxs])
+                _, dense = np.unique(raw_gids, return_inverse=True)
+                yield self._assemble_batch(
+                    [prompts[i] for i in idxs], [gts[i] for i in idxs],
+                    [sources[i] for i in idxs], outs, dense)
+        else:
+            with marked_timer("gen", metrics):
+                outs = self.rollout.generate(prompts, self._sampling(), rng=rng)
+            group_ids = np.repeat(np.arange(len(records), dtype=np.int32),
+                                  cfg.rollout_n)
+            batch = self._assemble_batch(prompts, gts, sources, outs, group_ids)
+            yield from batch.split(cfg.min_stream_batch_size)
 
     # -- per-ibatch pipeline ---------------------------------------------
 
@@ -240,53 +293,89 @@ class StreamRLTrainer:
             records = next(self.dataloader)
             rng, gen_rng = jax.random.split(rng)
 
-            with marked_timer("gen", metrics):
-                batch = self._generate_batch(records, gen_rng)
-
-            # stream accounting: ibatches of min_stream_batch_size; opt step
-            # when the cumulative count crosses each minibatch boundary
+            # stream accounting: ibatches arrive (possibly overlapping
+            # generation); opt step when the cumulative trajectory count
+            # crosses each minibatch boundary, plus a final flush on the last
+            # micro so dropped groups never strand accumulated grads
             # (reference cum-minibatch logic, stream_ray_trainer.py:500-568).
-            n_total = len(batch)
-            isize = cfg.min_stream_batch_size
             msize = cfg.ppo_mini_batch_size
             grad_steps_per_mini = msize // cfg.micro_batch_size
-            processed = 0
-            n_tokens = 0
-            for ibatch in batch.split(isize):
-                ibatch = self._process_ibatch(ibatch, metrics)
-                n_tokens += int(np.asarray(ibatch["attention_mask"]).sum())
-                for micro in ibatch.split(cfg.micro_batch_size):
-                    processed += len(micro)
-                    is_opt = processed % msize == 0
-                    feed = {k: micro[k] for k in (
+            state = {"processed": 0, "n_tokens": 0, "bubble": 0.0}
+
+            def micro_stream():
+                it = self._ibatch_iter(records, gen_rng, metrics)
+                while True:
+                    wait_t0 = time.monotonic()
+                    try:
+                        ibatch = next(it)
+                    except StopIteration:
+                        return
+                    # time blocked on rollout = the trainer bubble the
+                    # balancer minimizes (stream_ray_trainer.py:694-700)
+                    state["bubble"] += time.monotonic() - wait_t0
+                    ibatch = self._process_ibatch(ibatch, metrics)
+                    state["n_tokens"] += int(
+                        np.asarray(ibatch["attention_mask"]).sum())
+                    yield from ibatch.split(cfg.micro_batch_size)
+
+            def train_micro(micro):
+                state["processed"] += len(micro)
+                is_opt = state["processed"] % msize == 0
+                feed = {k: micro[k] for k in (
+                    "input_ids", "positions", "attention_mask", "responses",
+                    "response_mask", "advantages", "old_log_probs")}
+                if "ref_log_probs" in micro:
+                    feed["ref_log_probs"] = micro["ref_log_probs"]
+                with marked_timer("update_actor", metrics):
+                    m = self.actor.update_stream(
+                        feed, is_opt, loss_scale=1.0 / grad_steps_per_mini)
+                    metrics.update({k: float(v) for k, v in m.items()})
+                if self.critic is not None:
+                    cfeed = {k: micro[k] for k in (
                         "input_ids", "positions", "attention_mask", "responses",
-                        "response_mask", "advantages", "old_log_probs")}
-                    if "ref_log_probs" in micro:
-                        feed["ref_log_probs"] = micro["ref_log_probs"]
-                    with marked_timer("update_actor", metrics):
-                        m = self.actor.update_stream(
-                            feed, is_opt, loss_scale=1.0 / grad_steps_per_mini)
-                        metrics.update({k: float(v) for k, v in m.items()})
-                    if self.critic is not None:
-                        cfeed = {k: micro[k] for k in (
-                            "input_ids", "positions", "attention_mask", "responses",
-                            "response_mask", "returns", "values")}
-                        with marked_timer("update_critic", metrics):
-                            cm = self.critic.update_stream(
-                                cfeed, is_opt, loss_scale=1.0 / grad_steps_per_mini)
-                            metrics.update({k: float(v) for k, v in cm.items()})
+                        "response_mask", "returns", "values")}
+                    with marked_timer("update_critic", metrics):
+                        cm = self.critic.update_stream(
+                            cfeed, is_opt, loss_scale=1.0 / grad_steps_per_mini)
+                        metrics.update({k: float(v) for k, v in cm.items()})
+
+            # micros train the moment they exist (never idle behind the
+            # blocking ibatch wait); if a short batch (dropped groups) ends
+            # mid-minibatch, flush the accumulated grads afterwards
+            for micro in micro_stream():
+                train_micro(micro)
+            if state["processed"] % msize != 0 and state["processed"] > 0:
+                metrics.update({k: float(v) for k, v in
+                                self.actor.flush_opt_step().items()})
+                if self.critic is not None:
+                    metrics.update({k: float(v) for k, v in
+                                    self.critic.flush_opt_step().items()})
 
             with marked_timer("update_weight", metrics):
                 self.rollout.update_weights(self.actor.params)
 
             self.global_step += 1
             step_time = time.monotonic() - step_t0
+            throughput = state["n_tokens"] / step_time if step_time else 0.0
             metrics.update({
                 "training/global_step": self.global_step,
                 "perf/step_time_s": step_time,
-                "perf/throughput_tokens_per_s": n_tokens / step_time if step_time else 0.0,
+                "perf/trainer_bubble_s": state["bubble"],
+                "perf/throughput_tokens_per_s": throughput,
                 "perf/rollout_throughput_tok_s": self.rollout.last_gen_throughput,
             })
+            if isinstance(self.rollout, RemoteRollout):
+                # actuating metrics: the balancer returns the next
+                # local-generation budget (handlers.rs:867-901)
+                resp = self.rollout.update_metrics(
+                    step_time_s=step_time, trainer_bubble_s=state["bubble"],
+                    throughput=throughput)
+                if resp.get("max_local_gen_s"):
+                    self._max_local_gen_s = float(resp["max_local_gen_s"])
+                    metrics.update({
+                        "training/max_local_gen_s": self._max_local_gen_s,
+                        "training/num_rollout_instances":
+                            float(resp.get("num_instances", 0))})
             record = metrics.as_dict()
             history.append(record)
             if self.logger is not None:

@@ -1,0 +1,138 @@
+"""Disaggregated streaming path: RemoteRollout grouping semantics (unit) and
+the full pipeline — trainer ⇄ C++ manager ⇄ HTTP rollout server with weight
+fabric — on tiny shapes (SURVEY §3.1's heart, CPU-sized)."""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from polyrl_tpu.data.dataset import PromptDataLoader, make_arithmetic_dataset
+from polyrl_tpu.manager.client import GenerateResult, ManagerClient, spawn_rollout_manager
+from polyrl_tpu.rewards.manager import load_reward_manager
+from polyrl_tpu.rollout.remote import RemoteRollout
+from polyrl_tpu.rollout.sampling import SamplingParams
+from polyrl_tpu.rollout.serve import create_server, register_with_manager
+from polyrl_tpu.trainer.actor import ActorConfig, StreamActor
+from polyrl_tpu.trainer.stream_trainer import StreamRLTrainer, TrainerConfig
+from polyrl_tpu.transfer import TransferInterface
+from polyrl_tpu.utils.tokenizer import ByteTokenizer
+
+
+class _StubManager:
+    """Yields canned results in a given order (simulating out-of-order
+    completion across the pool)."""
+
+    def __init__(self, results):
+        self.results = results
+
+    def batch_generate_stream(self, requests, max_local_gen_s=None):
+        yield from self.results
+
+
+def _res(i, ok=True, n_tok=3):
+    return GenerateResult(rid=str(i), success=ok,
+                          output_token_ids=list(range(100 + i, 100 + i + n_tok)),
+                          output_token_logprobs=[-0.1] * n_tok,
+                          finish_reason="stop" if ok else "",
+                          error="" if ok else "boom")
+
+
+def test_group_streaming_order_and_min_emit():
+    # groups of 2; completion order interleaves groups; min_emit=4 → first
+    # yield only after TWO whole groups are done
+    order = [_res(0), _res(2), _res(3), _res(1), _res(5), _res(4),
+             _res(6), _res(7)]
+    rr = RemoteRollout(_StubManager(order))
+    chunks = list(rr.generate_stream(
+        [[1]] * 8, SamplingParams(max_new_tokens=4), group_size=2, min_emit=4))
+    assert [len(c) for c in chunks] == [4, 4]
+    # whole groups, members sorted by original index
+    assert [i for i, _ in chunks[0]] == [2, 3, 0, 1]
+    assert [i for i, _ in chunks[1]] == [4, 5, 6, 7]
+
+
+def test_failed_request_drops_whole_group():
+    order = [_res(0), _res(1), _res(2, ok=False), _res(3), _res(4), _res(5)]
+    rr = RemoteRollout(_StubManager(order))
+    chunks = list(rr.generate_stream(
+        [[1]] * 6, SamplingParams(max_new_tokens=4), group_size=2, min_emit=2))
+    got = [i for c in chunks for i, _ in c]
+    assert got == [0, 1, 4, 5]  # group 1 (indices 2,3) dropped whole
+    assert rr.dropped_groups == 1
+
+
+@pytest.fixture(scope="module")
+def stack():
+    """manager + cb rollout server + fabric, tiny model."""
+    srv = create_server(model="tiny", dtype="float32", host="127.0.0.1",
+                        backend="cb", page_size=8, max_slots=8,
+                        max_seq_len=256, prompt_buckets=(16, 32))
+    proc, port = spawn_rollout_manager(
+        "127.0.0.1:0",
+        extra_args=["--health-check-interval-s", "0.1",
+                    "--stats-poll-interval-s", "0.2"])
+    mgr = ManagerClient(f"127.0.0.1:{port}")
+    mgr.wait_healthy()
+    yield srv, mgr, proc
+    proc.kill()
+    srv.stop()
+
+
+def test_disaggregated_streaming_fit(stack):
+    """One GRPO step end-to-end through the full disaggregated stack:
+    streaming ibatches, fabric weight push, balancer feedback."""
+    srv, mgr, _ = stack
+    tok = ByteTokenizer()
+    # the trainer owns ITS OWN actor params (tiny cfg matches the server's)
+    from polyrl_tpu.models import decoder
+    cfg = decoder.get_config("tiny", dtype=jnp.float32)
+    params = decoder.init_params(jax.random.PRNGKey(1), cfg)
+
+    iface = TransferInterface(params, manager_client=mgr, num_streams=2,
+                              poll_s=0.1, advertise_host="127.0.0.1")
+    try:
+        register_with_manager(srv, mgr.endpoint.replace("http://", ""),
+                              transfer_streams=2)
+        assert srv.receiver is not None
+        t0 = time.monotonic()  # wait for health promotion
+        while time.monotonic() - t0 < 10:
+            st = mgr.get_instances_status()
+            if any(i["healthy"] for i in st["instances"]):
+                break
+            time.sleep(0.1)
+
+        remote = RemoteRollout(mgr, transfer=iface,
+                               pad_token_id=tok.pad_token_id)
+        tcfg = TrainerConfig(
+            train_batch_size=4, rollout_n=2, ppo_mini_batch_size=8,
+            micro_batch_size=4, min_stream_batch_size=4,
+            max_prompt_length=16, max_response_length=8,
+            adv_estimator="grpo", total_steps=1, temperature=1.0)
+        actor = StreamActor(cfg, ActorConfig(lr=1e-4, remat=False), params)
+        trainer = StreamRLTrainer(
+            tcfg, actor, remote, tok,
+            load_reward_manager("naive", tok, num_workers=1),
+            PromptDataLoader(make_arithmetic_dataset(16), 4))
+        history = trainer.fit()
+
+        assert len(history) == 1
+        h = history[0]
+        assert "actor/pg_loss" in h
+        assert "perf/trainer_bubble_s" in h
+        # balancer round trip happened
+        assert "training/max_local_gen_s" in h
+        # bootstrap + post-step push both land on the server (the post-step
+        # push is async — the sender agent overlaps it with the next step)
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < 30 and srv.engine.weight_version < 2:
+            time.sleep(0.2)
+        assert srv.engine.weight_version >= 2
+        assert remote.dropped_groups == 0
+    finally:
+        iface.close()
+        if srv.receiver is not None:
+            srv.receiver.stop()
+            srv.receiver = None
