@@ -80,6 +80,11 @@ class RemoteRollout:
                 for i, p in enumerate(prompt_ids)]
 
         q: "queue.Queue[Any]" = queue.Queue()
+        gen_t0 = time.monotonic()
+        # completion timestamp taken in the reader thread: the consumer side
+        # only resumes after trainer compute inside each yield, which would
+        # inflate elapsed in exactly the overlapped mode this measures
+        gen_end = [gen_t0]
 
         def reader() -> None:
             # drains the NDJSON stream so the manager is never backpressured
@@ -88,13 +93,14 @@ class RemoteRollout:
                 for res in self.manager.batch_generate_stream(
                         reqs, max_local_gen_s=max_local_gen_s):
                     q.put(res)
+                gen_end[0] = time.monotonic()
                 q.put(None)
             except Exception as exc:  # noqa: BLE001
+                gen_end[0] = time.monotonic()
                 q.put(exc)
 
         t = threading.Thread(target=reader, daemon=True)
         t.start()
-        gen_t0 = time.monotonic()
         n_tokens = 0
 
         groups: dict[int, list[tuple[int, GenerateResult]]] = {}
@@ -128,7 +134,7 @@ class RemoteRollout:
         if groups:  # stream ended with incomplete groups (should not happen)
             log.warning("%d groups incomplete at stream end", len(groups))
             self.dropped_groups += len(groups)
-        elapsed = time.monotonic() - gen_t0
+        elapsed = gen_end[0] - gen_t0
         self.last_gen_throughput = n_tokens / elapsed if elapsed > 0 else 0.0
         if pending:
             yield pending

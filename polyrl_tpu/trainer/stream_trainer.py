@@ -37,6 +37,7 @@ from polyrl_tpu.rollout.remote import RemoteRollout
 from polyrl_tpu.rollout.sampling import SamplingParams
 from polyrl_tpu.trainer.actor import ActorConfig, ReferencePolicy, StreamActor
 from polyrl_tpu.trainer.critic import CriticConfig, StreamCritic
+from polyrl_tpu.utils import checkpoint as ckpt_lib
 from polyrl_tpu.utils.metrics import MetricsTracker, marked_timer
 
 
@@ -74,6 +75,13 @@ class TrainerConfig:
     # run
     total_steps: int = 10
     seed: int = 0
+    # checkpoint/resume (reference _save_checkpoint gating,
+    # stream_ray_trainer.py:604-623; SURVEY.md §5.4)
+    ckpt_dir: str | None = None
+    save_freq: int = 0                    # 0 = only last step (+ESI)
+    max_ckpt_keep: int = 3
+    resume: str = "auto"                  # auto | disable
+    esi_margin_s: float = 300.0
     # sampling
     temperature: float = 1.0
     top_p: float = 1.0
@@ -128,6 +136,52 @@ class StreamRLTrainer:
         self._max_local_gen_s: float | None = None
         if cfg.adv_estimator == "gae" and critic is None:
             raise ValueError("GAE requires a critic")
+        self._ckpt = (
+            ckpt_lib.CheckpointManager(cfg.ckpt_dir, max_to_keep=cfg.max_ckpt_keep)
+            if cfg.ckpt_dir
+            else None
+        )
+        self._esi_expiry = ckpt_lib.esi_expiry_from_env()
+
+    # -- checkpoint/resume (reference stream_ray_trainer.py:305,604-623) --
+
+    def _ckpt_state(self) -> dict:
+        state = {"actor": {"params": self.actor.params,
+                           "opt_state": self.actor.opt_state}}
+        if self.critic is not None:
+            state["critic"] = {"params": self.critic.params,
+                               "opt_state": self.critic.opt_state}
+        return state
+
+    def _save_checkpoint(self) -> None:
+        meta = {"global_step": self.global_step}
+        if hasattr(self.dataloader, "state_dict"):
+            meta["dataloader"] = self.dataloader.state_dict()
+        self._ckpt.save(self.global_step, self._ckpt_state(), meta)
+
+    def _load_checkpoint(self) -> bool:
+        """Restore latest checkpoint if present; returns True on resume.
+        Items are restored independently, so a critic-config change (actor-
+        only ckpt into a critic trainer, or vice versa) resumes what
+        matches instead of failing on pytree-structure mismatch."""
+        if self._ckpt is None or self.cfg.resume == "disable":
+            return False
+        targets = {k: ckpt_lib.abstract_like(v)
+                   for k, v in self._ckpt_state().items()}
+        out = self._ckpt.restore(targets=targets)
+        if out is None:
+            return False
+        state, meta = out
+        if "actor" in state:
+            self.actor.params = state["actor"]["params"]
+            self.actor.opt_state = state["actor"]["opt_state"]
+        if self.critic is not None and "critic" in state:
+            self.critic.params = state["critic"]["params"]
+            self.critic.opt_state = state["critic"]["opt_state"]
+        self.global_step = int(meta.get("global_step", 0))
+        if "dataloader" in meta and hasattr(self.dataloader, "load_state_dict"):
+            self.dataloader.load_state_dict(meta["dataloader"])
+        return True
 
     # -- rollout → TensorBatch -------------------------------------------
 
@@ -283,15 +337,21 @@ class StreamRLTrainer:
         """Run ``total_steps`` PPO steps; returns per-step metric dicts."""
         cfg = self.cfg
         history = []
-        rng = jax.random.PRNGKey(cfg.seed)
+        base_rng = jax.random.PRNGKey(cfg.seed)
+        resumed = self._load_checkpoint()
+        if resumed and self.logger is not None:
+            self.logger.log({"training/resumed_from_step": self.global_step},
+                            step=self.global_step)
         # bootstrap weights into the rollout engine (reference fit :340)
         self.rollout.update_weights(self.actor.params)
 
-        for step in range(cfg.total_steps):
+        while self.global_step < cfg.total_steps:
             metrics = MetricsTracker()
             step_t0 = time.monotonic()
             records = next(self.dataloader)
-            rng, gen_rng = jax.random.split(rng)
+            # per-step rng derived from the step index so a resumed run
+            # replays the same sampling stream (keys need not be saved)
+            gen_rng = jax.random.fold_in(base_rng, self.global_step)
 
             # stream accounting: ibatches arrive (possibly overlapping
             # generation); opt step when the cumulative trajectory count
@@ -319,8 +379,13 @@ class StreamRLTrainer:
                     yield from ibatch.split(cfg.micro_batch_size)
 
             def train_micro(micro):
+                # boundary-CROSSING, not exact multiples: ragged micro sizes
+                # (streaming path with adv estimators that allow
+                # min_stream_batch_size % rollout_n != 0) may step over an
+                # exact multiple and must still trigger the opt step
+                prev = state["processed"]
                 state["processed"] += len(micro)
-                is_opt = state["processed"] % msize == 0
+                is_opt = state["processed"] // msize > prev // msize
                 feed = {k: micro[k] for k in (
                     "input_ids", "positions", "attention_mask", "responses",
                     "response_mask", "advantages", "old_log_probs")}
@@ -376,8 +441,16 @@ class StreamRLTrainer:
                         "training/max_local_gen_s": self._max_local_gen_s,
                         "training/num_rollout_instances":
                             float(resp.get("num_instances", 0))})
+            if self._ckpt is not None and ckpt_lib.should_save_checkpoint(
+                self.global_step, cfg.total_steps, cfg.save_freq,
+                esi_expiry_ts=self._esi_expiry, esi_margin_s=cfg.esi_margin_s,
+            ):
+                with marked_timer("save_checkpoint", metrics):
+                    self._save_checkpoint()
             record = metrics.as_dict()
             history.append(record)
             if self.logger is not None:
                 self.logger.log(record, step=self.global_step)
+        if self._ckpt is not None:
+            self._ckpt.wait()
         return history

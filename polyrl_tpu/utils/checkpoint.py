@@ -1,0 +1,162 @@
+"""Checkpoint/resume via Orbax (async-capable, sharding-aware).
+
+TPU-native equivalent of the reference's checkpoint layer (SURVEY.md §5.4):
+verl's ``FSDPCheckpointManager`` wired for actor+optimizer+LR scheduler
+(reference ``stream_fsdp_workers.py:357-376``), ``_load_checkpoint`` at fit
+start and periodic ``_save_checkpoint`` gated by save_freq / last-step /
+ESI expiry (``stream_ray_trainer.py:305,604-623``), and
+``find_latest_ckpt_path`` resume discovery. Dataloader state rides along the
+way verl uses ``StatefulDataLoader`` (``stream_ray_trainer.py:38``).
+
+Layout: ``<root>/global_step_<N>/{state,meta}`` — ``state`` is the sharded
+pytree (Orbax StandardSave: actor params/opt state, optional critic, RNG),
+``meta`` is JSON (global_step, dataloader state, config echo). Restore is
+sharding-aware when an abstract target is supplied (arrays land directly on
+the mesh); without one it yields host numpy for the caller to ``device_put``.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import time
+from typing import Any
+
+import jax
+
+_STEP_RE = re.compile(r"^global_step_(\d+)$")
+
+
+def find_latest_ckpt_path(root: str) -> str | None:
+    """Most recent ``global_step_<N>`` dir under ``root`` (reference
+    ``find_latest_ckpt_path``, stream_ray_trainer.py:57)."""
+    step = latest_step(root)
+    return None if step is None else os.path.join(root, f"global_step_{step}")
+
+
+def latest_step(root: str) -> int | None:
+    if not root or not os.path.isdir(root):
+        return None
+    steps = [int(m.group(1)) for d in os.listdir(root) if (m := _STEP_RE.match(d))]
+    return max(steps) if steps else None
+
+
+def should_save_checkpoint(
+    step: int,
+    total_steps: int,
+    save_freq: int,
+    *,
+    esi_expiry_ts: float | None = None,
+    esi_margin_s: float = 300.0,
+    now: float | None = None,
+) -> bool:
+    """Save gating: save_freq boundary, last step, or ESI (spot trainer)
+    expiry approaching (reference should_save_ckpt_esi forced save,
+    stream_ray_trainer.py:604-623)."""
+    if step >= total_steps:
+        return True
+    if save_freq > 0 and step % save_freq == 0:
+        return True
+    if esi_expiry_ts is not None:
+        t = time.time() if now is None else now
+        if t >= esi_expiry_ts - esi_margin_s:
+            return True
+    return False
+
+
+def esi_expiry_from_env() -> float | None:
+    """Spot/preemptible instance expiry timestamp (epoch seconds), if the
+    scheduler exported one (reference ESI path)."""
+    v = os.environ.get("POLYRL_ESI_EXPIRATION_TS", "")
+    try:
+        return float(v) if v else None
+    except ValueError:
+        return None
+
+
+class CheckpointManager:
+    """Orbax-backed save/restore of the full trainer state.
+
+    ``state`` pytree convention (what StreamRLTrainer passes):
+      {"actor": {"params": ..., "opt_state": ...},
+       "critic": {...} | absent,
+       "rng": jax.random key array}
+    """
+
+    def __init__(self, root: str, max_to_keep: int = 3, async_save: bool = True):
+        import orbax.checkpoint as ocp
+
+        self._ocp = ocp
+        self.root = os.path.abspath(root)
+        os.makedirs(self.root, exist_ok=True)
+        self._mgr = ocp.CheckpointManager(
+            self.root,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep,
+                step_prefix="global_step",
+                enable_async_checkpointing=async_save,
+                cleanup_tmp_directories=True,
+            ),
+        )
+
+    # -- save -------------------------------------------------------------
+
+    def save(self, step: int, items: dict[str, Any], meta: dict | None = None) -> None:
+        """``items``: name → pytree. Each item is a separate Composite entry
+        so restore can pick any subset (e.g. actor-only resume into a
+        trainer that has grown a critic, or vice versa)."""
+        ocp = self._ocp
+        args = {k: ocp.args.StandardSave(v) for k, v in items.items()}
+        args["meta"] = ocp.args.JsonSave(meta or {})
+        self._mgr.save(step, args=ocp.args.Composite(**args))
+
+    def wait(self) -> None:
+        """Block until in-flight async saves are durable."""
+        self._mgr.wait_until_finished()
+
+    # -- restore ----------------------------------------------------------
+
+    def latest_step(self) -> int | None:
+        return self._mgr.latest_step()
+
+    def saved_items(self, step: int | None = None) -> set[str]:
+        step = self._mgr.latest_step() if step is None else step
+        if step is None:
+            return set()
+        meta = self._mgr.item_metadata(step)
+        return {k for k in meta.keys() if k != "meta"}
+
+    def restore(self, step: int | None = None, targets: dict[str, Any] | None = None):
+        """Returns (items, meta) or None if nothing saved. ``targets``: name
+        → abstract pytree (``abstract_like`` over the live state, shardings
+        attached) for direct-to-mesh restore. Only items present both on
+        disk and in ``targets`` are restored."""
+        ocp = self._ocp
+        step = self._mgr.latest_step() if step is None else step
+        if step is None:
+            return None
+        avail = self.saved_items(step)
+        targets = targets or {}
+        args = {
+            k: ocp.args.StandardRestore(t)
+            for k, t in targets.items()
+            if k in avail
+        }
+        args["meta"] = ocp.args.JsonRestore()
+        out = self._mgr.restore(step, args=ocp.args.Composite(**args))
+        items = {k: out[k] for k in args if k != "meta"}
+        return items, dict(out["meta"] or {})
+
+    def close(self) -> None:
+        self._mgr.close()
+
+
+def abstract_like(tree: Any) -> Any:
+    """Abstract pytree (ShapeDtypeStruct + sharding) for sharded restore."""
+
+    def one(x):
+        if isinstance(x, jax.Array):
+            return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding)
+        return x
+
+    return jax.tree_util.tree_map(one, tree)

@@ -1,0 +1,135 @@
+"""Checkpoint/resume: Orbax round-trip, save gating, trainer resume parity
+(reference _load_checkpoint/_save_checkpoint + ESI gating, SURVEY.md §5.4)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from polyrl_tpu.data.dataset import PromptDataLoader, make_arithmetic_dataset
+from polyrl_tpu.models import decoder
+from polyrl_tpu.rewards.manager import load_reward_manager
+from polyrl_tpu.rollout.engine import RolloutEngine
+from polyrl_tpu.trainer.actor import ActorConfig, StreamActor
+from polyrl_tpu.trainer.stream_trainer import StreamRLTrainer, TrainerConfig
+from polyrl_tpu.utils import checkpoint as ckpt_lib
+from polyrl_tpu.utils.tokenizer import ByteTokenizer
+
+
+def test_should_save_gating():
+    f = ckpt_lib.should_save_checkpoint
+    assert f(10, 10, 0)                       # last step
+    assert f(4, 10, 2)                        # freq boundary
+    assert not f(3, 10, 2)
+    assert not f(3, 10, 0)
+    # ESI expiry inside margin forces a save (stream_ray_trainer.py:604-623)
+    assert f(3, 10, 0, esi_expiry_ts=1000.0, esi_margin_s=300.0, now=800.0)
+    assert not f(3, 10, 0, esi_expiry_ts=1000.0, esi_margin_s=300.0, now=600.0)
+
+
+def test_latest_step_discovery(tmp_path):
+    assert ckpt_lib.latest_step(str(tmp_path)) is None
+    (tmp_path / "global_step_3").mkdir()
+    (tmp_path / "global_step_12").mkdir()
+    (tmp_path / "junk").mkdir()
+    assert ckpt_lib.latest_step(str(tmp_path)) == 12
+    assert ckpt_lib.find_latest_ckpt_path(str(tmp_path)).endswith("global_step_12")
+
+
+def test_orbax_roundtrip_sharded(tmp_path):
+    mgr = ckpt_lib.CheckpointManager(str(tmp_path / "ck"), async_save=False)
+    state = {
+        "w": jnp.arange(16, dtype=jnp.float32).reshape(4, 4),
+        "nested": {"b": jnp.ones((3,), jnp.bfloat16)},
+    }
+    mgr.save(2, {"state": state}, {"global_step": 2, "dataloader": {"consumed": 8}})
+    mgr.wait()
+    assert mgr.saved_items() == {"state"}
+    out, meta = mgr.restore(targets={"state": ckpt_lib.abstract_like(state)})
+    out_state = out["state"]
+    assert meta["global_step"] == 2 and meta["dataloader"]["consumed"] == 8
+    np.testing.assert_array_equal(np.asarray(out_state["w"]), np.asarray(state["w"]))
+    assert out_state["nested"]["b"].dtype == jnp.bfloat16
+    # restoring with an extra target the checkpoint doesn't have is fine
+    out2, _ = mgr.restore(targets={
+        "state": ckpt_lib.abstract_like(state),
+        "critic": ckpt_lib.abstract_like(state)})
+    assert "critic" not in out2
+    mgr.close()
+
+
+def _make_trainer(ckpt_dir, total_steps, save_freq=1, seed=7):
+    cfg = decoder.get_config(
+        "tiny", dtype=jnp.float32, vocab_size=512, max_position_embeddings=128
+    )
+    params = decoder.init_params(jax.random.PRNGKey(0), cfg)
+    tok = ByteTokenizer()
+    engine = RolloutEngine(
+        cfg, params, pad_token_id=tok.pad_token_id,
+        batch_buckets=(16,), prompt_buckets=(16,), kv_cache_dtype=jnp.float32,
+    )
+    tcfg = TrainerConfig(
+        train_batch_size=4, rollout_n=2, ppo_mini_batch_size=8,
+        micro_batch_size=4, min_stream_batch_size=4,
+        max_prompt_length=16, max_response_length=8,
+        adv_estimator="grpo", total_steps=total_steps, seed=seed,
+        ckpt_dir=str(ckpt_dir), save_freq=save_freq,
+    )
+    actor = StreamActor(cfg, ActorConfig(lr=1e-4, remat=False), params)
+    loader = PromptDataLoader(
+        make_arithmetic_dataset(64), tcfg.train_batch_size, seed=seed
+    )
+    return StreamRLTrainer(
+        tcfg, actor, engine, tok,
+        load_reward_manager("naive", tok, num_workers=1), loader,
+    )
+
+
+def test_trainer_resume_matches_uninterrupted(tmp_path):
+    # Run A: 3 steps straight through.
+    ta = _make_trainer(tmp_path / "a", total_steps=3)
+    ta.fit()
+    # Run B: 2 steps, then a fresh trainer resumes from the checkpoint and
+    # finishes step 3. Params must match run A exactly (CPU f32 determinism).
+    tb1 = _make_trainer(tmp_path / "b", total_steps=2)
+    tb1.fit()
+    tb2 = _make_trainer(tmp_path / "b", total_steps=3)
+    history = tb2.fit()
+    assert len(history) == 1  # only step 3 ran
+    assert tb2.global_step == 3
+    assert tb2.dataloader.consumed == ta.dataloader.consumed
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=0, atol=0
+        ),
+        ta.actor.params, tb2.actor.params,
+    )
+
+
+def test_resume_actor_only_ckpt_into_critic_trainer(tmp_path):
+    # actor-only run saves; a trainer that now has a critic must still
+    # resume the actor (host-numpy fallback path, structures mismatch)
+    t1 = _make_trainer(tmp_path / "m", total_steps=1)
+    t1.fit()
+    from polyrl_tpu.trainer.critic import CriticConfig, StreamCritic, init_critic_params
+    t2 = _make_trainer(tmp_path / "m", total_steps=2)
+    mcfg = decoder.get_config(
+        "tiny", dtype=jnp.float32, vocab_size=512, max_position_embeddings=128
+    )
+    t2.critic = StreamCritic(
+        mcfg, CriticConfig(remat=False), init_critic_params(jax.random.PRNGKey(2), mcfg)
+    )
+    assert t2._load_checkpoint()
+    assert t2.global_step == 1
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)),
+        t1.actor.params, t2.actor.params,
+    )
+
+
+def test_trainer_resume_disable(tmp_path):
+    t1 = _make_trainer(tmp_path / "c", total_steps=1)
+    t1.fit()
+    t2 = _make_trainer(tmp_path / "c", total_steps=1)
+    t2.cfg.resume = "disable"
+    assert not t2._load_checkpoint()
+    assert t2.global_step == 0
