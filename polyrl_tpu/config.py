@@ -1,0 +1,219 @@
+"""Config system: dataclass tree + YAML + dotted CLI overrides.
+
+Equivalent of the reference's three config planes (SURVEY.md §5.6 / C18):
+Hydra/OmegaConf trainer tree with CLI overrides (``ppo_stream_trainer.yaml``
+composed over verl defaults, overridden in recipes), TOML for the
+manager/fabric, env vars for point toggles. Hydra/OmegaConf are not in the
+TPU image, so this is a self-contained equivalent: nested dataclasses are
+the schema + defaults, a YAML file overlays them, and ``key.sub=value``
+dotted CLI args overlay that (override order CLI > file > default, the
+reference's order, config.rs:6).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import typing
+from dataclasses import dataclass, field
+from typing import Any
+
+from polyrl_tpu.trainer.actor import ActorConfig
+from polyrl_tpu.trainer.critic import CriticConfig
+from polyrl_tpu.trainer.stream_trainer import TrainerConfig
+
+
+@dataclass
+class ModelSection:
+    preset: str = "tiny"                  # tiny | qwen3-1.7b | qwen3-8b | llama3-8b
+    dtype: str = "bfloat16"
+    # raw ModelConfig field overrides (vocab_size, num_layers, ...)
+    overrides: dict = field(default_factory=dict)
+
+
+@dataclass
+class TokenizerSection:
+    kind: str = "byte"                    # byte | hf
+    name_or_path: str = ""                # hf repo/dir when kind == "hf"
+
+
+@dataclass
+class DataSection:
+    train_path: str = "arithmetic"        # .jsonl/.parquet path, or "arithmetic"
+    val_path: str = ""
+    prompt_key: str = "prompt"
+    shuffle: bool = True
+    seed: int = 0
+    arithmetic_size: int = 512            # synthetic task size
+
+
+@dataclass
+class RolloutSection:
+    mode: str = "colocated"               # colocated | disaggregated
+    backend: str = "cb"                   # cb (paged continuous batching) | step (bucketed)
+    batch_buckets: tuple = ()             # step backend
+    prompt_buckets: tuple = ()
+    max_slots: int = 64                   # cb backend
+    page_size: int = 64
+    max_seq_len: int = 16384
+    kv_cache_dtype: str = ""              # "" → model dtype
+    # disaggregated plumbing (reference rollout_manager.{port,endpoint},
+    # workers/config/rollout.py:95-101)
+    manager_endpoint: str = ""            # "" → spawn the C++ manager locally
+    manager_args: tuple = ()              # extra CLI args for the spawned manager
+    transfer_streams: int = 4
+    advertise_host: str = "127.0.0.1"
+
+
+@dataclass
+class RewardSection:
+    manager: str = "naive"
+    custom_score_path: str = ""           # python file defining compute_score
+    num_workers: int = 8
+
+
+@dataclass
+class LoggingSection:
+    backends: tuple = ("console",)        # console | jsonl | tensorboard
+    path: str = ""                        # jsonl path / tensorboard dir
+
+
+@dataclass
+class RunConfig:
+    model: ModelSection = field(default_factory=ModelSection)
+    tokenizer: TokenizerSection = field(default_factory=TokenizerSection)
+    data: DataSection = field(default_factory=DataSection)
+    rollout: RolloutSection = field(default_factory=RolloutSection)
+    reward: RewardSection = field(default_factory=RewardSection)
+    trainer: TrainerConfig = field(default_factory=TrainerConfig)
+    actor: ActorConfig = field(default_factory=ActorConfig)
+    critic: CriticConfig = field(default_factory=CriticConfig)
+    logging: LoggingSection = field(default_factory=LoggingSection)
+
+
+# -- dict ⇄ dataclass -------------------------------------------------------
+
+
+def _build(cls, data: dict):
+    """Construct dataclass ``cls`` from a (possibly partial) dict, recursing
+    into dataclass-typed fields. Unknown keys raise (typo protection)."""
+    fields = {f.name: f for f in dataclasses.fields(cls)}
+    unknown = set(data) - set(fields)
+    if unknown:
+        raise KeyError(f"unknown config keys for {cls.__name__}: {sorted(unknown)}")
+    kwargs = {}
+    for name, value in data.items():
+        ftype = fields[name].type
+        resolved = _resolve_type(cls, ftype)
+        if dataclasses.is_dataclass(resolved) and isinstance(value, dict):
+            kwargs[name] = _build(resolved, value)
+        elif resolved is tuple or typing.get_origin(resolved) is tuple:
+            kwargs[name] = tuple(value) if isinstance(value, (list, tuple)) else (value,)
+        else:
+            kwargs[name] = value
+    return cls(**kwargs)
+
+
+def _resolve_type(cls, ftype):
+    """Field types are strings under ``from __future__ import annotations``."""
+    if isinstance(ftype, str):
+        hints = typing.get_type_hints(cls)
+        # get_type_hints resolves the whole class; cache-free but configs are tiny
+        for f in dataclasses.fields(cls):
+            if f.type == ftype and f.name in hints:
+                return hints[f.name]
+        return str
+    return ftype
+
+
+def to_dict(cfg: Any) -> dict:
+    d = dataclasses.asdict(cfg)
+
+    def clean(x):
+        if isinstance(x, dict):
+            return {k: clean(v) for k, v in x.items()}
+        if isinstance(x, tuple):
+            return list(x)
+        return x
+
+    return clean(d)
+
+
+# -- overrides --------------------------------------------------------------
+
+
+def _coerce(text: str, current: Any) -> Any:
+    """Parse a CLI string by the type of the value it replaces."""
+    if isinstance(current, bool):
+        if text.lower() in ("true", "1", "yes"):
+            return True
+        if text.lower() in ("false", "0", "no"):
+            return False
+        raise ValueError(f"not a bool: {text!r}")
+    if isinstance(current, int) and not isinstance(current, bool):
+        return int(text)
+    if isinstance(current, float):
+        return float(text)
+    if isinstance(current, tuple):
+        if not text:
+            return ()
+        items = [t.strip() for t in text.split(",") if t.strip()]
+        conv = int if all(i.lstrip("-").isdigit() for i in items) else str
+        return tuple(conv(i) for i in items)
+    if isinstance(current, dict):
+        return json.loads(text)
+    if current is None:
+        # str|None fields: "null" keeps None, anything else becomes str
+        if text.lower() in ("null", "none", ""):
+            return None
+        for conv in (int, float):
+            try:
+                return conv(text)
+            except ValueError:
+                pass
+        return text
+    return text
+
+
+def _set_path(obj: Any, parts: list[str], raw: str, full: str) -> Any:
+    """Return ``obj`` with the dotted path set; frozen dataclasses are
+    rebuilt via ``dataclasses.replace`` instead of mutated."""
+    name = parts[0]
+    if not dataclasses.is_dataclass(obj) or not hasattr(obj, name):
+        raise KeyError(f"no config field {name!r} in {full!r}")
+    cur = getattr(obj, name)
+    new = _coerce(raw, cur) if len(parts) == 1 else _set_path(cur, parts[1:], raw, full)
+    try:
+        setattr(obj, name, new)
+        return obj
+    except dataclasses.FrozenInstanceError:
+        return dataclasses.replace(obj, **{name: new})
+
+
+def apply_overrides(cfg: RunConfig, overrides: list[str]) -> RunConfig:
+    """``a.b.c=value`` dotted assignments, validated against the schema."""
+    for ov in overrides:
+        if "=" not in ov:
+            raise ValueError(f"override must be key=value, got {ov!r}")
+        key, _, raw = ov.partition("=")
+        cfg = _set_path(cfg, key.strip().split("."), raw, key)
+    return cfg
+
+
+def load_config(path: str | None = None,
+                overrides: list[str] | None = None) -> RunConfig:
+    """YAML file (optional) overlaid on defaults, then dotted overrides.
+    TrainerConfig validation (__post_init__ divisibility, the reference's
+    main_stream.py:372-389 checks) re-runs on the final values."""
+    data: dict = {}
+    if path:
+        import yaml
+
+        with open(path) as f:
+            data = yaml.safe_load(f) or {}
+    cfg = _build(RunConfig, data)
+    if overrides:
+        cfg = apply_overrides(cfg, overrides)
+    # re-validate trainer arithmetic after overrides mutated fields
+    cfg.trainer.__post_init__()
+    return cfg

@@ -480,10 +480,12 @@ class CBEngine:
     # -- convenience (tests / bench) ----------------------------------------
 
     def generate(self, prompt_ids: list[list[int]], sampling: SamplingParams,
-                 timeout: float = 300.0) -> list[dict]:
+                 timeout: float = 300.0, rng=None) -> list[dict]:
         """Synchronous batch generate: submit all, run the loop inline if not
         started, collect full sequences. Returns per-prompt dicts with
-        token_ids / logprobs / finish_reason."""
+        token_ids / logprobs / finish_reason. ``rng`` is accepted for
+        interface parity with RolloutEngine; the CB engine owns per-slot
+        sampling state (admission order is not deterministic anyway)."""
         outs = [self.submit(f"gen-{i}", p, sampling)
                 for i, p in enumerate(prompt_ids)]
         self.start()

@@ -1,0 +1,207 @@
+"""Trainer entry point: ``python -m polyrl_tpu.train [--config run.yaml]
+[section.field=value ...]``.
+
+Equivalent of the reference's C1 trainer driver (``python -m
+rlboost.verl_stream.trainer.main_stream``, main_stream.py:40-94): compose
+config, build datasets/tokenizer/reward, spawn the rollout manager when
+disaggregated (head-node role, main_stream.py:342-362), assemble the
+trainer, run ``fit``. The colocated mode is the ``main_ppo`` synchronous
+baseline (SURVEY.md §3.5) behind the same flag surface
+(``rollout.mode=colocated``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import logging
+import sys
+
+from polyrl_tpu.config import RunConfig, load_config, to_dict
+
+log = logging.getLogger("polyrl_tpu.train")
+
+
+def build_tokenizer(cfg: RunConfig):
+    from polyrl_tpu.utils.tokenizer import ByteTokenizer, load_tokenizer
+
+    if cfg.tokenizer.kind == "byte":
+        return ByteTokenizer()
+    return load_tokenizer(cfg.tokenizer.name_or_path)
+
+
+def build_dataset(cfg: RunConfig, split: str = "train"):
+    from polyrl_tpu.data.dataset import RLDataset, make_arithmetic_dataset
+
+    path = cfg.data.train_path if split == "train" else cfg.data.val_path
+    if not path:
+        return None
+    if path == "arithmetic":
+        return make_arithmetic_dataset(cfg.data.arithmetic_size, seed=cfg.data.seed)
+    if path.endswith(".jsonl"):
+        return RLDataset.from_jsonl(path)
+    if path.endswith(".parquet"):
+        return RLDataset.from_parquet(path, prompt_key=cfg.data.prompt_key)
+    raise ValueError(f"unsupported dataset path {path!r}")
+
+
+def load_custom_score(path: str):
+    """Load ``compute_score`` from a user file (reference custom reward fn,
+    reward.py:95-150)."""
+    spec = importlib.util.spec_from_file_location("polyrl_custom_reward", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod.compute_score
+
+
+def _build_model(cfg: RunConfig):
+    import jax
+    import jax.numpy as jnp
+
+    from polyrl_tpu.models import decoder
+
+    mcfg = decoder.get_config(cfg.model.preset, dtype=getattr(jnp, cfg.model.dtype),
+                              **cfg.model.overrides)
+    params = jax.jit(lambda: decoder.init_params(
+        jax.random.PRNGKey(cfg.trainer.seed), mcfg))()
+    return mcfg, params
+
+
+def _build_rollout(cfg: RunConfig, mcfg, params, tokenizer, cleanup: list):
+    """Colocated: an in-process engine. Disaggregated: ManagerClient (+
+    locally spawned manager when no endpoint is configured) + weight fabric;
+    rollout instances join the pool on their own via
+    ``python -m polyrl_tpu.rollout.serve``."""
+    import jax.numpy as jnp
+
+    kv_dtype = getattr(jnp, cfg.rollout.kv_cache_dtype or cfg.model.dtype)
+    pad = tokenizer.pad_token_id
+
+    if cfg.rollout.mode == "colocated":
+        if cfg.rollout.backend == "cb":
+            from polyrl_tpu.rollout.cb_engine import CBEngine
+
+            kwargs = {}
+            if cfg.rollout.prompt_buckets:
+                kwargs["prompt_buckets"] = tuple(cfg.rollout.prompt_buckets)
+            return CBEngine(
+                mcfg, params, pad_token_id=pad, kv_cache_dtype=kv_dtype,
+                max_slots=cfg.rollout.max_slots, page_size=cfg.rollout.page_size,
+                max_seq_len=cfg.rollout.max_seq_len, **kwargs)
+        from polyrl_tpu.rollout.engine import RolloutEngine
+
+        kwargs = {}
+        if cfg.rollout.batch_buckets:
+            kwargs["batch_buckets"] = tuple(cfg.rollout.batch_buckets)
+        if cfg.rollout.prompt_buckets:
+            kwargs["prompt_buckets"] = tuple(cfg.rollout.prompt_buckets)
+        return RolloutEngine(mcfg, params, pad_token_id=pad,
+                             kv_cache_dtype=kv_dtype, **kwargs)
+
+    if cfg.rollout.mode != "disaggregated":
+        raise ValueError(f"unknown rollout.mode {cfg.rollout.mode!r}")
+
+    from polyrl_tpu.manager.client import ManagerClient, spawn_rollout_manager
+    from polyrl_tpu.rollout.remote import RemoteRollout
+    from polyrl_tpu.transfer import TransferInterface
+
+    endpoint = cfg.rollout.manager_endpoint
+    if not endpoint:
+        proc, port = spawn_rollout_manager(
+            extra_args=list(cfg.rollout.manager_args))
+        cleanup.append(proc.kill)
+        endpoint = f"127.0.0.1:{port}"
+        log.info("spawned rollout manager on %s", endpoint)
+    mgr = ManagerClient(endpoint)
+    mgr.wait_healthy()
+    iface = TransferInterface(
+        params, manager_client=mgr, num_streams=cfg.rollout.transfer_streams,
+        advertise_host=cfg.rollout.advertise_host)
+    cleanup.append(iface.close)
+    return RemoteRollout(mgr, transfer=iface, pad_token_id=pad)
+
+
+def build_trainer(cfg: RunConfig, cleanup: list | None = None):
+    """Assemble the full trainer from a RunConfig. ``cleanup`` collects
+    teardown callables (spawned manager, fabric threads)."""
+    from polyrl_tpu.data.dataset import PromptDataLoader
+    from polyrl_tpu.rewards.manager import load_reward_manager
+    from polyrl_tpu.trainer.actor import ReferencePolicy, StreamActor
+    from polyrl_tpu.trainer.critic import StreamCritic, init_critic_params
+    from polyrl_tpu.trainer.stream_trainer import StreamRLTrainer
+    from polyrl_tpu.utils.metrics import Tracking
+
+    cleanup = [] if cleanup is None else cleanup
+    tokenizer = build_tokenizer(cfg)
+    mcfg, params = _build_model(cfg)
+    rollout = _build_rollout(cfg, mcfg, params, tokenizer, cleanup)
+
+    compute_score = (load_custom_score(cfg.reward.custom_score_path)
+                     if cfg.reward.custom_score_path else None)
+    reward_manager = load_reward_manager(
+        cfg.reward.manager, tokenizer, compute_score=compute_score,
+        num_workers=cfg.reward.num_workers)
+
+    dataset = build_dataset(cfg, "train")
+    loader = PromptDataLoader(dataset, cfg.trainer.train_batch_size,
+                              shuffle=cfg.data.shuffle, seed=cfg.data.seed)
+
+    actor = StreamActor(mcfg, cfg.actor, params)
+    critic = None
+    if cfg.trainer.adv_estimator == "gae":
+        import jax
+
+        critic = StreamCritic(mcfg, cfg.critic, init_critic_params(
+            jax.random.PRNGKey(cfg.trainer.seed + 1), mcfg))
+    ref_policy = (ReferencePolicy(mcfg, params)
+                  if (cfg.trainer.use_kl_in_reward or cfg.actor.use_kl_loss)
+                  else None)
+    logger = Tracking(backends=tuple(cfg.logging.backends),
+                      path=cfg.logging.path or None)
+
+    return StreamRLTrainer(
+        cfg.trainer, actor, rollout, tokenizer, reward_manager, loader,
+        critic=critic, ref_policy=ref_policy, logger=logger)
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m polyrl_tpu.train",
+        description="Streaming PPO/GRPO trainer (colocated or disaggregated)")
+    parser.add_argument("--config", default=None, help="YAML run config")
+    parser.add_argument("--print-config", action="store_true",
+                        help="resolve config, print as YAML, exit")
+    parser.add_argument("overrides", nargs="*",
+                        help="dotted overrides: trainer.total_steps=100 ...")
+    args = parser.parse_args(argv)
+
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s")
+    cfg = load_config(args.config, args.overrides)
+    if args.print_config:
+        import yaml
+
+        print(yaml.safe_dump(to_dict(cfg), sort_keys=False))
+        return 0
+
+    cleanup: list = []
+    try:
+        trainer = build_trainer(cfg, cleanup)
+        history = trainer.fit()
+        if history:
+            last = history[-1]
+            log.info("finished %d steps; final metrics: %s",
+                     trainer.global_step,
+                     {k: round(v, 5) for k, v in sorted(last.items())})
+        return 0
+    finally:
+        for fn in reversed(cleanup):
+            try:
+                fn()
+            except Exception:  # noqa: BLE001
+                log.exception("cleanup failed")
+
+
+if __name__ == "__main__":
+    sys.exit(main())

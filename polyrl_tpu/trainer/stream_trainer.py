@@ -42,15 +42,18 @@ from polyrl_tpu.utils.metrics import MetricsTracker, marked_timer
 
 
 class _ResultView:
-    """Adapt a manager GenerateResult to the engine-output field names the
-    assembly code consumes."""
+    """Adapt a manager GenerateResult or a CBEngine output dict to the
+    engine-output field names the assembly code consumes."""
 
     __slots__ = ("output_ids", "output_token_logprobs")
 
     def __init__(self, res):
-        self.output_ids = np.asarray(res.output_token_ids, np.int32)
-        self.output_token_logprobs = np.asarray(res.output_token_logprobs,
-                                                np.float32)
+        if isinstance(res, dict):
+            ids, lps = res["token_ids"], res["logprobs"]
+        else:
+            ids, lps = res.output_token_ids, res.output_token_logprobs
+        self.output_ids = np.asarray(ids, np.int32)
+        self.output_token_logprobs = np.asarray(lps, np.float32)
 
 
 @dataclasses.dataclass
@@ -267,6 +270,8 @@ class StreamRLTrainer:
         else:
             with marked_timer("gen", metrics):
                 outs = self.rollout.generate(prompts, self._sampling(), rng=rng)
+                outs = [o if hasattr(o, "output_ids") else _ResultView(o)
+                        for o in outs]
             group_ids = np.repeat(np.arange(len(records), dtype=np.int32),
                                   cfg.rollout_n)
             batch = self._assemble_batch(prompts, gts, sources, outs, group_ids)

@@ -388,8 +388,13 @@ class SenderAgent:
         """Clear the manager's updating_weight CAS so the instance is
         retried next poll instead of being drained forever."""
         if self.manager is not None:
-            self._notify_pool.submit(self.manager.abort_weight_update,
-                                     [instance])
+            try:
+                self._notify_pool.submit(self.manager.abort_weight_update,
+                                         [instance])
+            except RuntimeError:
+                # agent closing: notify pool already shut down; the manager
+                # side times the CAS out on its own
+                pass
 
     def _push_instance(self, instance: str, version: int,
                        buffer: np.ndarray) -> None:

@@ -1,0 +1,144 @@
+"""Config system: YAML overlay, dotted overrides, typo protection, CLI
+entry assembly (reference config planes, SURVEY.md §5.6 / C18 / C1)."""
+
+import subprocess
+import sys
+
+import pytest
+
+from polyrl_tpu import config as cfg_lib
+from polyrl_tpu.train import build_trainer, main
+
+
+def test_defaults_and_overrides():
+    cfg = cfg_lib.load_config(overrides=[
+        "trainer.total_steps=3",
+        "trainer.train_batch_size=8",
+        "trainer.rollout_n=2",
+        "trainer.ppo_mini_batch_size=16",
+        "actor.lr=0.001",
+        "model.dtype=float32",
+        "rollout.prompt_buckets=16,32",
+        "data.shuffle=false",
+        "logging.backends=console,jsonl",
+    ])
+    assert cfg.trainer.total_steps == 3
+    assert cfg.actor.lr == 0.001
+    assert cfg.model.dtype == "float32"
+    assert cfg.rollout.prompt_buckets == (16, 32)
+    assert cfg.data.shuffle is False
+    assert cfg.logging.backends == ("console", "jsonl")
+
+
+def test_yaml_overlay_then_cli_wins(tmp_path):
+    y = tmp_path / "run.yaml"
+    y.write_text(
+        "trainer:\n  total_steps: 7\n  micro_batch_size: 4\n"
+        "model:\n  preset: tiny\n  overrides:\n    vocab_size: 512\n"
+    )
+    cfg = cfg_lib.load_config(str(y), ["trainer.total_steps=9"])
+    assert cfg.trainer.total_steps == 9          # CLI > file
+    assert cfg.trainer.micro_batch_size == 4     # file > default
+    assert cfg.model.overrides == {"vocab_size": 512}
+
+
+def test_unknown_keys_rejected(tmp_path):
+    y = tmp_path / "bad.yaml"
+    y.write_text("trainer:\n  totol_steps: 7\n")
+    with pytest.raises(KeyError):
+        cfg_lib.load_config(str(y))
+    with pytest.raises(KeyError):
+        cfg_lib.load_config(overrides=["trainer.nope=1"])
+
+
+def test_trainer_validation_runs_after_overrides():
+    with pytest.raises(ValueError):
+        cfg_lib.load_config(overrides=[
+            "trainer.train_batch_size=3", "trainer.rollout_n=3",
+            "trainer.ppo_mini_batch_size=64"])
+
+
+def test_roundtrip_to_dict():
+    cfg = cfg_lib.load_config()
+    d = cfg_lib.to_dict(cfg)
+    assert d["trainer"]["total_steps"] == cfg.trainer.total_steps
+    assert isinstance(d["logging"]["backends"], list)
+
+
+_FAST = [
+    "model.dtype=float32",
+    "model.overrides={\"vocab_size\": 512, \"max_position_embeddings\": 128}",
+    "trainer.train_batch_size=4", "trainer.rollout_n=2",
+    "trainer.ppo_mini_batch_size=8", "trainer.micro_batch_size=4",
+    "trainer.min_stream_batch_size=4", "trainer.max_prompt_length=16",
+    "trainer.max_response_length=8", "trainer.total_steps=1",
+    "rollout.backend=step", "rollout.batch_buckets=16",
+    "rollout.prompt_buckets=16", "rollout.kv_cache_dtype=float32",
+    "data.arithmetic_size=32", "reward.num_workers=1",
+    "logging.backends=",
+]
+
+
+def test_build_trainer_colocated_and_fit():
+    cfg = cfg_lib.load_config(overrides=list(_FAST))
+    trainer = build_trainer(cfg)
+    history = trainer.fit()
+    assert len(history) == 1
+    assert "actor/pg_loss" in history[0]
+
+
+def test_build_trainer_disaggregated_assembly():
+    """train.py's disaggregated wiring: spawned manager + fabric + remote
+    rollout, one step against an in-process rollout server."""
+    import time as _time
+
+    from polyrl_tpu.manager.client import ManagerClient, spawn_rollout_manager
+    from polyrl_tpu.rollout.remote import RemoteRollout
+    from polyrl_tpu.rollout.serve import create_server, register_with_manager
+
+    proc, port = spawn_rollout_manager(
+        extra_args=["--health-check-interval-s", "0.1",
+                    "--stats-poll-interval-s", "0.2"])
+    srv = None
+    cleanup = []
+    try:
+        srv = create_server("tiny", dtype="float32", host="127.0.0.1",
+                            backend="step", batch_buckets=(16,),
+                            prompt_buckets=(16,), transfer_streams=2)
+        cfg = cfg_lib.load_config(overrides=[
+            "model.dtype=float32",
+            "trainer.train_batch_size=4", "trainer.rollout_n=2",
+            "trainer.ppo_mini_batch_size=8", "trainer.micro_batch_size=4",
+            "trainer.min_stream_batch_size=4", "trainer.max_prompt_length=16",
+            "trainer.max_response_length=8", "trainer.total_steps=1",
+            "rollout.mode=disaggregated",
+            f"rollout.manager_endpoint=127.0.0.1:{port}",
+            "rollout.transfer_streams=2",
+            "data.arithmetic_size=16", "reward.num_workers=1",
+            "logging.backends=",
+        ])
+        trainer = build_trainer(cfg, cleanup)
+        assert isinstance(trainer.rollout, RemoteRollout)
+        register_with_manager(srv, f"127.0.0.1:{port}", transfer_streams=2)
+        mgr = ManagerClient(f"127.0.0.1:{port}")
+        t0 = _time.monotonic()
+        while _time.monotonic() - t0 < 10:
+            st = mgr.get_instances_status()
+            if any(i["healthy"] for i in st["instances"]):
+                break
+            _time.sleep(0.1)
+        history = trainer.fit()
+        assert len(history) == 1 and "actor/pg_loss" in history[0]
+    finally:
+        for fn in reversed(cleanup):
+            fn()
+        if srv is not None:
+            srv.stop()
+        proc.kill()
+
+
+def test_main_print_config(capsys):
+    rc = main(["--print-config", "trainer.total_steps=42"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "total_steps: 42" in out
