@@ -112,6 +112,162 @@ def compute_score_math(solution_str: str, ground_truth: str) -> float:
     return 0.0
 
 
+def compute_score_math_dapo(
+    solution_str: str,
+    ground_truth: str,
+    correct_score: float = 1.0,
+    incorrect_score: float = -1.0,
+) -> float:
+    """DAPO/AIME-style strict scoring: the answer must appear in a
+    ``\\boxed{}``; correct → +1, anything else → −1 (the reference's
+    math_dapo scorer's ±1 scheme, reward_score/__init__.py dispatch row
+    math_dapo/aime)."""
+    answer = extract_boxed_answer(solution_str)
+    if answer is None:
+        return incorrect_score
+    ok = compute_score_math(f"\\boxed{{{answer}}}", ground_truth) > 0.0
+    return correct_score if ok else incorrect_score
+
+
+_ANSWER_PATTERNS = (
+    re.compile(r"(?:final answer|answer)\s*(?:is|:)\s*([^\n.,;]+)", re.IGNORECASE),
+)
+
+
+def compute_score_prime_math(solution_str: str, ground_truth: str) -> float:
+    """Robust math equivalence with fallback extraction (the reference's
+    numina → prime_math route): boxed first, then 'answer is X' phrasing,
+    then last number."""
+    if compute_score_math(solution_str, ground_truth) > 0.0:
+        return 1.0
+    gt = _normalize_math(ground_truth)
+    for pat in _ANSWER_PATTERNS:
+        matches = pat.findall(solution_str)
+        if matches and (_normalize_math(matches[-1]) == gt
+                        or _num_eq(_normalize_math(matches[-1]), gt)):
+            return 1.0
+    last = extract_gsm8k_answer(solution_str, method="flexible")
+    if last is not None and _num_eq(last, gt):
+        return 1.0
+    return 0.0
+
+
+# -- code execution (local sandbox) -----------------------------------------
+
+_CODE_BLOCK_RE = re.compile(r"```(?:python|py)?\s*\n(.*?)```", re.DOTALL)
+
+
+def extract_code(solution_str: str) -> str | None:
+    """Last fenced code block, else None."""
+    blocks = _CODE_BLOCK_RE.findall(solution_str)
+    return blocks[-1].strip() if blocks else None
+
+
+def _run_sandboxed(code: str, stdin: str, timeout_s: float) -> tuple[bool, str]:
+    """Run model-emitted code in an isolated python subprocess with CPU and
+    memory rlimits — the local stand-in for the reference's sandbox-fusion
+    code-execution service (reward.py:95-150)."""
+    import resource
+    import subprocess
+    import sys
+
+    def limits():
+        resource.setrlimit(resource.RLIMIT_CPU, (int(timeout_s) + 1,) * 2)
+        resource.setrlimit(resource.RLIMIT_AS, (1 << 30,) * 2)
+        resource.setrlimit(resource.RLIMIT_NPROC, (64, 64))
+
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-I", "-c", code], input=stdin,
+            capture_output=True, text=True, timeout=timeout_s,
+            preexec_fn=limits)
+    except subprocess.TimeoutExpired:
+        return False, "timeout"
+    except Exception as exc:  # noqa: BLE001
+        return False, str(exc)
+    if proc.returncode != 0:
+        return False, proc.stderr[-500:]
+    return True, proc.stdout
+
+
+def compute_score_code(
+    solution_str: str,
+    ground_truth: str,
+    extra_info: dict | None = None,
+    timeout_s: float = 6.0,
+) -> float:
+    """Code-contest scoring: fraction of test cases passed (the reference's
+    prime_code / sandbox path for codecontests/apps/codeforces/taco).
+
+    Test cases come from ``extra_info`` (or JSON-decoded ``ground_truth``):
+    ``{"inputs": [...], "outputs": [...]}`` stdin/stdout pairs, or
+    ``{"asserts": "..."}`` appended to the program.
+    """
+    code = extract_code(solution_str)
+    if code is None:
+        return 0.0
+    tests = None
+    if extra_info and isinstance(extra_info.get("test_cases"), dict):
+        tests = extra_info["test_cases"]
+    else:
+        import json as _json
+
+        try:
+            parsed = _json.loads(ground_truth)
+            if isinstance(parsed, dict):
+                tests = parsed
+        except (ValueError, TypeError):
+            tests = None
+    if not tests:
+        return 0.0
+    if "asserts" in tests:
+        ok, _ = _run_sandboxed(code + "\n\n" + tests["asserts"], "", timeout_s)
+        return 1.0 if ok else 0.0
+    inputs = tests.get("inputs", [])
+    outputs = tests.get("outputs", [])
+    if not inputs:
+        return 0.0
+    passed = 0
+    for stdin, expect in zip(inputs, outputs):
+        ok, out = _run_sandboxed(code, str(stdin), timeout_s)
+        if ok and out.strip() == str(expect).strip():
+            passed += 1
+    return passed / len(inputs)
+
+
+# -- QA exact match ---------------------------------------------------------
+
+_ARTICLES_RE = re.compile(r"\b(a|an|the)\b")
+_PUNCT_RE = re.compile(r"[^\w\s]")
+
+
+def _normalize_qa(text: str) -> str:
+    text = text.lower()
+    text = _PUNCT_RE.sub(" ", text)
+    text = _ARTICLES_RE.sub(" ", text)
+    return " ".join(text.split())
+
+
+def compute_score_qa_em(
+    solution_str: str,
+    ground_truth: str,
+    extra_info: dict | None = None,
+) -> float:
+    """SearchR1-style QA exact match (reference searchR1 QA-EM row):
+    normalized answer (inside <answer></answer> tags when present, else the
+    full response tail) must equal one of the gold answers
+    ('|||'-separated)."""
+    m = re.findall(r"<answer>(.*?)</answer>", solution_str, re.DOTALL)
+    cand = m[-1] if m else solution_str
+    cand_n = _normalize_qa(cand)
+    golds = [g for g in (ground_truth or "").split("|||")]
+    for g in golds:
+        gn = _normalize_qa(g)
+        if gn and (cand_n == gn or (m and gn in cand_n)):
+            return 1.0
+    return 0.0
+
+
 def default_compute_score(
     data_source: str,
     solution_str: str,
@@ -122,13 +278,17 @@ def default_compute_score(
     ds = (data_source or "").lower()
     if "gsm8k" in ds:
         return compute_score_gsm8k(solution_str, ground_truth)
-    if any(k in ds for k in ("math", "aime", "openr1", "deepscaler", "numina", "dapo")):
+    if any(k in ds for k in ("math_dapo", "aime", "dapo")):
+        return compute_score_math_dapo(solution_str, ground_truth)
+    if any(k in ds for k in ("numina", "prime_math")):
+        return compute_score_prime_math(solution_str, ground_truth)
+    if any(k in ds for k in ("math", "openr1", "deepscaler", "geometry3k")):
+        # geometry3k's vision-aware scorer reduces to boxed-math compare here
         return compute_score_math(solution_str, ground_truth)
     if any(k in ds for k in ("code", "apps", "taco", "codeforces")):
-        # sandboxed code execution scoring is gated off in this environment
-        # (reference uses sandbox-fusion, reward.py:95-150); fall back to
-        # exact-match of extracted answer.
-        return 1.0 if ground_truth.strip() and ground_truth.strip() in solution_str else 0.0
+        return compute_score_code(solution_str, ground_truth, extra_info)
+    if any(k in ds for k in ("searchr1", "nq", "triviaqa", "hotpotqa", "qa_em")):
+        return compute_score_qa_em(solution_str, ground_truth, extra_info)
     # default: MATH-style then gsm8k-style
     score = compute_score_math(solution_str, ground_truth)
     if score == 0.0:

@@ -1,0 +1,151 @@
+"""Reward layer breadth: per-dataset scorers (math_dapo/prime/code/QA-EM)
+and the batch/dapo/prime managers (reference C17, reward.py +
+reward_score/__init__.py:19-117)."""
+
+import numpy as np
+import pytest
+
+from polyrl_tpu.data.batch import TensorBatch
+from polyrl_tpu.rewards import scorers
+from polyrl_tpu.rewards.manager import load_reward_manager
+from polyrl_tpu.utils.tokenizer import ByteTokenizer
+
+
+# -- scorers -----------------------------------------------------------------
+
+
+def test_math_dapo_plus_minus_one():
+    f = scorers.compute_score_math_dapo
+    assert f("thus \\boxed{42}", "42") == 1.0
+    assert f("thus \\boxed{41}", "42") == -1.0
+    assert f("the answer is 42 (no box)", "42") == -1.0  # format penalty
+
+
+def test_prime_math_fallback_chain():
+    f = scorers.compute_score_prime_math
+    assert f("\\boxed{\\frac{1}{2}}", "0.5") == 1.0
+    assert f("The final answer is 17", "17") == 1.0
+    assert f("...so we get 3 then 9", "9") == 1.0        # last-number
+    assert f("nothing numeric", "9") == 0.0
+
+
+def test_qa_em():
+    f = scorers.compute_score_qa_em
+    assert f("<answer>The Eiffel Tower</answer>", "eiffel tower") == 1.0
+    assert f("I think it's the Eiffel Tower.", "Eiffel Tower") == 0.0  # untagged must EM whole
+    assert f("blah <answer>Paris, France</answer>", "paris france|||lyon") == 1.0
+    assert f("<answer>Lyon</answer>", "paris") == 0.0
+
+
+def test_code_extract_and_stdin_stdout():
+    sol = "Here:\n```python\nn = int(input())\nprint(n * 2)\n```"
+    gt = '{"inputs": ["3\\n", "5\\n"], "outputs": ["6", "10"]}'
+    assert scorers.compute_score_code(sol, gt) == 1.0
+    gt_half = '{"inputs": ["3\\n", "5\\n"], "outputs": ["6", "11"]}'
+    assert scorers.compute_score_code(sol, gt_half) == 0.5
+    assert scorers.compute_score_code("no code here", gt) == 0.0
+
+
+def test_code_asserts_and_crash():
+    sol = "```python\ndef add(a, b):\n    return a + b\n```"
+    ok = {"test_cases": {"asserts": "assert add(2, 3) == 5"}}
+    bad = {"test_cases": {"asserts": "assert add(2, 3) == 6"}}
+    assert scorers.compute_score_code(sol, "", ok) == 1.0
+    assert scorers.compute_score_code(sol, "", bad) == 0.0
+
+
+def test_code_timeout():
+    sol = "```python\nwhile True:\n    pass\n```"
+    gt = '{"inputs": [""], "outputs": [""]}'
+    assert scorers.compute_score_code(sol, gt, timeout_s=1.0) == 0.0
+
+
+def test_dispatch_routes():
+    f = scorers.default_compute_score
+    assert f("openai/gsm8k", "#### 7", "7") == 1.0
+    assert f("math_dapo", "\\boxed{1}", "2") == -1.0
+    assert f("aime_2024", "\\boxed{2}", "2") == 1.0
+    assert f("numina_math", "answer is 4", "4") == 1.0
+    assert f("searchR1_nq", "<answer>blue</answer>", "blue") == 1.0
+    assert f("geometry3k", "\\boxed{30}", "30") == 1.0
+
+
+# -- managers ----------------------------------------------------------------
+
+
+def _batch(texts, gts, tok, max_len=32, sources=None, extras=None):
+    n = len(texts)
+    responses = np.zeros((n, max_len), np.int32)
+    mask = np.zeros((n, max_len), np.float32)
+    for i, t in enumerate(texts):
+        ids = tok.encode(t)[:max_len]
+        responses[i, : len(ids)] = ids
+        mask[i, : len(ids)] = 1.0
+    non_tensors = {"ground_truth": gts}
+    if sources is not None:
+        non_tensors["data_source"] = sources
+    if extras is not None:
+        non_tensors["extra_info"] = extras
+    return TensorBatch.from_dict(
+        tensors={"responses": responses, "response_mask": mask},
+        non_tensors=non_tensors)
+
+
+def test_batch_manager_single_call():
+    tok = ByteTokenizer()
+    calls = []
+
+    def batch_score(sources, texts, gts, extras):
+        calls.append(len(texts))
+        return [1.0 if g in t else 0.0 for t, g in zip(texts, gts)]
+
+    mgr = load_reward_manager("batch", tok, compute_score=batch_score,
+                              num_workers=1)
+    out = mgr(_batch(["x=5 done", "nope"], ["5", "5"], tok))
+    assert calls == [2]
+    assert out.scores.tolist() == [1.0, 0.0]
+    # scalar lands on last response token
+    i = np.argmax(out.token_level_scores[0])
+    assert out.token_level_scores[0, i] == 1.0
+
+
+def test_dapo_manager_overlong_penalty():
+    tok = ByteTokenizer()
+    long_text = "a" * 30   # length 30 of max 32, buffer 8 → expected 24, over 6
+    short_text = "b" * 10
+
+    mgr = load_reward_manager(
+        "dapo", tok, compute_score=lambda *a: 1.0, num_workers=1,
+        max_response_length=32, overlong_buffer_len=8, penalty_factor=1.0)
+    out = mgr(_batch([long_text, short_text], ["", ""], tok))
+    assert out.scores[1] == 1.0                       # short: untouched
+    assert out.scores[0] == pytest.approx(1.0 - 6 / 8)
+    assert "reward/overlong_penalty_mean" in out.metrics
+
+
+def test_prime_manager_timeout_and_errors():
+    tok = ByteTokenizer()
+
+    def flaky(source, text, gt, extra):
+        if "crash" in text:
+            raise RuntimeError("boom")
+        return 1.0
+
+    mgr = load_reward_manager("prime", tok, compute_score=flaky,
+                              num_workers=2, timeout_s=5.0)
+    out = mgr(_batch(["fine", "crash now"], ["", ""], tok))
+    assert out.scores.tolist() == [1.0, 0.0]
+    assert out.metrics["reward/score_errors"] == 1.0
+
+
+def test_naive_manager_passes_extra_info():
+    tok = ByteTokenizer()
+    seen = []
+
+    def spy(source, text, gt, extra):
+        seen.append(extra)
+        return 0.0
+
+    mgr = load_reward_manager("naive", tok, compute_score=spy, num_workers=1)
+    mgr(_batch(["t"], [""], tok, extras=[{"k": 1}]))
+    assert seen == [{"k": 1}]
