@@ -159,9 +159,11 @@ def build_trainer(cfg: RunConfig, cleanup: list | None = None):
     logger = Tracking(backends=tuple(cfg.logging.backends),
                       path=cfg.logging.path or None)
 
+    val_dataset = build_dataset(cfg, "val")
     return StreamRLTrainer(
         cfg.trainer, actor, rollout, tokenizer, reward_manager, loader,
-        critic=critic, ref_policy=ref_policy, logger=logger)
+        critic=critic, ref_policy=ref_policy, logger=logger,
+        val_dataset=val_dataset)
 
 
 def main(argv: list[str] | None = None) -> int:

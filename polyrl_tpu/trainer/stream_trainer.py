@@ -78,6 +78,14 @@ class TrainerConfig:
     # run
     total_steps: int = 10
     seed: int = 0
+    # validation (reference _validate + test_freq/val_before_train gates,
+    # stream_ray_trainer.py:304-315,589-603; sample dump :585-587)
+    test_freq: int = 0                    # validate every N steps (0 = off)
+    val_before_train: bool = False
+    val_temperature: float = 0.0          # greedy by default
+    val_max_response_length: int = 0      # 0 → max_response_length
+    rollout_data_dir: str = ""            # dump val generations as jsonl
+    val_generations_to_log: int = 0       # echo first K generations to logger
     # checkpoint/resume (reference _save_checkpoint gating,
     # stream_ray_trainer.py:604-623; SURVEY.md §5.4)
     ckpt_dir: str | None = None
@@ -123,6 +131,7 @@ class StreamRLTrainer:
         critic: StreamCritic | None = None,
         ref_policy: ReferencePolicy | None = None,
         logger=None,
+        val_dataset=None,
     ):
         self.cfg = cfg
         self.actor = actor
@@ -133,6 +142,7 @@ class StreamRLTrainer:
         self.critic = critic
         self.ref_policy = ref_policy
         self.logger = logger
+        self.val_dataset = val_dataset
         self.global_step = 0
         # local-generation budget from the manager's balancer (None until the
         # first update_metrics round trip; manager default applies)
@@ -336,6 +346,94 @@ class StreamRLTrainer:
             ibatch.tensors["returns"] = np.asarray(ret)
         return ibatch
 
+    # -- validation (reference _validate, stream_ray_trainer.py:304-315) --
+
+    def _generate_all(self, prompts: list[list[int]], sampling: SamplingParams):
+        """Generate for every prompt with either rollout flavour; returns
+        outputs aligned with ``prompts``."""
+        if isinstance(self.rollout, RemoteRollout):
+            outs: list = [None] * len(prompts)
+            for chunk in self.rollout.generate_stream(
+                    prompts, sampling, group_size=1, min_emit=len(prompts)):
+                for i, res in chunk:
+                    outs[i] = _ResultView(res)
+            # dropped groups leave holes; substitute empty outputs
+            empty = type("E", (), {"output_ids": np.zeros(0, np.int32),
+                                   "output_token_logprobs": np.zeros(0, np.float32)})
+            return [o if o is not None else empty for o in outs]
+        outs = self.rollout.generate(prompts, sampling,
+                                     rng=jax.random.PRNGKey(0))
+        return [o if hasattr(o, "output_ids") else _ResultView(o) for o in outs]
+
+    def _validate(self) -> dict:
+        """Greedy eval over the val dataset: per-data-source mean score +
+        overall; optional generation dump (reference sample dump dir,
+        stream_ray_trainer.py:585-587)."""
+        cfg = self.cfg
+        records = list(self.val_dataset)
+        sampling = SamplingParams(
+            temperature=cfg.val_temperature, top_p=1.0, top_k=0,
+            max_new_tokens=cfg.val_max_response_length or cfg.max_response_length,
+            stop_token_ids=(self.tokenizer.eos_token_id,),
+        )
+        per_source: dict[str, list[float]] = {}
+        dump_rows: list[dict] = []
+        bs = max(cfg.train_batch_size, 1)
+        for lo in range(0, len(records), bs):
+            chunk = records[lo : lo + bs]
+            prompts = [self.tokenizer.encode(r["prompt"])[: cfg.max_prompt_length]
+                       for r in chunk]
+            outs = self._generate_all(prompts, sampling)
+            gts = [r.get("ground_truth", "") for r in chunk]
+            sources = [r.get("data_source", "") for r in chunk]
+            batch = self._assemble_batch(prompts, gts, sources, outs,
+                                         list(range(len(chunk))))
+            reward_out = self.reward_manager(batch)
+            for src, sc in zip(sources, reward_out.scores):
+                per_source.setdefault(src or "default", []).append(float(sc))
+            if cfg.rollout_data_dir or cfg.val_generations_to_log:
+                texts = self.tokenizer.batch_decode(
+                    [np.asarray(o.output_ids) for o in outs],
+                    skip_special_tokens=True)
+                for r, txt, sc in zip(chunk, texts, reward_out.scores):
+                    dump_rows.append({
+                        "step": self.global_step, "prompt": r["prompt"],
+                        "response": txt, "score": float(sc),
+                        "ground_truth": r.get("ground_truth", ""),
+                        "data_source": r.get("data_source", "")})
+        metrics = {f"val/test_score/{src}": float(np.mean(v))
+                   for src, v in per_source.items()}
+        all_scores = [s for v in per_source.values() for s in v]
+        metrics["val/test_score/mean"] = (
+            float(np.mean(all_scores)) if all_scores else 0.0)
+        if cfg.rollout_data_dir and dump_rows:
+            import json
+            import os
+
+            os.makedirs(cfg.rollout_data_dir, exist_ok=True)
+            path = os.path.join(cfg.rollout_data_dir,
+                                f"val_step{self.global_step}.jsonl")
+            with open(path, "w") as f:
+                for row in dump_rows:
+                    f.write(json.dumps(row) + "\n")
+        if cfg.val_generations_to_log and self.logger is not None and dump_rows:
+            for row in dump_rows[: cfg.val_generations_to_log]:
+                self.logger.log({"val/generation": 0.0, **{
+                    k: v for k, v in row.items() if isinstance(v, float)}},
+                    step=self.global_step)
+        return metrics
+
+    def _maybe_validate(self, metrics: MetricsTracker, *, force: bool = False) -> None:
+        cfg = self.cfg
+        if self.val_dataset is None:
+            return
+        due = force or (cfg.test_freq > 0 and self.global_step > 0
+                        and self.global_step % cfg.test_freq == 0)
+        if not due:
+            return
+        with marked_timer("testing", metrics):
+            metrics.update(self._validate())
+
     # -- fit --------------------------------------------------------------
 
     def fit(self) -> list[dict]:
@@ -349,6 +447,13 @@ class StreamRLTrainer:
                             step=self.global_step)
         # bootstrap weights into the rollout engine (reference fit :340)
         self.rollout.update_weights(self.actor.params)
+        if cfg.val_before_train and self.val_dataset is not None:
+            pre = MetricsTracker()
+            self._maybe_validate(pre, force=True)
+            rec = pre.as_dict()
+            history.append(rec)
+            if self.logger is not None:
+                self.logger.log(rec, step=self.global_step)
 
         while self.global_step < cfg.total_steps:
             metrics = MetricsTracker()
@@ -446,6 +551,8 @@ class StreamRLTrainer:
                         "training/max_local_gen_s": self._max_local_gen_s,
                         "training/num_rollout_instances":
                             float(resp.get("num_instances", 0))})
+            self._maybe_validate(metrics,
+                                 force=self.global_step >= cfg.total_steps)
             if self._ckpt is not None and ckpt_lib.should_save_checkpoint(
                 self.global_step, cfg.total_steps, cfg.save_freq,
                 esi_expiry_ts=self._esi_expiry, esi_margin_s=cfg.esi_margin_s,

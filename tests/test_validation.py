@@ -1,0 +1,92 @@
+"""Validation loop: greedy eval, per-source aggregation, generation dump,
+test_freq/val_before_train gating (reference _validate,
+stream_ray_trainer.py:304-315,585-603)."""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from polyrl_tpu.data.dataset import (PromptDataLoader, RLDataset,
+                                     make_arithmetic_dataset)
+from polyrl_tpu.models import decoder
+from polyrl_tpu.rewards.manager import load_reward_manager
+from polyrl_tpu.rollout.engine import RolloutEngine
+from polyrl_tpu.trainer.actor import ActorConfig, StreamActor
+from polyrl_tpu.trainer.stream_trainer import StreamRLTrainer, TrainerConfig
+from polyrl_tpu.utils.tokenizer import ByteTokenizer
+
+
+def _make(tmp_path, *, total_steps=2, test_freq=1, val_before=True,
+          dump=True, val_records=None):
+    cfg = decoder.get_config(
+        "tiny", dtype=jnp.float32, vocab_size=512, max_position_embeddings=128)
+    params = decoder.init_params(jax.random.PRNGKey(0), cfg)
+    tok = ByteTokenizer()
+    engine = RolloutEngine(
+        cfg, params, pad_token_id=tok.pad_token_id,
+        batch_buckets=(16,), prompt_buckets=(16,), kv_cache_dtype=jnp.float32)
+    tcfg = TrainerConfig(
+        train_batch_size=4, rollout_n=2, ppo_mini_batch_size=8,
+        micro_batch_size=4, min_stream_batch_size=4,
+        max_prompt_length=16, max_response_length=8,
+        adv_estimator="grpo", total_steps=total_steps,
+        test_freq=test_freq, val_before_train=val_before,
+        rollout_data_dir=str(tmp_path / "dump") if dump else "")
+    actor = StreamActor(cfg, ActorConfig(lr=1e-4, remat=False), params)
+    val = RLDataset(val_records) if val_records is not None else RLDataset([
+        {"prompt": "1+1=", "ground_truth": "2", "data_source": "gsm8k"},
+        {"prompt": "2+2=", "ground_truth": "4", "data_source": "gsm8k"},
+        {"prompt": "q?", "ground_truth": "x", "data_source": "other"},
+    ])
+    return StreamRLTrainer(
+        tcfg, actor, engine, tok,
+        load_reward_manager("naive", tok, num_workers=1),
+        PromptDataLoader(make_arithmetic_dataset(32), 4),
+        val_dataset=val), tcfg
+
+
+def test_validation_runs_and_aggregates(tmp_path):
+    trainer, tcfg = _make(tmp_path)
+    history = trainer.fit()
+    # val_before_train adds a pre-step record
+    assert "val/test_score/mean" in history[0]
+    assert "timing_s/testing" in history[0]
+    # per-source aggregation keys exist
+    assert "val/test_score/gsm8k" in history[0]
+    assert "val/test_score/other" in history[0]
+    # validated again at test_freq=1 on both steps
+    assert "val/test_score/mean" in history[1]
+    assert "val/test_score/mean" in history[2]
+    # dump files written per validation step
+    dumps = sorted(os.listdir(tmp_path / "dump"))
+    assert dumps == ["val_step0.jsonl", "val_step1.jsonl", "val_step2.jsonl"]
+    rows = [json.loads(l) for l in open(tmp_path / "dump" / "val_step1.jsonl")]
+    assert len(rows) == 3
+    assert {"step", "prompt", "response", "score", "ground_truth",
+            "data_source"} <= set(rows[0])
+
+
+def test_validation_gating_off(tmp_path):
+    trainer, _ = _make(tmp_path, test_freq=0, val_before=False, dump=False,
+                       total_steps=1)
+    history = trainer.fit()
+    # only the forced final validation runs (last step, val set present)
+    assert len(history) == 1
+    assert "val/test_score/mean" in history[0]
+
+
+def test_no_val_dataset_no_validation(tmp_path):
+    trainer, _ = _make(tmp_path, total_steps=1)
+    trainer.val_dataset = None
+    history = trainer.fit()
+    assert all("val/test_score/mean" not in h for h in history)
+
+
+def test_val_greedy_deterministic(tmp_path):
+    trainer, _ = _make(tmp_path, dump=False)
+    m1 = trainer._validate()
+    m2 = trainer._validate()
+    assert m1 == m2
