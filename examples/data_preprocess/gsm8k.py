@@ -1,0 +1,82 @@
+"""Preprocess GSM8K into the framework's prompt parquet format.
+
+Equivalent of the reference's data-preprocess recipes (SURVEY.md C19,
+``examples/data_preprocess/openr1.py:26-88`` pattern): each row carries
+``prompt`` / ``ground_truth`` / ``data_source`` / ``extra_info`` — the
+fields the reward layer dispatches on.
+
+Usage:
+  python examples/data_preprocess/gsm8k.py --out-dir ~/data/gsm8k
+  python examples/data_preprocess/gsm8k.py --local-json train.jsonl --split train
+
+With no --local-json, loads ``openai/gsm8k`` via HuggingFace datasets
+(needs network/cache); with it, reads {"question","answer"} JSONL rows.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+
+INSTRUCTION = 'Let\'s think step by step and output the final answer after "####".'
+
+
+def extract_solution(answer: str) -> str:
+    m = re.search(r"####\s*(-?[0-9.,]+)", answer)
+    return m.group(1).replace(",", "") if m else answer.strip()
+
+
+def to_record(row: dict, split: str, idx: int) -> dict:
+    question = row["question"].strip()
+    return {
+        "prompt": f"{question} {INSTRUCTION}",
+        "ground_truth": extract_solution(row["answer"]),
+        "data_source": "openai/gsm8k",
+        "extra_info": {"split": split, "index": idx,
+                       "answer": row["answer"]},
+    }
+
+
+def write_parquet(records: list[dict], path: str) -> None:
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    # extra_info as JSON string keeps the schema flat/portable
+    rows = [{**r, "extra_info": json.dumps(r["extra_info"])} for r in records]
+    pq.write_table(pa.Table.from_pylist(rows), path)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out-dir", default="data/gsm8k")
+    ap.add_argument("--local-json", default=None,
+                    help="offline mode: JSONL with question/answer rows")
+    ap.add_argument("--split", default=None,
+                    help="with --local-json: which split this file is")
+    args = ap.parse_args()
+    os.makedirs(args.out_dir, exist_ok=True)
+
+    if args.local_json:
+        split = args.split or "train"
+        with open(args.local_json) as f:
+            rows = [json.loads(l) for l in f if l.strip()]
+        records = [to_record(r, split, i) for i, r in enumerate(rows)]
+        out = os.path.join(args.out_dir, f"{split}.parquet")
+        write_parquet(records, out)
+        print(f"wrote {len(records)} rows -> {out}")
+        return
+
+    import datasets
+
+    ds = datasets.load_dataset("openai/gsm8k", "main")
+    for split in ("train", "test"):
+        records = [to_record(r, split, i) for i, r in enumerate(ds[split])]
+        out = os.path.join(args.out_dir, f"{split}.parquet")
+        write_parquet(records, out)
+        print(f"wrote {len(records)} rows -> {out}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,68 @@
+"""Preprocess OpenR1-Math-220k into the framework's prompt parquet format.
+
+Mirrors the reference recipe (``examples/data_preprocess/openr1.py:26-88``):
+problem + boxed-answer instruction as the prompt, the gold ``answer`` as
+``ground_truth``, routed to the MATH scorer via ``data_source``.
+
+Usage:
+  python examples/data_preprocess/openr1.py --out-dir ~/data/openr1
+  python examples/data_preprocess/openr1.py --local-json problems.jsonl
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+
+INSTRUCTION = ("Please reason step by step, and put your final answer "
+               "within \\boxed{}.")
+
+
+def to_record(row: dict, split: str, idx: int) -> dict:
+    problem = (row.get("problem") or row.get("question") or "").strip()
+    answer = str(row.get("answer") or row.get("ground_truth") or "").strip()
+    return {
+        "prompt": f"{problem}\n{INSTRUCTION}",
+        "ground_truth": answer,
+        "data_source": "openr1_math",
+        "extra_info": {"split": split, "index": idx},
+    }
+
+
+def write_parquet(records: list[dict], path: str) -> None:
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    rows = [{**r, "extra_info": json.dumps(r["extra_info"])} for r in records]
+    pq.write_table(pa.Table.from_pylist(rows), path)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out-dir", default="data/openr1")
+    ap.add_argument("--local-json", default=None)
+    ap.add_argument("--split", default="train")
+    ap.add_argument("--train-size", type=int, default=0,
+                    help="cap rows (0 = all); reference caps via config")
+    args = ap.parse_args()
+    os.makedirs(args.out_dir, exist_ok=True)
+
+    if args.local_json:
+        with open(args.local_json) as f:
+            rows = [json.loads(l) for l in f if l.strip()]
+    else:
+        import datasets
+
+        rows = datasets.load_dataset(
+            "open-r1/OpenR1-Math-220k", "default")[args.split]
+    if args.train_size:
+        rows = list(rows)[: args.train_size]
+    records = [to_record(r, args.split, i) for i, r in enumerate(rows)]
+    out = os.path.join(args.out_dir, f"{args.split}.parquet")
+    write_parquet(records, out)
+    print(f"wrote {len(records)} rows -> {out}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,14 @@
+#!/usr/bin/env bash
+# Launch one rollout worker and register it with the manager (reference
+# launch_sglang.sh: weight-transfer agent on, manager registration).
+set -euo pipefail
+
+MODEL=${MODEL:-qwen3-1.7b}
+MANAGER=${MANAGER:?set MANAGER=<head-host>:<port>}
+PORT=${PORT:-30000}
+
+python -m polyrl_tpu.rollout.serve \
+    --model "$MODEL" \
+    --manager-endpoint "$MANAGER" \
+    --port "$PORT" \
+    "$@"

@@ -1,0 +1,10 @@
+#!/usr/bin/env bash
+# Synchronous colocated GRPO baseline (reference run_sync_grpo_default.sh,
+# SURVEY.md §3.5): same trainer, in-process rollout engine, no manager.
+set -euo pipefail
+
+CONFIG=${CONFIG:-examples/configs/stream_grpo_qwen3_1p7b.yaml}
+
+python -m polyrl_tpu.train --config "$CONFIG" \
+    rollout.mode=colocated \
+    "$@"

@@ -1,0 +1,9 @@
+#!/usr/bin/env bash
+# Disaggregated streaming GRPO recipe (reference
+# run_async_grpo_pipeline.sh). The trainer spawns the C++ rollout manager
+# on this host; rollout workers join from other hosts via launch_rollout.sh.
+set -euo pipefail
+
+CONFIG=${CONFIG:-examples/configs/stream_grpo_qwen3_1p7b.yaml}
+
+python -m polyrl_tpu.train --config "$CONFIG" "$@"

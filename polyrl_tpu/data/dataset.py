@@ -33,9 +33,16 @@ class RLDataset:
         import pyarrow.parquet as pq  # optional dep, present with pandas stacks
 
         records = pq.read_table(path).to_pylist()
-        if prompt_key != "prompt":
-            for r in records:
+        for r in records:
+            if prompt_key != "prompt":
                 r["prompt"] = r.get(prompt_key, r.get("prompt", ""))
+            # preprocess scripts store extra_info as a JSON string to keep
+            # the parquet schema flat; decode back to a dict
+            if isinstance(r.get("extra_info"), str):
+                try:
+                    r["extra_info"] = json.loads(r["extra_info"])
+                except ValueError:
+                    pass
         return cls(records)
 
     def __len__(self) -> int:
