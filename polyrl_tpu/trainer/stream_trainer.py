@@ -38,6 +38,7 @@ from polyrl_tpu.rollout.sampling import SamplingParams
 from polyrl_tpu.trainer.actor import ActorConfig, ReferencePolicy, StreamActor
 from polyrl_tpu.trainer.critic import CriticConfig, StreamCritic
 from polyrl_tpu.utils import checkpoint as ckpt_lib
+from polyrl_tpu.utils.flops import FlopsCounter
 from polyrl_tpu.utils.metrics import MetricsTracker, marked_timer
 
 
@@ -78,6 +79,10 @@ class TrainerConfig:
     # run
     total_steps: int = 10
     seed: int = 0
+    # profiling (reference step-scoped profiling + nsight options,
+    # SURVEY.md §5.1; TPU equivalent = jax.profiler traces)
+    profile_steps: tuple = ()             # 1-based global steps to trace
+    profile_dir: str = "/tmp/polyrl_profile"
     # validation (reference _validate + test_freq/val_before_train gates,
     # stream_ray_trainer.py:304-315,589-603; sample dump :585-587)
     test_freq: int = 0                    # validate every N steps (0 = off)
@@ -155,6 +160,23 @@ class StreamRLTrainer:
             else None
         )
         self._esi_expiry = ckpt_lib.esi_expiry_from_env()
+        self._flops = FlopsCounter(actor.model_cfg, n_chips=jax.device_count())
+        self._tracing = False
+
+    # -- profiling (reference _start/_stop_profiling with continuous-step
+    # logic, stream_ray_trainer.py:356-361,629-641) ----------------------
+
+    def _profile_gate(self, about_to_run: int) -> None:
+        """Start/stop jax.profiler traces so that consecutive profiled steps
+        share one trace."""
+        cfg = self.cfg
+        want = about_to_run in cfg.profile_steps
+        if want and not self._tracing:
+            jax.profiler.start_trace(cfg.profile_dir)
+            self._tracing = True
+        elif not want and self._tracing:
+            jax.profiler.stop_trace()
+            self._tracing = False
 
     # -- checkpoint/resume (reference stream_ray_trainer.py:305,604-623) --
 
@@ -456,6 +478,7 @@ class StreamRLTrainer:
                 self.logger.log(rec, step=self.global_step)
 
         while self.global_step < cfg.total_steps:
+            self._profile_gate(self.global_step + 1)
             metrics = MetricsTracker()
             step_t0 = time.monotonic()
             records = next(self.dataloader)
@@ -532,13 +555,18 @@ class StreamRLTrainer:
             self.global_step += 1
             step_time = time.monotonic() - step_t0
             throughput = state["n_tokens"] / step_time if step_time else 0.0
+            n_traj = max(state["processed"], 1)
             metrics.update({
                 "training/global_step": self.global_step,
                 "perf/step_time_s": step_time,
                 "perf/trainer_bubble_s": state["bubble"],
                 "perf/throughput_tokens_per_s": throughput,
+                "perf/throughput_tok_s_per_chip":
+                    throughput / max(jax.device_count(), 1),
                 "perf/rollout_throughput_tok_s": self.rollout.last_gen_throughput,
             })
+            metrics.update(self._flops.step_metrics(
+                state["n_tokens"], state["n_tokens"] / n_traj, step_time))
             if isinstance(self.rollout, RemoteRollout):
                 # actuating metrics: the balancer returns the next
                 # local-generation budget (handlers.rs:867-901)
@@ -563,6 +591,7 @@ class StreamRLTrainer:
             history.append(record)
             if self.logger is not None:
                 self.logger.log(record, step=self.global_step)
+        self._profile_gate(-1)  # close any open trace
         if self._ckpt is not None:
             self._ckpt.wait()
         return history

@@ -1,0 +1,84 @@
+"""FLOPs accounting + MFU (reference verl ``FlopsCounter``, consumed at
+``stream_fsdp_workers.py:63`` and surfaced as ``perf/throughput_all_gpus``-
+style metrics, stream_ray_trainer.py:656-663).
+
+Per-token transformer FLOPs use the standard decomposition: ~6·P for the
+dense path (fwd 2·P, bwd 4·P) plus the attention quadratic term
+12·L·H·s per token at context length s (fwd+bwd; halve both for
+inference-only). Peak chip FLOP/s defaults to TPU v5e bf16 and can be
+overridden (env ``POLYRL_PEAK_TFLOPS`` or argument) for other parts.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any
+
+# bf16 peak TFLOP/s per chip (v5e: 197, v4: 275, v5p: 459, v6e/trillium: 918)
+PEAK_TFLOPS = {
+    "v4": 275.0,
+    "v5e": 197.0,
+    "v5p": 459.0,
+    "v6e": 918.0,
+}
+DEFAULT_PEAK_TFLOPS = 197.0
+
+
+def param_count(cfg: Any) -> int:
+    """Decoder parameter count from the ModelConfig (embed + L·(attn+mlp+
+    norms) + final norm + head)."""
+    d, L = cfg.hidden_size, cfg.num_layers
+    hd = cfg.head_dim_
+    q = d * cfg.num_heads * hd
+    kv = 2 * d * cfg.num_kv_heads * hd
+    o = cfg.num_heads * hd * d
+    mlp = 3 * d * cfg.intermediate_size       # gate, up, down
+    norms = 2 * d
+    embed = cfg.vocab_size * d
+    head = 0 if cfg.tie_word_embeddings else cfg.vocab_size * d
+    return embed + L * (q + kv + o + mlp + norms) + d + head
+
+
+def flops_per_token(cfg: Any, context_len: int, *, training: bool = True,
+                    include_embed: bool = False) -> float:
+    """FLOPs for one token at the given mean context length."""
+    p = param_count(cfg)
+    if not include_embed:
+        p -= cfg.vocab_size * cfg.hidden_size  # lookup is not a matmul
+    dense = 2.0 * p
+    attn = 4.0 * cfg.num_layers * cfg.num_heads * cfg.head_dim_ * context_len
+    fwd = dense + attn
+    return 3.0 * fwd if training else fwd     # bwd ≈ 2× fwd
+
+
+class FlopsCounter:
+    """Achieved TFLOP/s and MFU from token counts + wall time."""
+
+    def __init__(self, model_cfg: Any, peak_tflops: float | None = None,
+                 n_chips: int = 1):
+        self.cfg = model_cfg
+        env = os.environ.get("POLYRL_PEAK_TFLOPS", "")
+        self.peak_tflops = (peak_tflops if peak_tflops is not None
+                            else float(env) if env else DEFAULT_PEAK_TFLOPS)
+        self.n_chips = max(n_chips, 1)
+        self.params = param_count(model_cfg)
+
+    def estimate_flops(self, n_tokens: int, mean_context_len: float,
+                       *, training: bool = True) -> float:
+        return n_tokens * flops_per_token(self.cfg, mean_context_len,
+                                          training=training)
+
+    def step_metrics(self, n_tokens: int, mean_context_len: float,
+                     step_time_s: float, *, training: bool = True,
+                     prefix: str = "perf") -> dict:
+        if step_time_s <= 0 or n_tokens <= 0:
+            return {}
+        flops = self.estimate_flops(n_tokens, mean_context_len,
+                                    training=training)
+        achieved_tflops = flops / step_time_s / 1e12
+        per_chip = achieved_tflops / self.n_chips
+        return {
+            f"{prefix}/tflops_all_chips": achieved_tflops,
+            f"{prefix}/tflops_per_chip": per_chip,
+            f"{prefix}/mfu": per_chip / self.peak_tflops,
+        }

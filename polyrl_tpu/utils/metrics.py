@@ -49,12 +49,17 @@ def marked_timer(name: str, tracker: MetricsTracker):
 
 
 class Tracking:
-    """Console/JSONL/TensorBoard multiplexing logger (reference Tracking)."""
+    """Console/JSONL/TensorBoard/W&B multiplexing logger (reference
+    Tracking, stream_ray_trainer.py:291-298). Unavailable backends degrade
+    to no-ops instead of failing the run."""
 
-    def __init__(self, backends: tuple[str, ...] = ("console",), path: str | None = None):
+    def __init__(self, backends: tuple[str, ...] = ("console",),
+                 path: str | None = None, project: str = "polyrl_tpu",
+                 run_name: str | None = None, config: dict | None = None):
         self.backends = backends
         self._file = open(path, "a") if path and "jsonl" in backends else None
         self._tb = None
+        self._wandb = None
         if "tensorboard" in backends:
             try:
                 from torch.utils.tensorboard import SummaryWriter
@@ -62,6 +67,14 @@ class Tracking:
                 self._tb = SummaryWriter(path or "runs")
             except Exception:
                 self._tb = None
+        if "wandb" in backends:
+            try:
+                import wandb
+
+                self._wandb = wandb.init(project=project, name=run_name,
+                                         config=config or {})
+            except Exception:
+                self._wandb = None
 
     def log(self, metrics: dict, step: int) -> None:
         if "console" in self.backends:
@@ -74,9 +87,13 @@ class Tracking:
         if self._tb is not None:
             for k, v in metrics.items():
                 self._tb.add_scalar(k, v, step)
+        if self._wandb is not None:
+            self._wandb.log(metrics, step=step)
 
     def close(self) -> None:
         if self._file:
             self._file.close()
         if self._tb:
             self._tb.close()
+        if self._wandb:
+            self._wandb.finish()
