@@ -439,6 +439,66 @@ def prefill_into_pages(
     return (k_pools, v_pools), last_logits
 
 
+def prefill_suffix_into_pages(
+    params: dict,
+    cfg: ModelConfig,
+    ids: jnp.ndarray,             # [pb] int32 right-padded suffix tokens
+    suffix_len: jnp.ndarray,      # scalar int32 — real suffix tokens
+    prefix_len: jnp.ndarray,      # scalar int32 — cached tokens (whole pages)
+    pools: tuple,
+    prefix_page_ids: jnp.ndarray, # [n_prefix_pg] int32 (0/null-padded tail)
+    page_ids: jnp.ndarray,        # [pb // page_size] int32 suffix pages
+) -> tuple[tuple, jnp.ndarray]:
+    """Prefix-cache prefill: compute KV only for the suffix while attending
+    over the cached prefix pages (the compute-skip that makes page-granular
+    prefix reuse worthwhile — the TPU analogue of SGLang RadixAttention
+    prefix hits, SURVEY.md §2.2 native-census row 1).
+
+    The prefix occupies whole pages (``prefix_len`` ≤
+    ``n_prefix_pg·page_size``, padded entries null); suffix KV is scattered
+    into ``page_ids``. Returns (updated pools, last-token logits [V] f32).
+    """
+    page_size = pools[0].shape[2]
+    pb = ids.shape[0]
+    n_pg = pb // page_size
+    n_prefix_pg = prefix_page_ids.shape[0]
+    prefix_cap = n_prefix_pg * page_size
+    layers = cfg.num_layers
+    hkv, hd = cfg.num_kv_heads, cfg.head_dim_
+
+    # dense scratch cache: [prefix_cap | suffix chunk]
+    s_total = prefix_cap + pb
+    cache = make_cache(cfg, 1, s_total, dtype=pools[0].dtype)
+    k_pre = pools[0][:, prefix_page_ids]  # [L, n_pre, page, hkv, hd]
+    v_pre = pools[1][:, prefix_page_ids]
+    cache = (
+        cache[0].at[:, 0, :prefix_cap].set(
+            k_pre.reshape(layers, prefix_cap, hkv, hd)),
+        cache[1].at[:, 0, :prefix_cap].set(
+            v_pre.reshape(layers, prefix_cap, hkv, hd)),
+    )
+    # slot layout: prefix occupies [0, prefix_len); the chunk writes at
+    # write_idx=prefix_len so slot order stays temporal (padded prefix tail
+    # slots get overwritten by the chunk — they were masked anyway)
+    positions = (prefix_len + jnp.arange(pb, dtype=jnp.int32))[None]
+    slot_idx = jnp.arange(s_total)
+    valid = ((slot_idx < prefix_len)
+             | ((slot_idx >= prefix_len) & (slot_idx < prefix_len + suffix_len)))
+    logits, (k_all, v_all) = forward(
+        params, cfg, ids[None], positions, valid[None].astype(jnp.float32),
+        cache=cache, write_idx=prefix_len)
+
+    k_sfx = jax.lax.dynamic_slice_in_dim(k_all[:, 0], prefix_len, pb, axis=1)
+    v_sfx = jax.lax.dynamic_slice_in_dim(v_all[:, 0], prefix_len, pb, axis=1)
+    k_r = k_sfx.reshape(layers, n_pg, page_size, hkv, hd)
+    v_r = v_sfx.reshape(layers, n_pg, page_size, hkv, hd)
+    k_pools = pools[0].at[:, page_ids].set(k_r.astype(pools[0].dtype))
+    v_pools = pools[1].at[:, page_ids].set(v_r.astype(pools[1].dtype))
+    last_logits = jax.lax.dynamic_index_in_dim(
+        logits[0], jnp.maximum(suffix_len - 1, 0), axis=0, keepdims=False)
+    return (k_pools, v_pools), last_logits
+
+
 def make_cache(cfg: ModelConfig, batch: int, max_len: int, dtype=None) -> tuple:
     """Allocate a zeroed KV cache: (k, v) each [L, B, S, Hkv, D]."""
     dtype = dtype or cfg.dtype

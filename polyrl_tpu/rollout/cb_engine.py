@@ -42,6 +42,7 @@ import numpy as np
 
 from polyrl_tpu.models import decoder
 from polyrl_tpu.rollout.engine import next_bucket
+from polyrl_tpu.rollout.prefix_cache import PrefixCache
 from polyrl_tpu.rollout.sampling import SamplingParams, sample_token_vec
 
 log = logging.getLogger(__name__)
@@ -63,8 +64,10 @@ class _Request:
 @dataclasses.dataclass
 class _SlotInfo:
     req: _Request
-    pages: list[int]
+    pages: list[int]            # slot-PRIVATE pages (freed on finalize)
     stop_set: set
+    cache_entries: list = dataclasses.field(default_factory=list)
+    # prefix-cache refs (released on finalize; cache owns those pages)
 
 
 class PageAllocator:
@@ -104,6 +107,7 @@ class CBEngine:
         kv_cache_dtype=jnp.bfloat16,
         pad_token_id: int = 0,
         seed: int = 0,
+        enable_prefix_cache: bool = True,
     ):
         assert all(b % page_size == 0 for b in prompt_buckets), \
             "prompt buckets must be page-aligned"
@@ -133,6 +137,8 @@ class CBEngine:
         self._slots: list[_SlotInfo | None] = [None] * s
 
         self.allocator = PageAllocator(self.num_pages)
+        self.prefix_cache = (PrefixCache(page_size, self.allocator.free)
+                             if enable_prefix_cache else None)
         self._pools = decoder.make_paged_pools(
             cfg, self.num_pages, page_size, dtype=kv_cache_dtype)
         self._rng = jax.random.PRNGKey(seed)
@@ -200,6 +206,28 @@ class CBEngine:
             self._prefill_fns[pb] = jax.jit(prefill, donate_argnums=(1, 2))
         return self._prefill_fns[pb]
 
+    def _get_prefill_suffix(self, pb: int, n_prefix_pg: int):
+        """Prefix-cache-hit prefill: compute only the suffix, attend over the
+        cached prefix pages. Compile key = (suffix bucket, prefix-page
+        bucket) — both power-of-two-ish, so the cache stays small."""
+        key = ("sfx", pb, n_prefix_pg)
+        if key not in self._prefill_fns:
+            cfg = self.cfg
+
+            def prefill(params, kp, vp, ids, suffix_len, prefix_len, rng,
+                        prefix_page_ids, page_ids, temp, top_p, top_k):
+                (kp, vp), last_logits = decoder.prefill_suffix_into_pages(
+                    params, cfg, ids, suffix_len, prefix_len, (kp, vp),
+                    prefix_page_ids, page_ids)
+                rng, sub = jax.random.split(rng)
+                token, logp = sample_token_vec(
+                    last_logits[None], sub, temp[None], top_p[None],
+                    top_k[None], use_filters=True)
+                return kp, vp, rng, token[0], logp[0]
+
+            self._prefill_fns[key] = jax.jit(prefill, donate_argnums=(1, 2))
+        return self._prefill_fns[key]
+
     # -- submission API (server-facing) -------------------------------------
 
     def submit(self, rid: str, input_ids: list[int], sampling: SamplingParams,
@@ -233,6 +261,11 @@ class CBEngine:
         # shardings identical → the compiled step keeps working)
         self.params = params
         self.weight_version = self.weight_version + 1 if version is None else version
+        if self.prefix_cache is not None:
+            # cached KV belongs to the old weights (the reference flushes the
+            # radix cache after every update, patches.py:374-377)
+            with self._pool_lock:
+                self.prefix_cache.flush()
 
     def release_memory(self) -> None:
         """Pause serving and, once the decode batch drains, free the KV pool
@@ -242,6 +275,8 @@ class CBEngine:
         if self._idle.wait(timeout=30.0):
             with self._pool_lock:
                 if not self._active.any():
+                    if self.prefix_cache is not None:
+                        self.prefix_cache.flush()
                     self._pools = None
 
     def resume_memory(self) -> None:
@@ -291,6 +326,8 @@ class CBEngine:
         call; fail everything and reallocate so serving can continue."""
         self._fail_all("engine error")
         with self._pool_lock:
+            if self.prefix_cache is not None:
+                self.prefix_cache.flush()
             self._pools = decoder.make_paged_pools(
                 self.cfg, self.num_pages, self.page_size,
                 dtype=self.kv_cache_dtype)
@@ -323,37 +360,89 @@ class CBEngine:
             budget = min(req.sampling.max_new_tokens,
                          self.max_seq_len - n_prompt)
             n_pages = -(-(n_prompt + budget) // self.page_size)
-            pages = self.allocator.alloc(n_pages)
+            matched_pages: list[int] = []
+            matched_entries: list = []
+            if self.prefix_cache is not None:
+                matched_pages, matched_entries = self.prefix_cache.match(
+                    req.input_ids)
+            need = n_pages - len(matched_pages)
+            pages = self.allocator.alloc(need)
+            if pages is None and self.prefix_cache is not None:
+                # pool pressure: evict unreferenced cached pages and retry
+                if self.prefix_cache.evict(need - self.allocator.free_count):
+                    pages = self.allocator.alloc(need)
             if pages is None:
+                if self.prefix_cache is not None:
+                    self.prefix_cache.release(matched_entries)
                 return  # head-of-line waits for pages to free
             self._pending.popleft()
             try:
-                self._prefill_request(int(free_slots[0]), req, pages, budget)
+                self._prefill_request(int(free_slots[0]), req, pages, budget,
+                                      matched_pages, matched_entries)
             except Exception:
                 self.allocator.free(pages)
+                if self.prefix_cache is not None:
+                    self.prefix_cache.release(matched_entries)
                 self._emit_error(req, "prefill failed")
                 raise  # pools may be donation-poisoned: let _recover reset
         self.num_queued = len(self._pending)
 
     def _prefill_request(self, slot: int, req: _Request, pages: list[int],
-                         budget: int) -> None:
+                         budget: int, matched_pages: list[int] | None = None,
+                         matched_entries: list | None = None) -> None:
+        matched_pages = matched_pages or []
+        matched_entries = list(matched_entries or [])
         n_prompt = len(req.input_ids)
-        pb = next_bucket(n_prompt, self.prompt_buckets)
-        n_prompt_pages = -(-n_prompt // self.page_size)
-        page_ids = np.zeros((pb // self.page_size,), np.int32)
-        page_ids[:n_prompt_pages] = pages[:n_prompt_pages]
-        ids = np.full((pb,), self.pad_token_id, np.int32)
-        ids[:n_prompt] = req.input_ids
-
+        prefix_len = len(matched_pages) * self.page_size
         sp = req.sampling
-        fn = self._get_prefill(pb)
-        kp, vp, self._rng, token, logp = fn(
-            self.params, self._pools[0], self._pools[1], jnp.asarray(ids),
-            jnp.int32(n_prompt), jnp.asarray(page_ids), self._rng,
-            jnp.float32(sp.temperature), jnp.float32(sp.top_p),
-            jnp.int32(sp.top_k))
+
+        if matched_pages:
+            # prefix-cache hit: prefill only the suffix
+            suffix_len = n_prompt - prefix_len
+            pb = next_bucket(suffix_len, self.prompt_buckets)
+            n_sfx_pages = -(-suffix_len // self.page_size)
+            page_ids = np.zeros((pb // self.page_size,), np.int32)
+            page_ids[:n_sfx_pages] = pages[:n_sfx_pages]
+            n_pre_b = 1
+            while n_pre_b < len(matched_pages):
+                n_pre_b *= 2
+            prefix_ids = np.zeros((n_pre_b,), np.int32)
+            prefix_ids[:len(matched_pages)] = matched_pages
+            ids = np.full((pb,), self.pad_token_id, np.int32)
+            ids[:suffix_len] = req.input_ids[prefix_len:]
+            fn = self._get_prefill_suffix(pb, n_pre_b)
+            kp, vp, self._rng, token, logp = fn(
+                self.params, self._pools[0], self._pools[1], jnp.asarray(ids),
+                jnp.int32(suffix_len), jnp.int32(prefix_len), self._rng,
+                jnp.asarray(prefix_ids), jnp.asarray(page_ids),
+                jnp.float32(sp.temperature), jnp.float32(sp.top_p),
+                jnp.int32(sp.top_k))
+        else:
+            pb = next_bucket(n_prompt, self.prompt_buckets)
+            n_prompt_pages = -(-n_prompt // self.page_size)
+            page_ids = np.zeros((pb // self.page_size,), np.int32)
+            page_ids[:n_prompt_pages] = pages[:n_prompt_pages]
+            ids = np.full((pb,), self.pad_token_id, np.int32)
+            ids[:n_prompt] = req.input_ids
+            fn = self._get_prefill(pb)
+            kp, vp, self._rng, token, logp = fn(
+                self.params, self._pools[0], self._pools[1], jnp.asarray(ids),
+                jnp.int32(n_prompt), jnp.asarray(page_ids), self._rng,
+                jnp.float32(sp.temperature), jnp.float32(sp.top_p),
+                jnp.int32(sp.top_k))
         self._pools = (kp, vp)
         token, logp = int(token), float(logp)
+
+        # publish the prompt's freshly computed full pages; ownership of
+        # published pages moves to the cache (the slot holds refs)
+        all_pages = matched_pages + pages
+        private = list(pages)
+        if self.prefix_cache is not None:
+            published = self.prefix_cache.publish(
+                req.input_ids, all_pages, n_cached=len(matched_pages))
+            pub_pages = {e.page for _, e in published}
+            private = [p for p in pages if p not in pub_pages]
+            matched_entries += [e for _, e in published]
 
         stop_set = set(sp.stop_token_ids)
         finished = token in stop_set or budget <= 1
@@ -364,11 +453,13 @@ class CBEngine:
         self._count_tokens(1)
         if finished:
             req.out.put(STREAM_END)
-            self.allocator.free(pages)
+            self.allocator.free(private)
+            if self.prefix_cache is not None:
+                self.prefix_cache.release(matched_entries)
             return
 
         row = np.zeros((self.pages_per_slot,), np.int32)
-        row[:len(pages)] = pages
+        row[:len(all_pages)] = all_pages
         self._page_table[slot] = row
         self._seq_lens[slot] = n_prompt
         self._last_tokens[slot] = token
@@ -384,7 +475,8 @@ class CBEngine:
         for i, t in enumerate(sp.stop_token_ids[:MAX_STOP_TOKENS]):
             stops[i] = t
         self._stop_table[slot] = stops
-        self._slots[slot] = _SlotInfo(req, pages, stop_set)
+        self._slots[slot] = _SlotInfo(req, private, stop_set,
+                                      cache_entries=matched_entries)
 
     def _step_once(self) -> None:
         # host-side aborts flip slots inactive BEFORE the step
@@ -440,6 +532,8 @@ class CBEngine:
         info = self._slots[slot]
         if info is not None:
             self.allocator.free(info.pages)
+            if self.prefix_cache is not None and info.cache_entries:
+                self.prefix_cache.release(info.cache_entries)
         self._slots[slot] = None
         self._page_table[slot] = 0
         self._seq_lens[slot] = 0

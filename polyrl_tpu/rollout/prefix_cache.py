@@ -1,0 +1,138 @@
+"""Page-granular prefix cache for the continuous-batching engine.
+
+TPU-native analogue of SGLang's RadixAttention prefix cache (SURVEY.md §2.2
+native-census row 1; flushed after weight updates, reference
+patches.py:374-377): completed full pages of prompt KV are published under a
+chained page-content hash; later admissions reuse the longest matched run of
+pages and prefill only the suffix (``decoder.prefill_suffix_into_pages``).
+Pages are shared read-only with refcounts; unreferenced entries stay
+resident and are LRU-evicted back to the page allocator under pool
+pressure. GRPO's n-samples-per-prompt makes the hit rate structural: the
+first sample prefills, the other n−1 reuse every full prompt page.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable
+
+
+@dataclasses.dataclass
+class _Entry:
+    key: tuple
+    page: int
+    refcount: int = 0
+    tick: int = 0
+    orphaned: bool = False  # dropped from the map while still referenced
+
+
+class PrefixCache:
+    def __init__(self, page_size: int, free_pages: Callable[[list[int]], None]):
+        self.page_size = page_size
+        self._free_pages = free_pages
+        self._map: dict[tuple, _Entry] = {}
+        self._tick = 0
+        self.hits = 0       # pages served from cache
+        self.misses = 0     # full pages prefilled fresh
+
+    # -- keys ---------------------------------------------------------------
+
+    def _keys_for(self, tokens: list[int], n_pages: int) -> list[tuple]:
+        keys = []
+        parent: tuple = ()
+        for i in range(n_pages):
+            page_toks = tuple(tokens[i * self.page_size:(i + 1) * self.page_size])
+            parent = (hash((parent, page_toks)),)
+            keys.append(parent)
+        return keys
+
+    # -- lookup / publish ----------------------------------------------------
+
+    def match(self, tokens: list[int]) -> tuple[list[int], list[_Entry]]:
+        """Longest run of cached full pages for this prompt, holding a ref on
+        each. At least one token is always left for the suffix (the prefill
+        must produce last-token logits)."""
+        n_full = max(0, (len(tokens) - 1) // self.page_size)
+        pages: list[int] = []
+        entries: list[_Entry] = []
+        self._tick += 1
+        for key in self._keys_for(tokens, n_full):
+            e = self._map.get(key)
+            if e is None:
+                break
+            e.refcount += 1
+            e.tick = self._tick
+            pages.append(e.page)
+            entries.append(e)
+        self.hits += len(pages)
+        return pages, entries
+
+    def publish(self, tokens: list[int], page_ids: list[int],
+                n_cached: int) -> list[tuple[int, _Entry]]:
+        """Register the freshly prefilled full pages ``page_ids[n_cached:]``
+        (ownership moves to the cache; caller keeps a ref). Returns
+        ``(prompt_page_index, entry)`` for each page actually published —
+        pages whose key already exists stay caller-owned."""
+        n_full = max(0, (len(tokens) - 1) // self.page_size)
+        keys = self._keys_for(tokens, n_full)
+        out: list[tuple[int, _Entry]] = []
+        self._tick += 1
+        for i in range(n_cached, n_full):
+            key = keys[i]
+            if key in self._map:  # duplicate content: keep the existing
+                continue          # entry, caller's page stays slot-private
+            e = _Entry(key=key, page=page_ids[i], refcount=1, tick=self._tick)
+            self._map[key] = e
+            out.append((i, e))
+        self.misses += max(0, n_full - n_cached)
+        return out
+
+    # -- refs ----------------------------------------------------------------
+
+    def release(self, entries: list[_Entry]) -> None:
+        freed: list[int] = []
+        for e in entries:
+            e.refcount -= 1
+            if e.refcount == 0 and e.orphaned:
+                freed.append(e.page)
+        if freed:
+            self._free_pages(freed)
+
+    # -- eviction / flush ----------------------------------------------------
+
+    def evict(self, n_pages: int) -> int:
+        """Free up to ``n_pages`` unreferenced pages, LRU first. Returns how
+        many were freed."""
+        victims = sorted(
+            (e for e in self._map.values() if e.refcount == 0),
+            key=lambda e: e.tick)[:n_pages]
+        if not victims:
+            return 0
+        for e in victims:
+            del self._map[e.key]
+        self._free_pages([e.page for e in victims])
+        return len(victims)
+
+    def flush(self) -> None:
+        """Invalidate everything (weight update / memory release):
+        unreferenced pages return to the allocator now; referenced ones are
+        orphaned and freed when their last holder releases."""
+        freed: list[int] = []
+        for e in self._map.values():
+            if e.refcount == 0:
+                freed.append(e.page)
+            else:
+                e.orphaned = True
+        self._map.clear()
+        if freed:
+            self._free_pages(freed)
+
+    @property
+    def num_entries(self) -> int:
+        return len(self._map)
+
+    def stats(self) -> dict:
+        total = self.hits + self.misses
+        return {"prefix_cache/entries": float(len(self._map)),
+                "prefix_cache/hit_pages": float(self.hits),
+                "prefix_cache/hit_rate": self.hits / total if total else 0.0}

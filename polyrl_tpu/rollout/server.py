@@ -310,13 +310,17 @@ class RolloutServer:
     # -- telemetry / weights / memory ---------------------------------------
 
     def server_info(self) -> dict:
-        return {
+        info = {
             "num_running_reqs": self.engine.num_running,
             "num_queued_reqs": (self.engine.num_queued if self.cb
                                 else self._queue.qsize()),
             "last_gen_throughput": self.engine.last_gen_throughput,
             "weight_version": self.engine.weight_version,
         }
+        pc = getattr(self.engine, "prefix_cache", None)
+        if pc is not None:
+            info.update(pc.stats())
+        return info
 
     def update_weights_from_agent(self, version: int) -> tuple[bool, str]:
         """Load weights v``version`` from the receiver buffer into the live

@@ -1,0 +1,133 @@
+"""Prefix cache: structure unit tests + engine integration (page sharing,
+suffix prefill equivalence, weight-update flush, page conservation) —
+the TPU analogue of SGLang RadixAttention prefix reuse (SURVEY.md §2.2)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from polyrl_tpu.models import decoder
+from polyrl_tpu.rollout.cb_engine import CBEngine
+from polyrl_tpu.rollout.prefix_cache import PrefixCache
+from polyrl_tpu.rollout.sampling import SamplingParams
+
+PAGE = 4
+
+
+def _cache():
+    freed = []
+    pc = PrefixCache(PAGE, freed.extend)
+    return pc, freed
+
+
+def test_match_publish_release_cycle():
+    pc, freed = _cache()
+    toks = list(range(10))  # 2 full pages (last 2 toks + 1 reserved stay out)
+    pages, entries = pc.match(toks)
+    assert pages == [] and entries == []
+    pub = pc.publish(toks, [7, 8, 9], n_cached=0)
+    assert [i for i, _ in pub] == [0, 1]       # 2 full pages published
+    assert pc.num_entries == 2
+    # second identical prompt: both pages hit
+    pages2, entries2 = pc.match(toks)
+    assert pages2 == [7, 8]
+    assert pc.hits == 2
+    pc.release(entries2)
+    pc.release([e for _, e in pub])
+    assert freed == []                          # cache retains pages
+    assert pc.evict(10) == 2
+    assert sorted(freed) == [7, 8]
+    assert pc.num_entries == 0
+
+
+def test_exact_page_multiple_leaves_suffix():
+    pc, _ = _cache()
+    toks = list(range(8))                       # exactly 2 pages
+    pub = pc.publish(toks, [3, 4], n_cached=0)
+    assert [i for i, _ in pub] == [0]           # last page NOT cached:
+    pages, _ = pc.match(toks)                   # suffix must keep ≥1 token
+    assert pages == [3]
+
+
+def test_divergent_prompts_share_only_common_prefix():
+    pc, _ = _cache()
+    a = [1, 2, 3, 4, 5, 6, 7, 8, 9, 10]
+    b = [1, 2, 3, 4, 99, 98, 97, 96, 95, 94]
+    pc.publish(a, [11, 12, 13], n_cached=0)
+    pages, entries = pc.match(b)
+    assert pages == [11]                        # only page 0 matches
+    pc.release(entries)
+
+
+def test_flush_orphans_referenced_pages():
+    pc, freed = _cache()
+    toks = list(range(10))
+    pub = pc.publish(toks, [5, 6, 7], n_cached=0)
+    entries = [e for _, e in pub]
+    pc.flush()
+    assert pc.num_entries == 0
+    assert freed == []                          # still referenced
+    pc.release(entries)
+    assert sorted(freed) == [5, 6]              # freed on last release
+
+
+def _engine(enable_prefix_cache, seed=0):
+    import jax
+
+    cfg = decoder.get_config("tiny", dtype=jnp.float32)
+    params = decoder.init_params(jax.random.PRNGKey(0), cfg)
+    return CBEngine(cfg, params, max_slots=4, page_size=16, max_seq_len=128,
+                    prompt_buckets=(16, 32, 64), kv_cache_dtype=jnp.float32,
+                    pad_token_id=0, seed=seed,
+                    enable_prefix_cache=enable_prefix_cache)
+
+
+def _greedy(max_new=8):
+    return SamplingParams(temperature=0.0, top_p=1.0, top_k=0,
+                          max_new_tokens=max_new, stop_token_ids=(258,))
+
+
+def test_engine_prefix_hits_and_equivalence():
+    # same prompt twice: second admission reuses the first's full pages and
+    # produces IDENTICAL greedy tokens (suffix prefill == full prefill)
+    prompt = list(range(40, 40 + 37))           # 2 full 16-pages + 5 tail
+    on = _engine(True)
+    outs_on = on.generate([prompt, prompt], _greedy())
+    assert on.prefix_cache.hits >= 2            # second request hit 2 pages
+    off = _engine(False)
+    outs_off = off.generate([prompt, prompt], _greedy())
+    assert outs_on[0]["token_ids"] == outs_off[0]["token_ids"]
+    assert outs_on[1]["token_ids"] == outs_off[1]["token_ids"]
+    assert outs_on[0]["token_ids"] == outs_on[1]["token_ids"]
+    np.testing.assert_allclose(outs_on[1]["logprobs"], outs_off[1]["logprobs"],
+                               atol=1e-4)
+    on.stop(), off.stop()
+
+
+def test_engine_page_conservation_and_weight_flush():
+    prompt = list(range(40, 40 + 37))
+    eng = _engine(True)
+    eng.generate([prompt, prompt], _greedy())
+    # conservation: free + cache-resident == all allocatable pages
+    cached = eng.prefix_cache.num_entries
+    assert cached > 0
+    assert eng.allocator.free_count + cached == eng.num_pages - 1
+    eng.update_weights(eng.params)              # flush (radix-flush parity)
+    assert eng.prefix_cache.num_entries == 0
+    assert eng.allocator.free_count == eng.num_pages - 1
+    # serving still works after the flush
+    outs = eng.generate([prompt], _greedy())
+    assert len(outs[0]["token_ids"]) > 0
+    eng.stop()
+
+
+def test_engine_divergent_prompts_correct_under_sharing():
+    base = list(range(60, 60 + 16))             # exactly one shared page
+    a = base + [7, 8, 9, 10, 11]
+    b = base + [20, 21, 22, 23, 24]
+    on = _engine(True)
+    outs_on = on.generate([a, b, a], _greedy())
+    off = _engine(False)
+    outs_off = off.generate([a, b, a], _greedy())
+    for i in range(3):
+        assert outs_on[i]["token_ids"] == outs_off[i]["token_ids"], i
+    on.stop(), off.stop()
