@@ -18,6 +18,7 @@ struct Config {
   double health_check_deadline_s = 300.0;
   int max_generate_attempts = 5;
   int generate_timeout_ms = 600000;
+  int schedule_wait_timeout_ms = 120000;  // block on instance availability
   int groups_per_sender = 4;
   double initial_local_gen_s = 150.0;
   std::vector<std::string> allowed_sender_ips;  // CIDR filters (doc only v0)
@@ -74,6 +75,7 @@ inline Config load_config(int argc, char** argv) {
     if (auto* v = get("health_check_deadline_s")) cfg.health_check_deadline_s = std::stod(*v);
     if (auto* v = get("max_generate_attempts")) cfg.max_generate_attempts = std::stoi(*v);
     if (auto* v = get("generate_timeout_ms")) cfg.generate_timeout_ms = std::stoi(*v);
+    if (auto* v = get("schedule_wait_timeout_ms")) cfg.schedule_wait_timeout_ms = std::stoi(*v);
     if (auto* v = get("groups_per_sender")) cfg.groups_per_sender = std::stoi(*v);
     if (auto* v = get("initial_local_gen_s")) cfg.initial_local_gen_s = std::stod(*v);
   }
@@ -88,6 +90,7 @@ inline Config load_config(int argc, char** argv) {
     else if (a == "--health-check-deadline-s") cfg.health_check_deadline_s = std::stod(v);
     else if (a == "--max-generate-attempts") cfg.max_generate_attempts = std::stoi(v);
     else if (a == "--generate-timeout-ms") cfg.generate_timeout_ms = std::stoi(v);
+    else if (a == "--schedule-wait-timeout-ms") cfg.schedule_wait_timeout_ms = std::stoi(v);
     else if (a == "--groups-per-sender") cfg.groups_per_sender = std::stoi(v);
   }
   return cfg;

@@ -56,7 +56,8 @@ class Manager {
     PartialResponse acc;
     Value current = request;
     for (int attempt = 0; attempt < cfg_.max_generate_attempts; ++attempt) {
-      InstancePtr inst = state_.next_instance(want_local);
+      InstancePtr inst = state_.next_instance(want_local,
+                                              cfg_.schedule_wait_timeout_ms);
       if (!inst) return error_response(rid, "no instance available");
       bool finished = stream_from_instance(inst, current, acc);
       // assigned_batches is a RATE quota: incremented on assignment, zeroed

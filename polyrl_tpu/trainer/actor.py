@@ -21,6 +21,7 @@ from typing import Any, Callable
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import optax
 
 from polyrl_tpu.models import decoder
@@ -43,6 +44,10 @@ class ActorConfig:
     lr_warmup_steps: int = 0
     weight_decay: float = 0.01
     max_grad_norm: float = 1.0
+    # host-offload optimizer state between steps: frees HBM for the rollout
+    # phase in colocated time-slicing (the reference's FSDP optimizer CPU
+    # offload, stream_fsdp_workers.py:308-316,386-389)
+    offload_optimizer: bool = False
     ppo_epochs: int = 1                   # reference guards ppo_epochs==1 (stream_dp_actor.py:145)
     remat: bool = True
 
@@ -101,6 +106,33 @@ class StreamActor:
         self.accum_grads = jax.tree_util.tree_map(jnp.zeros_like, params)
         self._update_fns: dict = {}
         self._logprob_fns: dict = {}
+        self._opt_offloaded = False
+        self._opt_shardings = None
+
+    # -- optimizer host offload (reference FSDP opt CPU offload,
+    # stream_fsdp_workers.py:308-316: load lazily, offload after step) ----
+
+    def offload_opt_state(self) -> None:
+        """Move optimizer state to host memory, freeing its HBM for the
+        rollout phase. No-op unless cfg.offload_optimizer."""
+        if not self.cfg.offload_optimizer or self._opt_offloaded:
+            return
+        self._opt_shardings = jax.tree_util.tree_map(
+            lambda x: x.sharding if isinstance(x, jax.Array) else None,
+            self.opt_state)
+        self.opt_state = jax.tree_util.tree_map(
+            lambda x: np.asarray(x) if isinstance(x, jax.Array) else x,
+            self.opt_state)
+        self._opt_offloaded = True
+
+    def load_opt_state(self) -> None:
+        """Bring offloaded optimizer state back to the mesh."""
+        if not self._opt_offloaded:
+            return
+        self.opt_state = jax.tree_util.tree_map(
+            lambda x, s: jax.device_put(x, s) if s is not None else x,
+            self.opt_state, self._opt_shardings)
+        self._opt_offloaded = False
 
     # -- jitted kernels ---------------------------------------------------
 
@@ -163,6 +195,7 @@ class StreamActor:
         """One sub-minibatch fwd/bwd (+opt step at boundary). ``batch`` is a
         dict of arrays: input_ids, positions, attention_mask, responses,
         response_mask, advantages, old_log_probs [, ref_log_probs]."""
+        self.load_opt_state()
         if is_opt_step not in self._update_fns:
             self._update_fns[is_opt_step] = self._build_update(is_opt_step)
         fn = self._update_fns[is_opt_step]
@@ -175,6 +208,7 @@ class StreamActor:
     def flush_opt_step(self) -> dict:
         """Apply accumulated grads without new data — the stream trainer's
         final flush when a short batch (dropped groups) ends mid-minibatch."""
+        self.load_opt_state()
         if not hasattr(self, "_flush_fn"):
             optimizer = self.optimizer
 

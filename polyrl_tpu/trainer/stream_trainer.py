@@ -551,6 +551,9 @@ class StreamRLTrainer:
 
             with marked_timer("update_weight", metrics):
                 self.rollout.update_weights(self.actor.params)
+            # free optimizer HBM for the generation phase (colocated
+            # time-slicing; no-op unless actor.cfg.offload_optimizer)
+            self.actor.offload_opt_state()
 
             self.global_step += 1
             step_time = time.monotonic() - step_t0
