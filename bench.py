@@ -27,7 +27,7 @@ def main() -> None:
     from polyrl_tpu.rollout.sampling import SamplingParams
 
     preset = os.environ.get("POLYRL_BENCH_PRESET", "qwen3-1.7b")
-    batch = int(os.environ.get("POLYRL_BENCH_BATCH", "64"))
+    batch = int(os.environ.get("POLYRL_BENCH_BATCH", "256"))
     prompt_len = int(os.environ.get("POLYRL_BENCH_PROMPT", "128"))
     new_tokens = int(os.environ.get("POLYRL_BENCH_NEW", "128"))
 

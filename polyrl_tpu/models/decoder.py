@@ -274,8 +274,10 @@ def forward(
     write_idx: int | jnp.ndarray = 0,
     remat: bool = False,
     attn_fn=None,                    # SP attention (parallel.sequence), no-cache path only
+    logits_for: jnp.ndarray | None = None,  # [B] int32 — unembed only this position
 ) -> tuple[jnp.ndarray, tuple | None]:
-    """Returns (logits [B, T, V] float32, new_cache or None).
+    """Returns (logits [B, T, V] float32 — or [B, V] when ``logits_for`` is
+    given — and new_cache or None).
 
     Without cache: full-sequence causal forward (training / prefill-scoring).
     With cache: attends over the cache buffer [B, S]; the current chunk's KV
@@ -319,18 +321,50 @@ def forward(
         x, _ = jax.lax.scan(body, x, layers)
         new_cache = None
     else:
+        # UNROLLED layer loop with single-token in-place cache writes.
+        # A scan would force the cache through xs/ys (fresh stacked
+        # allocations: full [L, B, S] rewrite per decode step) or through
+        # the carry with dynamic layer indexing (full layer-slice copy per
+        # layer). Static layer indices turn the write into a [B, T]-token
+        # dynamic-update-slice and the read into a lazily-fused view —
+        # decode becomes weights+KV-read bound, the HBM floor.
         k_cache, v_cache = cache
-
-        def body(x, scanned):
-            lp, kc, vc = scanned
-            x, (kf, vf) = _layer_forward(cfg, x, lp, cos, sin, mask, (kc, vc, write_idx))
-            return x, (kf, vf)
-
-        x, (k_new, v_new) = jax.lax.scan(body, x, (layers, k_cache, v_cache))
-        new_cache = (k_new, v_new)
+        n_layers = k_cache.shape[0]
+        b = x.shape[0]
+        t_chunk = x.shape[1]
+        hq, hkv, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim_
+        for l in range(n_layers):
+            lp = jax.tree_util.tree_map(lambda a: a[l], layers)
+            h = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
+            q = (h @ lp["wq"]).reshape(b, t_chunk, hq, hd)
+            k = (h @ lp["wk"]).reshape(b, t_chunk, hkv, hd)
+            v = (h @ lp["wv"]).reshape(b, t_chunk, hkv, hd)
+            if cfg.use_qk_norm:
+                q = rms_norm(q, lp["q_norm"], cfg.rms_norm_eps)
+                k = rms_norm(k, lp["k_norm"], cfg.rms_norm_eps)
+            q = apply_rope(q, cos, sin)
+            k = apply_rope(k, cos, sin)
+            k_cache = jax.lax.dynamic_update_slice(
+                k_cache, k[None].astype(k_cache.dtype), (l, 0, write_idx, 0, 0))
+            v_cache = jax.lax.dynamic_update_slice(
+                v_cache, v[None].astype(v_cache.dtype), (l, 0, write_idx, 0, 0))
+            attn_out = attention(q, k_cache[l], v_cache[l], mask=mask)
+            x = x + attn_out.reshape(b, t_chunk, hq * hd) @ lp["wo"]
+            h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
+            gate = jax.nn.silu((h @ lp["w_gate"]).astype(jnp.float32)).astype(h.dtype)
+            x = x + (gate * (h @ lp["w_up"])) @ lp["w_down"]
+        new_cache = (k_cache, v_cache)
 
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
     head = params["embed"].T if cfg.tie_word_embeddings else params["lm_head"]
+    if logits_for is not None:
+        # unembed only one position per row: prefill needs just the last
+        # real token's logits, and [B, T, V] f32 for a long chunk is the
+        # dominant HBM transient (e.g. 4k x 152k f32 = 2.5 GB per prompt)
+        x = jnp.take_along_axis(x, logits_for[:, None, None], axis=1)[:, 0]
+        logits = jnp.einsum("bd,dv->bv", x, head,
+                            preferred_element_type=jnp.float32)
+        return logits, new_cache
     logits = jnp.einsum("btd,dv->btv", x, head, preferred_element_type=jnp.float32)
     return logits, new_cache
 
@@ -379,8 +413,14 @@ def forward_paged_decode(
 
     layers = params["layers"]
 
-    def body(x, scanned):
-        lp, kp, vp = scanned
+    # UNROLLED layer loop, static layer indices: pool writes are per-token
+    # scatters and pool reads are lazily-fused views. A scan would copy
+    # entire pool layers per step (ys restacking or dynamic layer slicing) —
+    # catastrophic when the pool IS the whole KV memory.
+    k_pools, v_pools = pools
+    n_layers = k_pools.shape[0]
+    for l in range(n_layers):
+        lp = jax.tree_util.tree_map(lambda a: a[l], layers)
         h = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
         q = (h @ lp["wq"]).reshape(s, 1, hq, hd)
         k = (h @ lp["wk"]).reshape(s, 1, hkv, hd)
@@ -390,16 +430,16 @@ def forward_paged_decode(
             k = rms_norm(k, lp["k_norm"], cfg.rms_norm_eps)
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
-        kp = kp.at[write_page, write_off].set(k[:, 0].astype(kp.dtype))
-        vp = vp.at[write_page, write_off].set(v[:, 0].astype(vp.dtype))
-        attn_out = attn_fn(q[:, 0], kp, vp, page_table, attn_lens)  # [S, Hq, D]
+        k_pools = k_pools.at[l, write_page, write_off].set(
+            k[:, 0].astype(k_pools.dtype))
+        v_pools = v_pools.at[l, write_page, write_off].set(
+            v[:, 0].astype(v_pools.dtype))
+        attn_out = attn_fn(q[:, 0], k_pools[l], v_pools[l], page_table,
+                           attn_lens)  # [S, Hq, D]
         x = x + attn_out.reshape(s, hq * hd) @ lp["wo"]
         h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
         gate = jax.nn.silu((h @ lp["w_gate"]).astype(jnp.float32)).astype(h.dtype)
         x = x + (gate * (h @ lp["w_up"])) @ lp["w_down"]
-        return x, (kp, vp)
-
-    x, (k_pools, v_pools) = jax.lax.scan(body, x, (layers, pools[0], pools[1]))
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
     head = params["embed"].T if cfg.tie_word_embeddings else params["lm_head"]
     logits = jnp.einsum("sd,dv->sv", x, head, preferred_element_type=jnp.float32)
@@ -427,16 +467,15 @@ def prefill_into_pages(
     mask = (jnp.arange(pb) < prompt_len).astype(jnp.float32)[None]
     positions = jnp.arange(pb, dtype=jnp.int32)[None]
     cache = make_cache(cfg, 1, pb, dtype=pools[0].dtype)
-    logits, (k_new, v_new) = forward(
-        params, cfg, ids[None], positions, mask, cache=cache, write_idx=0)
+    last_logits, (k_new, v_new) = forward(
+        params, cfg, ids[None], positions, mask, cache=cache, write_idx=0,
+        logits_for=jnp.maximum(prompt_len - 1, 0)[None])
 
     k_r = k_new[:, 0].reshape(layers, n_pg, page_size, hkv, hd)
     v_r = v_new[:, 0].reshape(layers, n_pg, page_size, hkv, hd)
     k_pools = pools[0].at[:, page_ids].set(k_r.astype(pools[0].dtype))
     v_pools = pools[1].at[:, page_ids].set(v_r.astype(pools[1].dtype))
-    last_logits = jax.lax.dynamic_index_in_dim(
-        logits[0], jnp.maximum(prompt_len - 1, 0), axis=0, keepdims=False)
-    return (k_pools, v_pools), last_logits
+    return (k_pools, v_pools), last_logits[0]
 
 
 def prefill_suffix_into_pages(
@@ -484,9 +523,10 @@ def prefill_suffix_into_pages(
     slot_idx = jnp.arange(s_total)
     valid = ((slot_idx < prefix_len)
              | ((slot_idx >= prefix_len) & (slot_idx < prefix_len + suffix_len)))
-    logits, (k_all, v_all) = forward(
+    last_logits, (k_all, v_all) = forward(
         params, cfg, ids[None], positions, valid[None].astype(jnp.float32),
-        cache=cache, write_idx=prefix_len)
+        cache=cache, write_idx=prefix_len,
+        logits_for=jnp.maximum(suffix_len - 1, 0)[None])
 
     k_sfx = jax.lax.dynamic_slice_in_dim(k_all[:, 0], prefix_len, pb, axis=1)
     v_sfx = jax.lax.dynamic_slice_in_dim(v_all[:, 0], prefix_len, pb, axis=1)
@@ -494,9 +534,7 @@ def prefill_suffix_into_pages(
     v_r = v_sfx.reshape(layers, n_pg, page_size, hkv, hd)
     k_pools = pools[0].at[:, page_ids].set(k_r.astype(pools[0].dtype))
     v_pools = pools[1].at[:, page_ids].set(v_r.astype(pools[1].dtype))
-    last_logits = jax.lax.dynamic_index_in_dim(
-        logits[0], jnp.maximum(suffix_len - 1, 0), axis=0, keepdims=False)
-    return (k_pools, v_pools), last_logits
+    return (k_pools, v_pools), last_logits[0]
 
 
 def make_cache(cfg: ModelConfig, batch: int, max_len: int, dtype=None) -> tuple:

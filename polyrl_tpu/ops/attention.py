@@ -49,11 +49,24 @@ def attention(
     """
     b, tq, hq, d = q.shape
     hkv = k.shape[2]
-    if hq != hkv:
-        n_rep = hq // hkv
-        k = repeat_kv(k, n_rep)
-        v = repeat_kv(v, n_rep)
     scale = scale if scale is not None else d ** -0.5
+
+    if hq != hkv:
+        # grouped einsum — contracting against the shared KV head directly.
+        # Materializing repeat_kv costs 2·B·Tk·Hq·D bytes of HBM traffic per
+        # call; at decode (Tq=1, called per layer per step) that expansion
+        # dominated the whole step (~0.2 ms/layer at B=64, S=256).
+        g = hq // hkv
+        qg = q.reshape(b, tq, hkv, g, d)
+        logits = jnp.einsum("bqhgd,bkhd->bhgqk", qg, k,
+                            preferred_element_type=logits_dtype)
+        logits = logits * scale
+        if mask is not None:
+            logits = jnp.where(mask[:, :, None] if mask.ndim == 4 else mask,
+                               logits, jnp.finfo(logits_dtype).min)
+        probs = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
+        out = jnp.einsum("bhgqk,bkhd->bqhgd", probs, v)
+        return out.reshape(b, tq, hq, d)
 
     # [B, H, Tq, Tk]
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=logits_dtype)

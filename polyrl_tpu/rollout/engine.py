@@ -125,10 +125,10 @@ class RolloutEngine:
             cache_mask = jnp.concatenate(
                 [mask, jnp.zeros((bb, max_total - pb), mask.dtype)], axis=-1
             )
-            logits, cache = decoder.forward(
-                params, cfg, ids, positions, cache_mask, cache=cache, write_idx=0
-            )
-            last_logits = logits[:, -1, :]  # [bb, V] — prompts end at pb-1
+            last_logits, cache = decoder.forward(
+                params, cfg, ids, positions, cache_mask, cache=cache, write_idx=0,
+                logits_for=jnp.full((bb,), pb - 1, jnp.int32),
+            )  # [bb, V] — left-padded prompts all end at pb-1
 
             out_tokens = jnp.full((bb, sp.max_new_tokens), self.pad_token_id, jnp.int32)
             out_logps = jnp.zeros((bb, sp.max_new_tokens), jnp.float32)
