@@ -70,16 +70,20 @@ def paged_attention_ref(
 
 
 def _paged_attn_kernel(page_tbl_ref, seq_lens_ref,  # scalar prefetch
-                       q_ref,      # [1, 1, rep, D]
-                       k_ref,      # [1, page_size, 1, D]
-                       v_ref,      # [1, page_size, 1, D]
-                       out_ref,    # [1, 1, rep, D]
-                       m_ref, l_ref, acc_ref,  # VMEM scratch
+                       q_ref,      # [1, Hkv, rep, D]
+                       k_ref,      # [1, page_size, Hkv, D]
+                       v_ref,      # [1, page_size, Hkv, D]
+                       out_ref,    # [1, Hkv, rep, D]
+                       m_ref, l_ref, acc_ref,  # VMEM [Hkv, rep_pad, 128|D]
                        *, page_size: int, scale: float):
+    """One (slot, page) program computing ALL kv-head groups at once:
+    Mosaic requires the last two block dims be (8,128)-tileable or full, so
+    the kv-head axis must ride whole inside the block (blocking it to 1 is
+    rejected on real TPUs — only interpret mode accepted it)."""
     import jax.experimental.pallas as pl
 
     s = pl.program_id(0)
-    p = pl.program_id(2)
+    p = pl.program_id(1)
     seq_len = seq_lens_ref[s]
     n_pages = (jnp.maximum(seq_len, 1) + page_size - 1) // page_size
 
@@ -91,37 +95,39 @@ def _paged_attn_kernel(page_tbl_ref, seq_lens_ref,  # scalar prefetch
 
     @pl.when(p < n_pages)
     def _work():
-        q = q_ref[0, 0].astype(jnp.float32)          # [rep, D]
-        k = k_ref[0, :, 0, :].astype(jnp.float32)    # [page_size, D]
-        v = v_ref[0, :, 0, :].astype(jnp.float32)    # [page_size, D]
+        q = q_ref[0].astype(jnp.float32)   # [Hkv, rep, D]
+        # head-major layout for the batched dots (Mosaic requires batch dims
+        # at the same index on both operands)
+        k = k_ref[0].astype(jnp.float32).swapaxes(0, 1)  # [Hkv, page_size, D]
+        v = v_ref[0].astype(jnp.float32).swapaxes(0, 1)  # [Hkv, page_size, D]
+        rep = q.shape[1]
 
         logits = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale  # [rep, page_size]
+            q, k, (((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32) * scale  # [Hkv, rep, page_size]
         pos = p * page_size + jax.lax.broadcasted_iota(
-            jnp.int32, logits.shape, 1)
+            jnp.int32, logits.shape, 2)
         logits = jnp.where(pos < jnp.maximum(seq_len, 1), logits, NEG_INF)
 
-        rep = logits.shape[0]
-        m_prev = m_ref[:rep, :1]                       # [rep, 1]
-        l_prev = l_ref[:rep, :1]
+        m_prev = m_ref[:, :rep, :1]                    # [Hkv, rep, 1]
+        l_prev = l_ref[:, :rep, :1]
         m_cur = jnp.max(logits, axis=-1, keepdims=True)
         m_new = jnp.maximum(m_prev, m_cur)
         alpha = jnp.exp(m_prev - m_new)
-        probs = jnp.exp(logits - m_new)                # [rep, page_size]
+        probs = jnp.exp(logits - m_new)                # [Hkv, rep, page_size]
         l_new = alpha * l_prev + jnp.sum(probs, axis=-1, keepdims=True)
         pv = jax.lax.dot_general(
-            probs, v, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)        # [rep, D]
-        acc_ref[:rep, :] = acc_ref[:rep, :] * alpha + pv
-        m_ref[:rep, :1] = m_new
-        l_ref[:rep, :1] = l_new
+            probs, v, (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)        # [Hkv, rep, D]
+        acc_ref[:, :rep, :] = acc_ref[:, :rep, :] * alpha + pv
+        m_ref[:, :rep, :1] = m_new
+        l_ref[:, :rep, :1] = l_new
 
     @pl.when(p == n_pages - 1)
     def _finish():
         rep = out_ref.shape[2]
-        out_ref[0, 0] = (
-            acc_ref[:rep, :] / jnp.maximum(l_ref[:rep, :1], 1e-30)
+        out_ref[0] = (
+            acc_ref[:, :rep, :] / jnp.maximum(l_ref[:, :rep, :1], 1e-30)
         ).astype(out_ref.dtype)
 
 
@@ -149,20 +155,20 @@ def paged_attention_pallas(
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
-        grid=(s, hkv, p),
+        grid=(s, p),
         in_specs=[
-            pl.BlockSpec((1, 1, rep, d), lambda si, hi, pi, pt, sl: (si, hi, 0, 0)),
-            pl.BlockSpec((1, page_size, 1, d),
-                         lambda si, hi, pi, pt, sl: (pt[si, pi], 0, hi, 0)),
-            pl.BlockSpec((1, page_size, 1, d),
-                         lambda si, hi, pi, pt, sl: (pt[si, pi], 0, hi, 0)),
+            pl.BlockSpec((1, hkv, rep, d), lambda si, pi, pt, sl: (si, 0, 0, 0)),
+            pl.BlockSpec((1, page_size, hkv, d),
+                         lambda si, pi, pt, sl: (pt[si, pi], 0, 0, 0)),
+            pl.BlockSpec((1, page_size, hkv, d),
+                         lambda si, pi, pt, sl: (pt[si, pi], 0, 0, 0)),
         ],
-        out_specs=pl.BlockSpec((1, 1, rep, d),
-                               lambda si, hi, pi, pt, sl: (si, hi, 0, 0)),
+        out_specs=pl.BlockSpec((1, hkv, rep, d),
+                               lambda si, pi, pt, sl: (si, 0, 0, 0)),
         scratch_shapes=[
-            pltpu.VMEM((rep_pad, 128), jnp.float32),  # m (col 0 used)
-            pltpu.VMEM((rep_pad, 128), jnp.float32),  # l
-            pltpu.VMEM((rep_pad, d), jnp.float32),    # acc
+            pltpu.VMEM((hkv, rep_pad, 128), jnp.float32),  # m (col 0 used)
+            pltpu.VMEM((hkv, rep_pad, 128), jnp.float32),  # l
+            pltpu.VMEM((hkv, rep_pad, d), jnp.float32),    # acc
         ],
     )
     out = pl.pallas_call(

@@ -12,12 +12,15 @@ sglang_http_async_engine.py:286-298). Design:
 - Paged KV: slots own page lists from a shared pool
   (``decoder.make_paged_pools``); attention is
   ``ops.paged_attention`` (Pallas on TPU). No shape buckets in decode.
-- Admission: prompts prefill one-at-a-time into their slot's pages
-  (compiled per prompt bucket), then join the decode batch — requests
-  stream in and out continuously.
-- The host loop uploads the small per-slot control arrays each step and
-  fetches (token, logprob, done) — the same per-token host round-trip the
-  streaming serving path already pays, now amortized over all slots.
+- Admission: FUSED async prefill (compiled per prompt bucket) — one packed
+  int32 control upload per request; the prefill inserts the slot into the
+  device-resident control state and the first token joins the deferred
+  emission queue. No host round trip per admission.
+- Decode: the control state lives on device and the step ADVANCES it there;
+  dispatches stay `pipeline_depth` ahead and outputs are fetched in one
+  batched transfer, so device compute overlaps host streaming and the
+  dispatch round trip. Host np mirrors (updated at drain) drive admission
+  and are re-uploaded only after host-side events (abort, overflow stop).
 
 Weight hot-swap = atomic ``self.params`` swap between steps (buffer shapes
 and shardings unchanged → no recompilation), mirroring the reference's
@@ -155,6 +158,13 @@ class CBEngine:
 
         self._step_fns: dict = {}
         self._prefill_fns: dict = {}
+        # device-resident control state (mirrors of the np arrays above) and
+        # the deferred-emission pipeline: dispatches (prefills + steps) are
+        # queued async and their (token, logp, done) outputs fetched later,
+        # so device compute overlaps the tunnel round trips and streaming
+        self._dev_state: dict | None = None
+        self._emit_q: collections.deque = collections.deque()
+        self.pipeline_depth = 2
 
         # serving telemetry (server_info contract)
         self.weight_version = 0
@@ -166,6 +176,11 @@ class CBEngine:
     # -- compiled pieces ----------------------------------------------------
 
     def _get_step(self, use_filters: bool):
+        """One decode step that also ADVANCES the control state on device:
+        the host loop keeps np mirrors for admission decisions but never
+        re-uploads state between steps (each host→device array was a tunnel
+        round trip — at ~10 uploads + 3 fetches per step the old loop was
+        RTT-bound at <100 tok/s on real hardware)."""
         if use_filters not in self._step_fns:
             cfg, pad = self.cfg, self.pad_token_id
 
@@ -183,47 +198,125 @@ class CBEngine:
                 done = active & (hit_stop | (n_gen >= budgets))
                 token = jnp.where(active, token, pad)
                 logp = jnp.where(active, logp, 0.0)
-                return kp, vp, rng, token, logp, done
+                # device-side state advance
+                new_active = active & ~done
+                new_seq_lens = seq_lens + active.astype(jnp.int32)
+                new_last = jnp.where(active, token, last_tokens)
+                return (kp, vp, rng, token, logp, done,
+                        new_seq_lens, new_last, n_gen, new_active)
 
             self._step_fns[use_filters] = jax.jit(
-                step, donate_argnums=(1, 2), static_argnames=())
+                step, donate_argnums=(1, 2, 5, 6, 7, 9), static_argnames=())
         return self._step_fns[use_filters]
 
-    def _get_prefill(self, pb: int):
-        if pb not in self._prefill_fns:
-            cfg = self.cfg
+    def _insert_slot_state(self, st: dict, slot, prompt_len, token, done,
+                           budget, temp, top_p, top_k, stop_row, row):
+        """Device-side slot insertion shared by both prefill variants: the
+        host never round-trips for admission (a blocking first-token fetch
+        flushed the whole pipeline per request — admission-bound serving)."""
+        st = dict(st)
+        st["seq_lens"] = st["seq_lens"].at[slot].set(prompt_len)
+        st["last_tokens"] = st["last_tokens"].at[slot].set(token)
+        st["n_generated"] = st["n_generated"].at[slot].set(1)
+        st["budgets"] = st["budgets"].at[slot].set(budget)
+        st["active"] = st["active"].at[slot].set(~done)
+        st["temps"] = st["temps"].at[slot].set(temp)
+        st["top_ps"] = st["top_ps"].at[slot].set(top_p)
+        st["top_ks"] = st["top_ks"].at[slot].set(top_k)
+        st["stop_table"] = st["stop_table"].at[slot].set(stop_row)
+        st["page_table"] = st["page_table"].at[slot].set(row)
+        return st
 
-            def prefill(params, kp, vp, ids, prompt_len, page_ids, rng,
-                        temp, top_p, top_k):
+    _STATE_KEYS = ("page_table", "seq_lens", "last_tokens", "n_generated",
+                   "budgets", "active", "temps", "top_ps", "top_ks",
+                   "stop_table")
+
+    # packed-buffer layout for fused prefill uploads: every per-request host
+    # value rides ONE int32 vector (floats bitcast) — a dozen separate tiny
+    # jnp.asarray uploads per admission dominated the admission cost
+    _PACK_SCALARS = 8  # prompt/suffix_len, prefix_len, slot, budget, top_k,
+                       # temp_bits, top_p_bits, (pad)
+
+    def _pack_prefill(self, ids, page_ids, row, stops, prefix_ids,
+                      len_a, len_b, slot, budget, sp) -> np.ndarray:
+        parts = [np.asarray(ids, np.int32), np.asarray(page_ids, np.int32),
+                 np.asarray(row, np.int32), np.asarray(stops, np.int32),
+                 np.asarray(prefix_ids, np.int32),
+                 np.array([len_a, len_b, slot, budget, sp.top_k,
+                           np.float32(sp.temperature).view(np.int32),
+                           np.float32(sp.top_p).view(np.int32), 0], np.int32)]
+        return np.concatenate(parts)
+
+    @staticmethod
+    def _unpack_prefill(packed, pb, n_pg, pps, n_pre):
+        ids = packed[:pb]; o = pb
+        page_ids = packed[o:o + n_pg]; o += n_pg
+        row = packed[o:o + pps]; o += pps
+        stops = packed[o:o + MAX_STOP_TOKENS]; o += MAX_STOP_TOKENS
+        prefix_ids = packed[o:o + n_pre]; o += n_pre
+        sc = packed[o:]
+        temp = jax.lax.bitcast_convert_type(sc[5], jnp.float32)
+        top_p = jax.lax.bitcast_convert_type(sc[6], jnp.float32)
+        return (ids, page_ids, row, stops, prefix_ids,
+                sc[0], sc[1], sc[2], sc[3], sc[4], temp, top_p)
+
+    def _get_prefill(self, pb: int, use_filters: bool):
+        """Fused admission: prefill + sample + insert the slot into the
+        device-resident control state, returning (token, logp, done) device
+        scalars for DEFERRED emission. ``use_filters`` is a compile-time
+        variant: the top-p/top-k sort over the vocab is ~a third of prefill
+        wall time and most requests don't need it."""
+        key = (pb, use_filters)
+        if key not in self._prefill_fns:
+            cfg = self.cfg
+            n_pg, pps = pb // self.page_size, self.pages_per_slot
+
+            def prefill(params, kp, vp, packed, rng, **state):
+                (ids, page_ids, row, stop_row, _pre, prompt_len, _b, slot,
+                 budget, top_k, temp, top_p) = self._unpack_prefill(
+                    packed, pb, n_pg, pps, 0)
                 (kp, vp), last_logits = decoder.prefill_into_pages(
                     params, cfg, ids, prompt_len, (kp, vp), page_ids)
                 rng, sub = jax.random.split(rng)
                 token, logp = sample_token_vec(
                     last_logits[None], sub, temp[None], top_p[None],
-                    top_k[None], use_filters=True)
-                return kp, vp, rng, token[0], logp[0]
+                    top_k[None], use_filters=use_filters)
+                token, logp = token[0], logp[0]
+                done = jnp.any(token == stop_row) | (budget <= 1)
+                st = self._insert_slot_state(
+                    state, slot, prompt_len, token, done, budget,
+                    temp, top_p, top_k, stop_row, row)
+                return kp, vp, rng, token, logp, done, st
 
-            self._prefill_fns[pb] = jax.jit(prefill, donate_argnums=(1, 2))
-        return self._prefill_fns[pb]
+            self._prefill_fns[key] = jax.jit(prefill, donate_argnums=(1, 2))
+        return self._prefill_fns[key]
 
-    def _get_prefill_suffix(self, pb: int, n_prefix_pg: int):
-        """Prefix-cache-hit prefill: compute only the suffix, attend over the
-        cached prefix pages. Compile key = (suffix bucket, prefix-page
+    def _get_prefill_suffix(self, pb: int, n_prefix_pg: int, use_filters: bool):
+        """Prefix-cache-hit fused prefill: compute only the suffix, attend
+        over cached prefix pages. Compile key = (suffix bucket, prefix-page
         bucket) — both power-of-two-ish, so the cache stays small."""
-        key = ("sfx", pb, n_prefix_pg)
+        key = ("sfx", pb, n_prefix_pg, use_filters)
         if key not in self._prefill_fns:
             cfg = self.cfg
+            n_pg, pps = pb // self.page_size, self.pages_per_slot
 
-            def prefill(params, kp, vp, ids, suffix_len, prefix_len, rng,
-                        prefix_page_ids, page_ids, temp, top_p, top_k):
+            def prefill(params, kp, vp, packed, rng, **state):
+                (ids, page_ids, row, stop_row, prefix_page_ids, suffix_len,
+                 prefix_len, slot, budget, top_k, temp, top_p) = \
+                    self._unpack_prefill(packed, pb, n_pg, pps, n_prefix_pg)
                 (kp, vp), last_logits = decoder.prefill_suffix_into_pages(
                     params, cfg, ids, suffix_len, prefix_len, (kp, vp),
                     prefix_page_ids, page_ids)
                 rng, sub = jax.random.split(rng)
                 token, logp = sample_token_vec(
                     last_logits[None], sub, temp[None], top_p[None],
-                    top_k[None], use_filters=True)
-                return kp, vp, rng, token[0], logp[0]
+                    top_k[None], use_filters=use_filters)
+                token, logp = token[0], logp[0]
+                done = jnp.any(token == stop_row) | (budget <= 1)
+                st = self._insert_slot_state(
+                    state, slot, prefix_len + suffix_len, token, done, budget,
+                    temp, top_p, top_k, stop_row, row)
+                return kp, vp, rng, token, logp, done, st
 
             self._prefill_fns[key] = jax.jit(prefill, donate_argnums=(1, 2))
         return self._prefill_fns[key]
@@ -247,6 +340,8 @@ class CBEngine:
         self._stop.set()
         if self._loop_thread is not None:
             self._loop_thread.join(timeout=10.0)
+        self._emit_q.clear()
+        self._invalidate_dev_state()
         # every in-flight and queued request must still see a terminal line +
         # STREAM_END or its HTTP handler thread blocks forever
         self._fail_all("engine shutdown")
@@ -300,11 +395,13 @@ class CBEngine:
 
     def _loop_iter(self) -> None:
         if self._paused.is_set():
+            self._drain_emit_q()
             self._idle.set()
             time.sleep(0.02)
             return
         self._drain_queue()
         if not self._pending and not self._active.any():
+            self._drain_emit_q()  # drain only ever deactivates slots
             self._idle.set()
             try:
                 self._pending.append(self._queue.get(timeout=0.05))
@@ -324,6 +421,8 @@ class CBEngine:
     def _recover(self) -> None:
         """After any jit failure the pools may have been donated to the dead
         call; fail everything and reallocate so serving can continue."""
+        self._emit_q.clear()
+        self._invalidate_dev_state()
         self._fail_all("engine error")
         with self._pool_lock:
             if self.prefix_cache is not None:
@@ -345,6 +444,10 @@ class CBEngine:
             free_slots = np.flatnonzero(~self._active & np.asarray(
                 [s is None for s in self._slots]))
             if len(free_slots) == 0:
+                if self._emit_q:
+                    # finished slots may be hiding behind undrained outputs
+                    self._drain_emit_q()
+                    continue
                 return
             req = self._pending[0]
             if req.abort is not None and req.abort.is_set():
@@ -367,6 +470,10 @@ class CBEngine:
                     req.input_ids)
             need = n_pages - len(matched_pages)
             pages = self.allocator.alloc(need)
+            if pages is None and self._emit_q:
+                # drain: finished slots return their pages
+                self._drain_emit_q()
+                pages = self.allocator.alloc(need)
             if pages is None and self.prefix_cache is not None:
                 # pool pressure: evict unreferenced cached pages and retry
                 if self.prefix_cache.evict(need - self.allocator.free_count):
@@ -390,12 +497,25 @@ class CBEngine:
     def _prefill_request(self, slot: int, req: _Request, pages: list[int],
                          budget: int, matched_pages: list[int] | None = None,
                          matched_entries: list | None = None) -> None:
+        """Fused async admission: the compiled prefill also inserts the slot
+        into the device control state, and the first token's emission is
+        deferred to the emit queue — no host round trip per request."""
         matched_pages = matched_pages or []
         matched_entries = list(matched_entries or [])
         n_prompt = len(req.input_ids)
         prefix_len = len(matched_pages) * self.page_size
         sp = req.sampling
 
+        all_pages = matched_pages + pages
+        row = np.zeros((self.pages_per_slot,), np.int32)
+        row[:len(all_pages)] = all_pages
+        stops = np.full((MAX_STOP_TOKENS,), -1, np.int32)
+        for i, t in enumerate(sp.stop_token_ids[:MAX_STOP_TOKENS]):
+            stops[i] = t
+
+        self._ensure_dev_state()
+        state_kwargs = {k: self._dev_state[k] for k in self._STATE_KEYS}
+        use_filters = bool(sp.top_p < 1.0 or sp.top_k > 0)
         if matched_pages:
             # prefix-cache hit: prefill only the suffix
             suffix_len = n_prompt - prefix_len
@@ -410,13 +530,9 @@ class CBEngine:
             prefix_ids[:len(matched_pages)] = matched_pages
             ids = np.full((pb,), self.pad_token_id, np.int32)
             ids[:suffix_len] = req.input_ids[prefix_len:]
-            fn = self._get_prefill_suffix(pb, n_pre_b)
-            kp, vp, self._rng, token, logp = fn(
-                self.params, self._pools[0], self._pools[1], jnp.asarray(ids),
-                jnp.int32(suffix_len), jnp.int32(prefix_len), self._rng,
-                jnp.asarray(prefix_ids), jnp.asarray(page_ids),
-                jnp.float32(sp.temperature), jnp.float32(sp.top_p),
-                jnp.int32(sp.top_k))
+            packed = self._pack_prefill(ids, page_ids, row, stops, prefix_ids,
+                                        suffix_len, prefix_len, slot, budget, sp)
+            fn = self._get_prefill_suffix(pb, n_pre_b, use_filters)
         else:
             pb = next_bucket(n_prompt, self.prompt_buckets)
             n_prompt_pages = -(-n_prompt // self.page_size)
@@ -424,18 +540,18 @@ class CBEngine:
             page_ids[:n_prompt_pages] = pages[:n_prompt_pages]
             ids = np.full((pb,), self.pad_token_id, np.int32)
             ids[:n_prompt] = req.input_ids
-            fn = self._get_prefill(pb)
-            kp, vp, self._rng, token, logp = fn(
-                self.params, self._pools[0], self._pools[1], jnp.asarray(ids),
-                jnp.int32(n_prompt), jnp.asarray(page_ids), self._rng,
-                jnp.float32(sp.temperature), jnp.float32(sp.top_p),
-                jnp.int32(sp.top_k))
+            packed = self._pack_prefill(ids, page_ids, row, stops,
+                                        np.zeros((0,), np.int32),
+                                        n_prompt, 0, slot, budget, sp)
+            fn = self._get_prefill(pb, use_filters)
+        kp, vp, self._rng, token, logp, done, new_st = fn(
+            self.params, self._pools[0], self._pools[1],
+            jnp.asarray(packed), self._rng, **state_kwargs)
         self._pools = (kp, vp)
-        token, logp = int(token), float(logp)
+        self._dev_state = new_st
 
         # publish the prompt's freshly computed full pages; ownership of
         # published pages moves to the cache (the slot holds refs)
-        all_pages = matched_pages + pages
         private = list(pages)
         if self.prefix_cache is not None:
             published = self.prefix_cache.publish(
@@ -444,70 +560,96 @@ class CBEngine:
             private = [p for p in pages if p not in pub_pages]
             matched_entries += [e for _, e in published]
 
-        stop_set = set(sp.stop_token_ids)
-        finished = token in stop_set or budget <= 1
-        reason = ("stop" if token in stop_set else
-                  "length" if finished else "")
-        req.out.put({"token_ids": [token], "logprobs": [logp],
-                     "finished": finished, "finish_reason": reason})
-        self._count_tokens(1)
-        if finished:
-            req.out.put(STREAM_END)
-            self.allocator.free(private)
-            if self.prefix_cache is not None:
-                self.prefix_cache.release(matched_entries)
-            return
-
-        row = np.zeros((self.pages_per_slot,), np.int32)
-        row[:len(all_pages)] = all_pages
+        # host mirrors: everything except the (device-side) first token;
+        # _emit_prefill fills last_tokens when the output is drained, and
+        # finalizes immediately-finished requests
         self._page_table[slot] = row
         self._seq_lens[slot] = n_prompt
-        self._last_tokens[slot] = token
+        self._last_tokens[slot] = self.pad_token_id
         self._n_generated[slot] = 1
         self._budgets[slot] = budget
         self._active[slot] = True
         self._temps[slot] = sp.temperature
         self._top_ps[slot] = sp.top_p
         self._top_ks[slot] = sp.top_k
-        # device table holds the first MAX_STOP_TOKENS in request order
-        # (deterministic); the host check in _step_once covers any overflow
-        stops = np.full((MAX_STOP_TOKENS,), -1, np.int32)
-        for i, t in enumerate(sp.stop_token_ids[:MAX_STOP_TOKENS]):
-            stops[i] = t
         self._stop_table[slot] = stops
-        self._slots[slot] = _SlotInfo(req, private, stop_set,
+        self._slots[slot] = _SlotInfo(req, private, set(sp.stop_token_ids),
                                       cache_entries=matched_entries)
+        self._emit_q.append(("prefill", token, logp, done, slot))
 
-    def _step_once(self) -> None:
-        # host-side aborts flip slots inactive BEFORE the step
-        for i, info in enumerate(self._slots):
+    # -- device-resident state + pipelined stepping --------------------------
+
+    def _invalidate_dev_state(self) -> None:
+        self._dev_state = None
+
+    def _ensure_dev_state(self) -> None:
+        if self._dev_state is not None:
+            return
+        # mirrors must be exact before a re-upload: queued emissions still
+        # carry device-side first tokens (mirror last_tokens is a
+        # placeholder until drained)
+        self._drain_emit_q()
+        self._dev_state = {
+            "page_table": jnp.asarray(self._page_table),
+            "seq_lens": jnp.asarray(self._seq_lens),
+            "last_tokens": jnp.asarray(self._last_tokens),
+            "n_generated": jnp.asarray(self._n_generated),
+            "budgets": jnp.asarray(self._budgets),
+            "active": jnp.asarray(self._active),
+            "temps": jnp.asarray(self._temps),
+            "top_ps": jnp.asarray(self._top_ps),
+            "top_ks": jnp.asarray(self._top_ks),
+            "stop_table": jnp.asarray(self._stop_table),
+        }
+
+    def _drain_emit_q(self, keep: int = 0) -> None:
+        """Fetch queued dispatch outputs FIFO and stream them out, bringing
+        the host mirrors up to date. ``keep`` leaves the newest entries
+        outstanding (pipeline depth)."""
+        n = len(self._emit_q) - keep
+        if n <= 0:
+            return
+        entries = [self._emit_q.popleft() for _ in range(n)]
+        # ONE batched transfer for every outstanding output (a device_get
+        # per entry would serialize a tunnel round trip each)
+        fetched = jax.device_get([e[1:4] for e in entries])
+        for (kind, _t, _l, _d, tail), (token, logp, done) in zip(entries, fetched):
+            if kind == "step":
+                self._emit_fetched(token, logp, done, tail)
+            else:
+                self._emit_prefill(int(token), float(logp), bool(done), tail)
+
+    def _emit_prefill(self, t: int, lp: float, device_done: bool, slot: int) -> None:
+        """Deliver an admitted request's first token (deferred from the
+        fused prefill dispatch)."""
+        info = self._slots[slot]
+        if info is None:
+            return
+        stop_hit = t in info.stop_set
+        fin = device_done or stop_hit
+        reason = "stop" if stop_hit else ("length" if fin else "")
+        info.req.out.put({"token_ids": [t], "logprobs": [lp],
+                          "finished": fin, "finish_reason": reason})
+        self._last_tokens[slot] = t
+        self._count_tokens(1)
+        if fin:
+            info.req.out.put(STREAM_END)
+            self._active[slot] = False
+            self._finalize(slot)
+            if not device_done:
+                # stop token beyond the device table: device active is stale
+                self._invalidate_dev_state()
+
+    def _emit_fetched(self, token, logp, done, idxs) -> None:
+        """Stream one fetched step to the requests; ``idxs`` may be a
+        superset of live slots (mirrors lag the pipeline by one step) —
+        finished/replaced slots are filtered here."""
+        n_emitted = 0
+        host_stop_fix = False
+        for i in idxs:
+            info = self._slots[i]
             if info is None or not self._active[i]:
                 continue
-            if info.req.abort is not None and info.req.abort.is_set():
-                self._active[i] = False
-                self._emit_abort(info.req, emit_line=True)
-                self._finalize(i)
-
-        if not self._active.any():
-            return
-        use_filters = bool(np.any(
-            (self._top_ps[self._active] < 1.0) | (self._top_ks[self._active] > 0)))
-        fn = self._get_step(use_filters)
-        kp, vp, self._rng, token, logp, done = fn(
-            self.params, self._pools[0], self._pools[1], self._rng,
-            jnp.asarray(self._page_table), jnp.asarray(self._seq_lens),
-            jnp.asarray(self._last_tokens), jnp.asarray(self._n_generated),
-            jnp.asarray(self._budgets), jnp.asarray(self._active),
-            jnp.asarray(self._temps), jnp.asarray(self._top_ps),
-            jnp.asarray(self._top_ks), jnp.asarray(self._stop_table))
-        self._pools = (kp, vp)
-        token = np.asarray(token)
-        logp = np.asarray(logp)
-        done = np.asarray(done)
-
-        n_emitted = 0
-        for i in np.flatnonzero(self._active):
-            info = self._slots[i]
             t = int(token[i])
             # host check is authoritative: covers stop tokens beyond the
             # MAX_STOP_TOKENS device table
@@ -525,8 +667,57 @@ class CBEngine:
                 info.req.out.put(STREAM_END)
                 self._active[i] = False
                 self._finalize(i)
+                if not bool(done[i]):
+                    # device missed this stop (beyond its table): its active
+                    # mask is stale — force a state re-upload. Any step
+                    # already in flight writes one garbage token into the
+                    # freed pages, which is safe: a later prefill reusing
+                    # them is ordered after it by the pools data dependency.
+                    host_stop_fix = True
+        if host_stop_fix:
+            self._invalidate_dev_state()
         self._count_tokens(n_emitted)
         self.num_running = int(self._active.sum())
+
+    def _step_once(self) -> None:
+        # host-side aborts flip slots inactive BEFORE the next dispatch;
+        # mirrors must be current, so drain the pipeline first
+        if any(info is not None and self._active[i]
+               and info.req.abort is not None and info.req.abort.is_set()
+               for i, info in enumerate(self._slots)):
+            self._drain_emit_q()
+            changed = False
+            for i, info in enumerate(self._slots):
+                if info is None or not self._active[i]:
+                    continue
+                if info.req.abort is not None and info.req.abort.is_set():
+                    self._active[i] = False
+                    self._emit_abort(info.req, emit_line=True)
+                    self._finalize(i)
+                    changed = True
+            if changed:
+                self._invalidate_dev_state()
+
+        if not self._active.any():
+            self._drain_emit_q()
+            return
+        use_filters = bool(np.any(
+            (self._top_ps[self._active] < 1.0) | (self._top_ks[self._active] > 0)))
+        self._ensure_dev_state()
+        st = self._dev_state
+        fn = self._get_step(use_filters)
+        (kp, vp, self._rng, token, logp, done, st["seq_lens"],
+         st["last_tokens"], st["n_generated"], st["active"]) = fn(
+            self.params, self._pools[0], self._pools[1], self._rng,
+            st["page_table"], st["seq_lens"], st["last_tokens"],
+            st["n_generated"], st["budgets"], st["active"], st["temps"],
+            st["top_ps"], st["top_ks"], st["stop_table"])
+        self._pools = (kp, vp)
+        self._emit_q.append(("step", token, logp, done,
+                             np.flatnonzero(self._active)))
+        # keep a couple of dispatches outstanding: older outputs stream out
+        # while the device computes, hiding the tunnel round trip entirely
+        self._drain_emit_q(keep=self.pipeline_depth)
 
     def _finalize(self, slot: int) -> None:
         info = self._slots[slot]
