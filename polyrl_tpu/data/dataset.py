@@ -52,14 +52,21 @@ class RLDataset:
         return self.records[i]
 
 
-def make_sampler(n: int, kind: str = "random", seed: int = 0) -> Iterator[int]:
-    """random | sequential index stream (reference create_rl_sampler,
-    main_ppo.py:398-439; curriculum hooks slot in here)."""
+def make_sampler(n: int, kind: str = "random", seed: int = 0,
+                 scores: Sequence[float] | None = None) -> Iterator[int]:
+    """random | sequential | curriculum index stream (reference
+    create_rl_sampler, main_ppo.py:398-439). Curriculum orders by
+    ``scores`` ascending (easy→hard) on the first epoch, then anneals to
+    random shuffles — the reference's curriculum sampler contract."""
     rng = random.Random(seed)
+    first = True
     while True:
         order = list(range(n))
-        if kind == "random":
+        if kind == "curriculum" and scores is not None and first:
+            order.sort(key=lambda i: scores[i])
+        elif kind in ("random", "curriculum"):
             rng.shuffle(order)
+        first = False
         yield from order
 
 
@@ -67,10 +74,17 @@ class PromptDataLoader:
     """Batches of raw records; stateful for checkpoint/resume (the reference
     uses StatefulDataLoader, stream_ray_trainer.py:38)."""
 
-    def __init__(self, dataset: RLDataset, batch_size: int, shuffle: bool = True, seed: int = 0):
+    def __init__(self, dataset: RLDataset, batch_size: int, shuffle: bool = True,
+                 seed: int = 0, sampler_kind: str | None = None,
+                 curriculum_key: str = "difficulty"):
         self.dataset = dataset
         self.batch_size = batch_size
-        self.sampler = make_sampler(len(dataset), "random" if shuffle else "sequential", seed)
+        kind = sampler_kind or ("random" if shuffle else "sequential")
+        scores = None
+        if kind == "curriculum":
+            scores = [float((r.get("extra_info") or {}).get(curriculum_key, 0.0))
+                      for r in dataset.records]
+        self.sampler = make_sampler(len(dataset), kind, seed, scores=scores)
         self.consumed = 0
 
     def state_dict(self) -> dict:

@@ -32,9 +32,13 @@ using pjson::Object;
 using pjson::Value;
 
 static void log_line(const std::string& msg) {
+  // called from every worker/health/stats thread: localtime() hands back a
+  // shared static buffer (TSAN-confirmed race) — use the reentrant form
   auto now = std::chrono::system_clock::to_time_t(std::chrono::system_clock::now());
+  struct tm tm_buf;
+  localtime_r(&now, &tm_buf);
   char buf[32];
-  strftime(buf, sizeof(buf), "%H:%M:%S", localtime(&now));
+  strftime(buf, sizeof(buf), "%H:%M:%S", &tm_buf);
   fprintf(stderr, "[manager %s] %s\n", buf, msg.c_str());
 }
 

@@ -1,0 +1,75 @@
+"""Multi-host / multi-slice initialization and hybrid DCN×ICI meshes.
+
+TPU-native equivalent of the reference's distributed backends (SURVEY.md
+§2.4): torch.distributed/NCCL process groups become ``jax.distributed``
+(one process per host, XLA collectives over ICI inside a slice and DCN
+across slices). The reference's trainer ranks discover each other through
+Ray; here coordinator discovery uses the standard TPU env vars (or explicit
+arguments), so the same entry point works under any launcher.
+
+Mesh layout guidance (scaling-book recipe): put the OUTER (slowest) axis on
+DCN — cross-slice data parallelism — and keep tp/sp/fsdp inside a slice on
+ICI. ``make_hybrid_mesh`` builds exactly that via
+``mesh_utils.create_hybrid_device_mesh``.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+import jax
+
+from polyrl_tpu.parallel import mesh as meshlib
+
+log = logging.getLogger(__name__)
+
+
+def initialize(coordinator_address: str | None = None,
+               num_processes: int | None = None,
+               process_id: int | None = None) -> None:
+    """Bring up jax.distributed for multi-host execution. No-ops when
+    single-process (num_processes == 1 or nothing configured). Arguments
+    default to the standard env vars (JAX_COORDINATOR_ADDRESS /
+    JAX_NUM_PROCESSES / JAX_PROCESS_ID), which TPU pod launchers set."""
+    coordinator_address = coordinator_address or os.environ.get(
+        "JAX_COORDINATOR_ADDRESS")
+    if num_processes is None:
+        num_processes = int(os.environ.get("JAX_NUM_PROCESSES", "0") or 0)
+    if process_id is None:
+        pid = os.environ.get("JAX_PROCESS_ID")
+        process_id = int(pid) if pid is not None else None
+    if not coordinator_address or num_processes <= 1:
+        log.info("single-process run; jax.distributed not initialized")
+        return
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    log.info("jax.distributed up: process %d/%d, %d local / %d global devices",
+             jax.process_index(), jax.process_count(),
+             jax.local_device_count(), jax.device_count())
+
+
+def make_hybrid_mesh(dcn_dp: int | None = None,
+                     config: "meshlib.MeshConfig | None" = None) -> jax.sharding.Mesh:
+    """Hybrid DCN×ICI mesh: ``dcn_dp`` slices data-parallel over DCN (one
+    entry per slice/granule), everything else (fsdp/tp/sp from ``config``)
+    inside the slice on ICI. Falls back to the flat mesh single-slice."""
+    from jax.experimental import mesh_utils
+
+    n_granules = getattr(jax.devices()[0], "slice_index", None)
+    if dcn_dp is None:
+        dcn_dp = jax.process_count() if n_granules is not None else 1
+    if dcn_dp <= 1:
+        return meshlib.make_mesh(config)
+    per_slice = jax.device_count() // dcn_dp
+    cfg = config or meshlib.MeshConfig()
+    dp, fsdp, tp, sp = cfg.resolve(per_slice)
+    devices = mesh_utils.create_hybrid_device_mesh(
+        mesh_shape=(dp, fsdp, tp, sp),
+        dcn_mesh_shape=(dcn_dp, 1, 1, 1),
+        devices=jax.devices(),
+    )
+    return jax.sharding.Mesh(devices, meshlib.AXES)
