@@ -68,6 +68,14 @@ def make_optimizer(cfg: ActorConfig, total_steps: int = 0) -> optax.GradientTran
     )
 
 
+def default_train_attention():
+    """Default training attention: Pallas flash on TPU (O(T) memory — the
+    reference's flash-attn varlen role), dense masked attention elsewhere."""
+    from polyrl_tpu.ops import flash
+
+    return flash.auto_train_attention()
+
+
 def _model_logprobs_entropy(params, model_cfg, input_ids, positions, attn_mask,
                             responses, response_mask, remat, compute_entropy,
                             attn_fn=None):
@@ -99,7 +107,7 @@ class StreamActor:
         self.model_cfg = model_cfg
         self.cfg = cfg
         self.mesh = mesh
-        self.attn_fn = attn_fn
+        self.attn_fn = attn_fn if attn_fn is not None else default_train_attention()
         self.params = params
         self.optimizer = make_optimizer(cfg)
         self.opt_state = self.optimizer.init(params)
@@ -251,6 +259,8 @@ class ReferencePolicy:
     def __init__(self, model_cfg: decoder.ModelConfig, params: Any, attn_fn=None):
         self.model_cfg = model_cfg
         self.params = jax.tree_util.tree_map(jnp.copy, params)
+        if attn_fn is None:
+            attn_fn = default_train_attention()
         self._fn = jax.jit(
             partial(_model_logprobs_entropy, remat=False, compute_entropy=False,
                     attn_fn=attn_fn),

@@ -49,7 +49,8 @@ def critic_param_specs(model_cfg: decoder.ModelConfig) -> dict:
     return specs
 
 
-def forward_values(params, model_cfg, input_ids, positions, attn_mask, responses, remat):
+def forward_values(params, model_cfg, input_ids, positions, attn_mask, responses,
+                   remat, attn_fn=None):
     """Token values for the response region [B, T_resp] (f32)."""
     # trunk forward: reuse decoder but skip the LM head by computing
     # hidden states via a value-head projection on the normed trunk output.
@@ -59,15 +60,20 @@ def forward_values(params, model_cfg, input_ids, positions, attn_mask, responses
     # [D, 1] lm_head so XLA never materialises the [B, T, V] logits.
     value_params["lm_head"] = head
     cfg = dataclasses.replace(model_cfg, tie_word_embeddings=False)
-    values, _ = decoder.forward(value_params, cfg, input_ids, positions, attn_mask, remat=remat)
+    values, _ = decoder.forward(value_params, cfg, input_ids, positions,
+                                attn_mask, remat=remat, attn_fn=attn_fn)
     t_resp = responses.shape[1]
     return values[:, -t_resp - 1 : -1, 0].astype(jnp.float32)
 
 
 class StreamCritic:
-    def __init__(self, model_cfg: decoder.ModelConfig, cfg: CriticConfig, params: Any, mesh=None):
+    def __init__(self, model_cfg: decoder.ModelConfig, cfg: CriticConfig,
+                 params: Any, mesh=None, attn_fn=None):
+        from polyrl_tpu.trainer.actor import default_train_attention
+
         self.model_cfg = model_cfg
         self.cfg = cfg
+        self.attn_fn = attn_fn if attn_fn is not None else default_train_attention()
         self.params = params
         self.optimizer = optax.chain(
             optax.clip_by_global_norm(cfg.max_grad_norm),
@@ -82,6 +88,7 @@ class StreamCritic:
         vpreds = forward_values(
             params, self.model_cfg, batch["input_ids"], batch["positions"],
             batch["attention_mask"], batch["responses"], self.cfg.remat,
+            attn_fn=self.attn_fn,
         )
         vf_loss, clipfrac = core_algos.compute_value_loss(
             vpreds, batch["returns"], batch["values"], batch["response_mask"],
@@ -140,6 +147,7 @@ class StreamCritic:
                 lambda p, b: forward_values(
                     p, self.model_cfg, b["input_ids"], b["positions"],
                     b["attention_mask"], b["responses"], False,
+                    attn_fn=self.attn_fn,
                 )
             )
         return self._value_fn(self.params, batch)

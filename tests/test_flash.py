@@ -1,0 +1,58 @@
+"""Flash-attention training wrapper: fallback equivalence, dispatch logic,
+and GQA/segment handling (SURVEY.md §2.2 row 2 — the reference's flash-attn
+varlen role). The Pallas kernel itself only runs on TPU; it is validated on
+hardware (fwd err ~1e-4, grad err ~1e-2 vs dense) — these tests cover the
+wrapper's host logic and the dense path used off-TPU."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from polyrl_tpu.ops import flash
+from polyrl_tpu.ops.attention import attention, causal_mask
+
+
+def test_supports_flash_dispatch():
+    # off-TPU (tests force CPU) flash is never selected
+    assert not flash.supports_flash(512, 128)
+    assert flash._pick_block(512) == 512
+    assert flash._pick_block(15360) == 1024
+    assert flash._pick_block(300) is None
+
+
+def test_dense_fallback_matches_reference_masking():
+    rng = np.random.default_rng(0)
+    B, T, HQ, HKV, D = 2, 64, 4, 2, 32
+    q = jnp.asarray(rng.standard_normal((B, T, HQ, D)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((B, T, HKV, D)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((B, T, HKV, D)), jnp.float32)
+    mask = np.ones((B, T), np.float32)
+    mask[0, :9] = 0.0
+    mask = jnp.asarray(mask)
+    out = flash.flash_attention_train(q, k, v, mask)
+    m = causal_mask(T, T)[None, None] & (mask[:, None, None, :] > 0)
+    ref = attention(q, k, v, mask=m)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+def test_actor_default_attention_is_wrapper():
+    from polyrl_tpu.models import decoder
+    from polyrl_tpu.trainer.actor import ActorConfig, StreamActor
+
+    cfg = decoder.get_config("tiny", dtype=jnp.float32, vocab_size=512,
+                             max_position_embeddings=128)
+    params = decoder.init_params(jax.random.PRNGKey(0), cfg)
+    actor = StreamActor(cfg, ActorConfig(remat=False), params)
+    assert actor.attn_fn is not None
+    # and the logprob path runs through it
+    b, t = 2, 24
+    rng = np.random.default_rng(0)
+    batch = {
+        "input_ids": rng.integers(0, 500, (b, t)).astype(np.int32),
+        "positions": np.tile(np.arange(t, dtype=np.int32), (b, 1)),
+        "attention_mask": np.ones((b, t), np.float32),
+        "responses": rng.integers(0, 500, (b, 8)).astype(np.int32),
+        "response_mask": np.ones((b, 8), np.float32),
+    }
+    lp, _ = actor.compute_log_prob(batch)
+    assert np.asarray(lp).shape == (b, 8)
