@@ -59,9 +59,12 @@ def make_hybrid_mesh(dcn_dp: int | None = None,
     inside the slice on ICI. Falls back to the flat mesh single-slice."""
     from jax.experimental import mesh_utils
 
-    n_granules = getattr(jax.devices()[0], "slice_index", None)
+    # dcn_dp = number of DISTINCT slices (DCN granules), not process count:
+    # a multi-host single-slice pod (e.g. v4-32: 4 processes, 1 slice) must
+    # resolve to dcn_dp=1 or create_hybrid_device_mesh rejects the shape
+    slice_ids = {getattr(d, "slice_index", None) for d in jax.devices()}
     if dcn_dp is None:
-        dcn_dp = jax.process_count() if n_granules is not None else 1
+        dcn_dp = len(slice_ids) if None not in slice_ids else 1
     if dcn_dp <= 1:
         return meshlib.make_mesh(config)
     per_slice = jax.device_count() // dcn_dp

@@ -11,6 +11,9 @@ shaping hooks are the manager's job.
 from __future__ import annotations
 
 import concurrent.futures
+import queue
+import threading
+import time
 from dataclasses import dataclass
 from typing import Callable
 
@@ -179,15 +182,56 @@ class PrimeRewardManager(NaiveRewardManager):
 
         scores = np.zeros(len(texts), dtype=np.float32)
         n_err = 0
-        with concurrent.futures.ThreadPoolExecutor(self.num_workers) as ex:
-            futs = {ex.submit(score_one, i): i for i in range(len(texts))}
-            for fut in concurrent.futures.as_completed(futs, timeout=None):
-                i = futs[fut]
+        # daemon worker threads, NOT ThreadPoolExecutor: executor workers
+        # are non-daemon and joined by an atexit hook, so a permanently
+        # wedged scorer would block interpreter shutdown; daemon threads are
+        # truly abandonable. Overall deadline = timeout_s per wave.
+        n = len(texts)
+        work: "queue.Queue[int]" = queue.Queue()
+        for i in range(n):
+            work.put(i)
+        done: "queue.Queue[tuple[int, float | None]]" = queue.Queue()
+
+        def _worker() -> None:
+            while True:
                 try:
-                    scores[i] = fut.result(timeout=self.timeout_s)
-                except Exception:  # noqa: BLE001 — timeout or scorer crash
-                    scores[i] = 0.0
-                    n_err += 1
+                    i = work.get_nowait()
+                except queue.Empty:
+                    return
+                try:
+                    done.put((i, score_one(i)))
+                except Exception:  # noqa: BLE001 — scorer crash
+                    done.put((i, None))
+
+        for _ in range(min(self.num_workers, max(n, 1))):
+            threading.Thread(target=_worker, daemon=True).start()
+        n_waves = max(1, -(-n // self.num_workers))
+        deadline = time.monotonic() + self.timeout_s * n_waves
+        collected = 0
+        got = np.zeros(n, dtype=bool)
+        while collected < n:
+            try:
+                i, s = done.get(timeout=max(0.0, deadline - time.monotonic()))
+            except queue.Empty:
+                break  # deadline: drain what already finished, then give up
+            got[i] = True
+            collected += 1
+            if s is None:
+                n_err += 1
+            else:
+                scores[i] = s
+        # drain results that landed right at the deadline (no busy wait)
+        while True:
+            try:
+                i, s = done.get_nowait()
+            except queue.Empty:
+                break
+            got[i] = True
+            if s is not None:
+                scores[i] = s
+            else:
+                n_err += 1
+        n_err += int((~got).sum())  # abandoned (hung/unstarted) samples
         token_scores = np.zeros_like(response_mask, dtype=np.float32)
         for i, ln in enumerate(lengths):
             if ln > 0:

@@ -138,6 +138,12 @@ class CBEngine:
         self._top_ks = np.zeros((s,), np.int32)
         self._stop_table = np.full((s, MAX_STOP_TOKENS), -1, np.int32)
         self._slots: list[_SlotInfo | None] = [None] * s
+        # per-slot admission generation: queued emit entries record the
+        # generation they were dispatched against, so an entry that outlives
+        # its slot (finalized via the device-done path, then reused by a new
+        # admission before the entry drains) is detected and skipped instead
+        # of leaking pad tokens into the new request's stream (ABA race)
+        self._slot_gen = np.zeros((s,), np.int64)
 
         self.allocator = PageAllocator(self.num_pages)
         self.prefix_cache = (PrefixCache(page_size, self.allocator.free)
@@ -575,7 +581,9 @@ class CBEngine:
         self._stop_table[slot] = stops
         self._slots[slot] = _SlotInfo(req, private, set(sp.stop_token_ids),
                                       cache_entries=matched_entries)
-        self._emit_q.append(("prefill", token, logp, done, slot))
+        self._slot_gen[slot] += 1
+        self._emit_q.append(("prefill", token, logp, done,
+                             (slot, int(self._slot_gen[slot]))))
 
     # -- device-resident state + pipelined stepping --------------------------
 
@@ -619,11 +627,13 @@ class CBEngine:
             else:
                 self._emit_prefill(int(token), float(logp), bool(done), tail)
 
-    def _emit_prefill(self, t: int, lp: float, device_done: bool, slot: int) -> None:
+    def _emit_prefill(self, t: int, lp: float, device_done: bool,
+                      tail: tuple[int, int]) -> None:
         """Deliver an admitted request's first token (deferred from the
         fused prefill dispatch)."""
+        slot, gen = tail
         info = self._slots[slot]
-        if info is None:
+        if info is None or self._slot_gen[slot] != gen:
             return
         stop_hit = t in info.stop_set
         fin = device_done or stop_hit
@@ -641,14 +651,15 @@ class CBEngine:
                 self._invalidate_dev_state()
 
     def _emit_fetched(self, token, logp, done, idxs) -> None:
-        """Stream one fetched step to the requests; ``idxs`` may be a
-        superset of live slots (mirrors lag the pipeline by one step) —
-        finished/replaced slots are filtered here."""
+        """Stream one fetched step to the requests; ``idxs`` is a list of
+        (slot, generation) pairs and may be a superset of live slots
+        (mirrors lag the pipeline by one step) — finished slots and slots
+        reused by a newer admission (generation mismatch) are filtered."""
         n_emitted = 0
         host_stop_fix = False
-        for i in idxs:
+        for i, gen in idxs:
             info = self._slots[i]
-            if info is None or not self._active[i]:
+            if info is None or not self._active[i] or self._slot_gen[i] != gen:
                 continue
             t = int(token[i])
             # host check is authoritative: covers stop tokens beyond the
@@ -714,7 +725,8 @@ class CBEngine:
             st["top_ps"], st["top_ks"], st["stop_table"])
         self._pools = (kp, vp)
         self._emit_q.append(("step", token, logp, done,
-                             np.flatnonzero(self._active)))
+                             [(int(i), int(self._slot_gen[i]))
+                              for i in np.flatnonzero(self._active)]))
         # keep a couple of dispatches outstanding: older outputs stream out
         # while the device computes, hiding the tunnel round trip entirely
         self._drain_emit_q(keep=self.pipeline_depth)

@@ -24,6 +24,13 @@ class _Entry:
     refcount: int = 0
     tick: int = 0
     orphaned: bool = False  # dropped from the map while still referenced
+    # collision guard: the hash key alone is NOT trusted (a 64-bit collision
+    # would silently serve another prompt's KV). Each entry records its own
+    # page's tokens and the identity of its parent entry; a match requires
+    # token equality at every page AND that the parent chain is the exact
+    # sequence of entries already verified for this request.
+    page_toks: tuple = ()
+    parent: "_Entry | None" = None
 
 
 class PrefixCache:
@@ -56,14 +63,18 @@ class PrefixCache:
         pages: list[int] = []
         entries: list[_Entry] = []
         self._tick += 1
-        for key in self._keys_for(tokens, n_full):
+        prev: _Entry | None = None
+        for i, key in enumerate(self._keys_for(tokens, n_full)):
             e = self._map.get(key)
-            if e is None:
+            page_toks = tuple(
+                tokens[i * self.page_size:(i + 1) * self.page_size])
+            if e is None or e.page_toks != page_toks or e.parent is not prev:
                 break
             e.refcount += 1
             e.tick = self._tick
             pages.append(e.page)
             entries.append(e)
+            prev = e
         self.hits += len(pages)
         return pages, entries
 
@@ -77,13 +88,45 @@ class PrefixCache:
         keys = self._keys_for(tokens, n_full)
         out: list[tuple[int, _Entry]] = []
         self._tick += 1
+        prev: _Entry | None = (
+            self._map.get(keys[n_cached - 1]) if n_cached > 0 else None)
+        if n_cached > 0 and prev is None:
+            # matched parent vanished (should not happen under the pool
+            # lock); publishing children would break the verified chain
+            self.misses += max(0, n_full - n_cached)
+            return out
         for i in range(n_cached, n_full):
             key = keys[i]
-            if key in self._map:  # duplicate content: keep the existing
-                continue          # entry, caller's page stays slot-private
-            e = _Entry(key=key, page=page_ids[i], refcount=1, tick=self._tick)
+            page_toks = tuple(
+                tokens[i * self.page_size:(i + 1) * self.page_size])
+            existing = self._map.get(key)
+            if existing is not None:
+                # duplicate key: caller's page stays slot-private. Only keep
+                # chaining if the existing entry REALLY is this prefix
+                # (token + parent-identity check — a colliding entry would
+                # poison every child published under it)
+                if existing.page_toks == page_toks and existing.parent is prev:
+                    prev = existing
+                    continue
+                if existing.refcount == 0:
+                    # stale squatter (e.g. a child whose parent was evicted,
+                    # or a colliding entry): replace it so this prefix stays
+                    # cacheable instead of permanently re-prefilling
+                    del self._map[key]
+                    self._free_pages([existing.page])
+                    e = _Entry(key=key, page=page_ids[i], refcount=1,
+                               tick=self._tick, page_toks=page_toks,
+                               parent=prev)
+                    self._map[key] = e
+                    out.append((i, e))
+                    prev = e
+                    continue
+                break
+            e = _Entry(key=key, page=page_ids[i], refcount=1, tick=self._tick,
+                       page_toks=page_toks, parent=prev)
             self._map[key] = e
             out.append((i, e))
+            prev = e
         self.misses += max(0, n_full - n_cached)
         return out
 

@@ -178,3 +178,54 @@ def test_release_resume_memory(tiny):
     res = cbe.generate([[1, 2, 3]], sp)
     cbe.stop()
     assert res[0]["finish_reason"] in ("stop", "length")
+
+
+def test_slot_reuse_stale_emit_guard(tiny):
+    """Regression (ABA): a queued 'step' entry dispatched for an old request
+    must never emit into a NEW request admitted into the same slot after the
+    old one finalized via the device-done path (which leaves _dev_state
+    valid, so admission does not drain the queue). Guarded by the per-slot
+    generation counter recorded in each dispatched entry."""
+    from polyrl_tpu.rollout.cb_engine import STREAM_END
+
+    cbe = _mk_engine(tiny, max_slots=1)
+    cbe.pipeline_depth = 8  # keep dispatches queued until we drain explicitly
+    sp = SamplingParams(temperature=0.0, max_new_tokens=2, stop_token_ids=())
+
+    qa = cbe.submit("a", [5, 3, 9], sp)
+    cbe._drain_queue()
+    with cbe._pool_lock:
+        cbe._admit()       # prefill A queued; budget=2 -> one decode step left
+        cbe._step_once()   # step1: device-side done (n_gen hits budget)
+        cbe._step_once()   # step2: host mirror lags -> STALE dispatch for slot 0
+    assert len(cbe._emit_q) == 3
+
+    # drain all but the stale step2 entry: A finishes and slot 0 is finalized
+    # via device_done=True, i.e. WITHOUT invalidating the device state
+    cbe._drain_emit_q(keep=1)
+    assert cbe._slots[0] is None and len(cbe._emit_q) == 1
+    a_tokens = []
+    while True:
+        item = qa.get_nowait()
+        if item is STREAM_END:
+            break
+        a_tokens.extend(item["token_ids"])
+    assert len(a_tokens) == 2
+
+    # admit B into the reused slot 0 while the stale entry is still queued
+    qb = cbe.submit("b", [7, 1], sp)
+    cbe._drain_queue()
+    with cbe._pool_lock:
+        cbe._admit()
+    assert cbe._slots[0] is not None and len(cbe._emit_q) == 2
+
+    cbe._drain_emit_q()  # stale step2 drains FIRST and must be skipped
+    first = qb.get_nowait()
+    # without the generation guard the stale entry emits a pad token with
+    # logprob 0.0 into B's stream and bumps the host mirrors out of sync
+    assert len(first["token_ids"]) == 1
+    assert not (first["token_ids"][0] == cbe.pad_token_id
+                and first["logprobs"][0] == 0.0)
+    assert int(cbe._n_generated[0]) == 1     # only B's prefill token counted
+    assert int(cbe._seq_lens[0]) == 2       # B's prompt length, un-bumped
+    cbe.stop()

@@ -131,3 +131,51 @@ def test_engine_divergent_prompts_correct_under_sharing():
     for i in range(3):
         assert outs_on[i]["token_ids"] == outs_off[i]["token_ids"], i
     on.stop(), off.stop()
+
+
+def test_hash_collision_never_serves_wrong_pages():
+    """A 64-bit key collision must not serve another prompt's KV: entries
+    verify page tokens AND parent-entry identity, not just the hash chain."""
+    pc, freed = _cache()
+    # force EVERY chain key to collide
+    orig_keys = PrefixCache._keys_for
+    pc._keys_for = lambda tokens, n: [(7,)] * n
+
+    a = [1, 2, 3, 4, 5, 6, 7, 8, 9]          # two full pages
+    pub = pc.publish(a, [10, 11], n_cached=0)
+    assert len(pub) == 1                      # second page collides w/ first,
+    assert pub[0][1].page == 10               # chain stops (parent mismatch)
+
+    b = [9, 9, 9, 9, 1, 1, 1, 1, 2]          # different tokens, same keys
+    pages, entries = pc.match(b)
+    assert pages == [] and entries == []      # token check rejects collision
+
+    pages_a, entries_a = pc.match(a)          # the real prefix still matches
+    assert pages_a == [10]
+    pc.release(entries_a)
+    pc._keys_for = orig_keys.__get__(pc)
+
+
+def test_parent_chain_identity_required():
+    """Page i only matches when pages 0..i-1 matched the SAME entries (a
+    child whose parent was evicted is unreachable, not wrongly served)."""
+    pc, freed = _cache()
+    a = list(range(1, 10))                    # two full pages -> 2 entries
+    pub = pc.publish(a, [10, 11], n_cached=0)
+    assert len(pub) == 2
+    pc.release([e for _, e in pub])
+    # evict only the first (LRU) entry; its child remains mapped
+    assert pc.evict(1) == 1
+    assert freed == [10]
+    pages, entries = pc.match(a)
+    assert pages == []                        # chain broke at the parent
+    # re-publishing the same prompt REPAIRS the chain: the unreachable
+    # stale child (refcount 0) is replaced, its page freed, and the prefix
+    # becomes cacheable again instead of permanently re-prefilling
+    pub2 = pc.publish(a, [20, 21], n_cached=0)
+    assert len(pub2) == 2
+    assert 11 in freed                        # stale child's page reclaimed
+    pc.release([e for _, e in pub2])
+    pages, entries = pc.match(a)
+    assert pages == [20, 21]
+    pc.release(entries)

@@ -149,3 +149,26 @@ def test_naive_manager_passes_extra_info():
     mgr = load_reward_manager("naive", tok, compute_score=spy, num_workers=1)
     mgr(_batch(["t"], [""], tok, extras=[{"k": 1}]))
     assert seen == [{"k": 1}]
+
+
+def test_prime_manager_hung_scorer_is_abandoned():
+    """A wedged scorer (the exact flaky code-execution case) must not block
+    the training step: the overall deadline zeros unfinished samples and the
+    manager returns without joining the hung thread."""
+    import time
+
+    tok = ByteTokenizer()
+
+    def hang(source, text, gt, extra):
+        if "hang" in text:
+            time.sleep(60.0)
+        return 1.0
+
+    mgr = load_reward_manager("prime", tok, compute_score=hang,
+                              num_workers=2, timeout_s=1.0)
+    t0 = time.monotonic()
+    out = mgr(_batch(["fine", "hang now"], ["", ""], tok))
+    assert time.monotonic() - t0 < 10.0
+    assert out.scores[0] == 1.0
+    assert out.scores[1] == 0.0
+    assert out.metrics["reward/score_errors"] >= 1.0
