@@ -63,6 +63,12 @@ class RolloutSection:
     manager_args: tuple = ()              # extra CLI args for the spawned manager
     transfer_streams: int = 4
     advertise_host: str = "127.0.0.1"
+    # hybrid colocated + remote: ALSO serve generation from an in-process
+    # engine registered as a LOCAL (time-sliced) instance — the manager
+    # aborts it after the balancer's local window and the engine yields its
+    # KV HBM back to training (reference sglang_http_async_engine.py:102-113
+    # + handlers.rs:500-513)
+    colocated_local: bool = False
 
 
 @dataclass

@@ -92,6 +92,7 @@ inline Config load_config(int argc, char** argv) {
     else if (a == "--generate-timeout-ms") cfg.generate_timeout_ms = std::stoi(v);
     else if (a == "--schedule-wait-timeout-ms") cfg.schedule_wait_timeout_ms = std::stoi(v);
     else if (a == "--groups-per-sender") cfg.groups_per_sender = std::stoi(v);
+    else if (a == "--initial-local-gen-s") cfg.initial_local_gen_s = std::stod(v);
   }
   return cfg;
 }

@@ -43,9 +43,15 @@ def supports_flash(t: int, head_dim: int) -> bool:
             and head_dim % 128 == 0)
 
 
-def _dense(q, k, v, attn_mask, causal: bool):
+def _dense(q, k, v, attn_mask, causal: bool, segment_ids=None):
     t = q.shape[1]
-    mask = attn_mask[:, None, None, :] > 0
+    if segment_ids is not None:
+        # packed sequences: tokens attend only within their own segment
+        # (block-diagonal), matching the Pallas kernel's SegmentIds semantics
+        mask = (segment_ids[:, None, :, None] == segment_ids[:, None, None, :])
+        mask = mask & (attn_mask[:, None, None, :] > 0)
+    else:
+        mask = attn_mask[:, None, None, :] > 0
     if causal:
         mask = causal_mask(t, t)[None, None] & mask
     return attention(q, k, v, mask=mask)
@@ -59,7 +65,7 @@ def flash_attention_train(q, k, v, attn_mask, *, causal: bool = True,
     b, t, hq, d = q.shape
     hkv = k.shape[2]
     if not supports_flash(t, d):
-        return _dense(q, k, v, attn_mask, causal)
+        return _dense(q, k, v, attn_mask, causal, segment_ids)
 
     from jax.experimental.pallas.ops.tpu.flash_attention import (
         BlockSizes, SegmentIds, flash_attention)

@@ -35,13 +35,20 @@ from polyrl_tpu.parallel.mesh import DP, FSDP, SP
 _NEG = -0.7 * float(jnp.finfo(jnp.float32).max)  # finite -inf (no exp NaNs)
 
 
-def _maybe_repeat_kv(k, v, hq: int, sp: int):
-    """GQA: if KV heads don't split evenly over sp, expand to Q heads."""
+def _expand_kv_minimal(k, v, hq: int, sp: int):
+    """GQA under Ulysses: KV heads ride the same all-to-all as Q heads, so
+    their count must divide by sp. When ``hkv % sp != 0``, expand by the
+    SMALLEST factor r (r must divide the GQA group hq/hkv so head↔group
+    association survives the head split, and make hkv*r % sp == 0) —
+    full expansion to hq only as the last resort. This keeps most of the
+    GQA memory win, e.g. hkv=8, hq=32, sp=16 expands 2× not 4×."""
     hkv = k.shape[2]
-    if hkv % sp != 0:
-        n_rep = hq // hkv
-        k, v = repeat_kv(k, n_rep), repeat_kv(v, n_rep)
-    return k, v
+    if hkv % sp == 0:
+        return k, v
+    group = hq // hkv
+    r = next((r for r in range(2, group + 1)
+              if group % r == 0 and (hkv * r) % sp == 0), group)
+    return repeat_kv(k, r), repeat_kv(v, r)
 
 
 # --------------------------------------------------------------------------
@@ -59,7 +66,7 @@ def make_ulysses_attention(mesh: Mesh, axis: str = SP,
     def inner(q, k, v, token_mask):
         # local: q [B, Ts, Hq, D]; all_to_all -> [B, T, Hq/sp, D]
         hq = q.shape[2]
-        k, v = _maybe_repeat_kv(k, v, hq, sp)
+        k, v = _expand_kv_minimal(k, v, hq, sp)
         q_g = lax.all_to_all(q, axis, split_axis=2, concat_axis=1, tiled=True)
         k_g = lax.all_to_all(k, axis, split_axis=2, concat_axis=1, tiled=True)
         v_g = lax.all_to_all(v, axis, split_axis=2, concat_axis=1, tiled=True)
@@ -89,44 +96,47 @@ def make_ring_attention(mesh: Mesh, axis: str = SP, batch_axes=(DP, FSDP)):
     perm = [(i, (i + 1) % sp) for i in range(sp)]
 
     def inner(q, k, v, token_mask):
+        # GQA-native: heads never leave their rank in ring attention, so KV
+        # is NOT expanded at all — the rotating K/V blocks stay at hkv heads
+        # (the dominant memory/ICI cost) and Q heads group against their
+        # shared KV head in the einsum, exactly like ops.attention.
         b, tq, hq, d = q.shape
-        k, v = _maybe_repeat_kv(k, v, hq, sp)
-        if k.shape[2] != hq:  # evenly divisible GQA: still expand locally —
-            k, v = repeat_kv(k, hq // k.shape[2]), repeat_kv(v, hq // k.shape[2])
+        hkv = k.shape[2]
+        g = hq // hkv
         scale = d ** -0.5
         idx = lax.axis_index(axis)
-        q32 = q.astype(jnp.float32) * scale
+        q32 = q.reshape(b, tq, hkv, g, d).astype(jnp.float32) * scale
         q_pos = idx * tq + jnp.arange(tq)  # global positions of local Q rows
 
-        m = jnp.full((b, hq, tq), _NEG, jnp.float32)
-        l = jnp.zeros((b, hq, tq), jnp.float32)
-        o = jnp.zeros((b, tq, hq, d), jnp.float32)
+        m = jnp.full((b, hkv, g, tq), _NEG, jnp.float32)
+        l = jnp.zeros((b, hkv, g, tq), jnp.float32)
+        o = jnp.zeros((b, tq, hkv, g, d), jnp.float32)
         k_cur, v_cur, mask_cur = k, v, token_mask
 
         for step in range(sp):
             src = (idx - step) % sp  # block id currently held
             tk = k_cur.shape[1]
-            logits = jnp.einsum("bqhd,bkhd->bhqk", q32,
+            logits = jnp.einsum("bqhgd,bkhd->bhgqk", q32,
                                 k_cur.astype(jnp.float32))
             kv_pos = src * tk + jnp.arange(tk)
-            ok = (kv_pos[None, :] <= q_pos[:, None])[None, None, :, :]
-            ok = ok & (mask_cur[:, None, None, :] > 0)
+            ok = (kv_pos[None, :] <= q_pos[:, None])[None, None, None, :, :]
+            ok = ok & (mask_cur[:, None, None, None, :] > 0)
             logits = jnp.where(ok, logits, _NEG)
             m_new = jnp.maximum(m, logits.max(axis=-1))
             p = jnp.exp(logits - m_new[..., None])
             p = jnp.where(ok, p, 0.0)
-            corr = jnp.exp(m - m_new)
+            corr = jnp.exp(m - m_new)                      # [b,hkv,g,tq]
             l = l * corr + p.sum(axis=-1)
-            o = o * corr.transpose(0, 2, 1)[..., None] + jnp.einsum(
-                "bhqk,bkhd->bqhd", p, v_cur.astype(jnp.float32))
+            o = o * corr.transpose(0, 3, 1, 2)[..., None] + jnp.einsum(
+                "bhgqk,bkhd->bqhgd", p, v_cur.astype(jnp.float32))
             m = m_new
             if step < sp - 1:
                 k_cur = lax.ppermute(k_cur, axis, perm)
                 v_cur = lax.ppermute(v_cur, axis, perm)
                 mask_cur = lax.ppermute(mask_cur, axis, perm)
 
-        denom = jnp.maximum(l, 1e-30).transpose(0, 2, 1)[..., None]
-        return (o / denom).astype(q.dtype)
+        denom = jnp.maximum(l, 1e-30).transpose(0, 3, 1, 2)[..., None]
+        return (o / denom).reshape(b, tq, hq, d).astype(q.dtype)
 
     qkv_spec = P(batch_axes, axis, None, None)
     mask_spec = P(batch_axes, axis)

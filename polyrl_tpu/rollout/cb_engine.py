@@ -177,6 +177,7 @@ class CBEngine:
         self.num_running = 0
         self.num_queued = 0
         self.last_gen_throughput = 0.0
+        self.total_tokens_served = 0
         self._tok_window: collections.deque = collections.deque(maxlen=64)
 
     # -- compiled pieces ----------------------------------------------------
@@ -766,6 +767,7 @@ class CBEngine:
             self._finalize(i)
 
     def _count_tokens(self, n: int) -> None:
+        self.total_tokens_served += n
         now = time.monotonic()
         self._tok_window.append((now, n))
         horizon = now - 10.0

@@ -69,6 +69,32 @@ class RemoteRollout:
         ``min_emit`` need not divide by group_size — emission granularity is
         whole groups, the threshold just gates when to flush."""
         assert len(prompt_ids) % group_size == 0
+        # colocated time-slicing: the local engine serves during the window
+        # (manager aborts it after max_local_gen_s, handlers.rs:500-513
+        # equivalent), then yields its KV HBM back to training. Resume here,
+        # release at window expiry (grace for the abort to drain) or at
+        # stream end, whichever first.
+        local_eng = (self.local_server.engine
+                     if self.local_server is not None else None)
+        released = threading.Event()
+
+        def _release() -> None:
+            if released.is_set() or local_eng is None:
+                return
+            released.set()
+            try:
+                local_eng.release_memory()
+            except Exception:  # noqa: BLE001 — time-slicing is best-effort
+                log.exception("local engine release_memory failed")
+
+        window_timer: threading.Timer | None = None
+        if local_eng is not None:
+            if hasattr(local_eng, "resume_memory"):
+                local_eng.resume_memory()
+            if max_local_gen_s:
+                window_timer = threading.Timer(max_local_gen_s + 1.0, _release)
+                window_timer.daemon = True
+                window_timer.start()
         reqs = [{"rid": str(i), "input_ids": list(p),
                  "sampling_params": {
                      "temperature": sampling.temperature,
@@ -136,6 +162,9 @@ class RemoteRollout:
             self.dropped_groups += len(groups)
         elapsed = gen_end[0] - gen_t0
         self.last_gen_throughput = n_tokens / elapsed if elapsed > 0 else 0.0
+        if window_timer is not None:
+            window_timer.cancel()
+        _release()  # stream done: nothing left to serve locally
         if pending:
             yield pending
 
@@ -149,9 +178,20 @@ class RemoteRollout:
             self.weight_version = self.transfer.update_weights_with_agent(params)
         else:
             self.weight_version = self.manager.update_weight_version()
-            if self.local_server is not None:
-                self.local_server.engine.update_weights(
-                    params, version=self.weight_version)
+        if self.local_server is not None:
+            # colocated engine shares the chip but must own a COPY: the
+            # actor's opt step DONATES its param buffers while the engine
+            # may still be serving late groups (streaming overlap) — a
+            # by-reference swap leaves the engine on deleted buffers. The
+            # reference pays the same cost (the local SGLang process holds
+            # its own weights). No fabric hop either way; the manager
+            # re-adds locals to the pool on update_weight_version.
+            import jax
+            import jax.numpy as jnp
+
+            engine_copy = jax.tree_util.tree_map(jnp.copy, params)
+            self.local_server.engine.update_weights(
+                engine_copy, version=self.weight_version)
         return self.weight_version
 
     def update_metrics(self, **stats) -> dict:

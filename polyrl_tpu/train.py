@@ -118,7 +118,31 @@ def _build_rollout(cfg: RunConfig, mcfg, params, tokenizer, cleanup: list):
         params, manager_client=mgr, num_streams=cfg.rollout.transfer_streams,
         advertise_host=cfg.rollout.advertise_host)
     cleanup.append(iface.close)
-    return RemoteRollout(mgr, transfer=iface, pad_token_id=pad)
+
+    local_server = None
+    if cfg.rollout.colocated_local:
+        # hybrid mode: an in-process engine shares this chip with training
+        # and registers as a LOCAL instance — the manager time-slices it
+        # (abort after the balancer window) and RemoteRollout releases /
+        # resumes its KV HBM around the generation phase (reference
+        # sglang_http_async_engine.py:43-113 + stream_fsdp_workers.py:468-492)
+        from polyrl_tpu.rollout.cb_engine import CBEngine
+        from polyrl_tpu.rollout.serve import register_with_manager
+        from polyrl_tpu.rollout.server import RolloutServer
+
+        eng = CBEngine(
+            mcfg, params, pad_token_id=pad, kv_cache_dtype=kv_dtype,
+            max_slots=cfg.rollout.max_slots, page_size=cfg.rollout.page_size,
+            max_seq_len=cfg.rollout.max_seq_len,
+            **({"prompt_buckets": tuple(cfg.rollout.prompt_buckets)}
+               if cfg.rollout.prompt_buckets else {}))
+        local_server = RolloutServer(eng, host="127.0.0.1", port=0).start()
+        cleanup.append(local_server.stop)
+        register_with_manager(local_server, endpoint, is_local=True)
+        log.info("colocated local engine registered at %s",
+                 local_server.endpoint)
+    return RemoteRollout(mgr, transfer=iface, local_server=local_server,
+                         pad_token_id=pad)
 
 
 def build_trainer(cfg: RunConfig, cleanup: list | None = None):

@@ -93,6 +93,31 @@ def _model_logprobs_entropy(params, model_cfg, input_ids, positions, attn_mask,
     return logprobs, entropy
 
 
+def _packed_logprobs_entropy(params, model_cfg, input_ids, positions,
+                             attn_mask, segment_ids, remat, compute_entropy):
+    """Packed-row (remove-padding) variant: rows hold several trajectories
+    separated by segment ids (reference use_remove_padding + flash varlen,
+    stream_dp_actor.py:41-47). Returns per-COLUMN logprobs [R, L]: column t
+    holds the logprob of input_ids[:, t] predicted from column t-1 — response
+    tokens are selected by the caller's loss_mask (never at column 0, since a
+    segment always starts with >= 1 prompt token)."""
+    from polyrl_tpu.ops import flash
+
+    attn = lambda q, k, v, am: flash.flash_attention_train(  # noqa: E731
+        q, k, v, am, causal=True, segment_ids=segment_ids)
+    logits, _ = decoder.forward(params, model_cfg, input_ids, positions,
+                                attn_mask, remat=remat, attn_fn=attn)
+    pred = logits[:, :-1, :]
+    targets = input_ids[:, 1:]
+    lp = core_algos.logprobs_from_logits(pred, targets)
+    lp = jnp.pad(lp, ((0, 0), (1, 0)))
+    if compute_entropy:
+        ent = jnp.pad(core_algos.entropy_from_logits(pred), ((0, 0), (1, 0)))
+    else:
+        ent = None
+    return lp, ent
+
+
 class StreamActor:
     """Owns params + optimizer + accumulated grads; stream-update semantics."""
 
@@ -112,6 +137,11 @@ class StreamActor:
         self.optimizer = make_optimizer(cfg)
         self.opt_state = self.optimizer.init(params)
         self.accum_grads = jax.tree_util.tree_map(jnp.zeros_like, params)
+        # sum of loss_scales accumulated since the last opt step: a tail
+        # flush renormalizes by it so a partial minibatch sees the same
+        # effective gradient scale as a full one (mean over actual micros,
+        # not sum/G — reference loss_scale_factor semantics)
+        self._accum_scale = 0.0
         self._update_fns: dict = {}
         self._logprob_fns: dict = {}
         self._opt_offloaded = False
@@ -146,12 +176,23 @@ class StreamActor:
 
     def _loss_fn(self, params, batch, loss_scale: float):
         cfg = self.cfg
-        logprobs, entropy = _model_logprobs_entropy(
-            params, self.model_cfg,
-            batch["input_ids"], batch["positions"], batch["attention_mask"],
-            batch["responses"], batch["response_mask"],
-            cfg.remat, cfg.entropy_coeff != 0.0, attn_fn=self.attn_fn,
-        )
+        if "segment_ids" in batch:
+            # packed rows: loss_mask plays response_mask; advantages /
+            # old_log_probs already live in the packed [R, L] layout
+            logprobs, entropy = _packed_logprobs_entropy(
+                params, self.model_cfg,
+                batch["input_ids"], batch["positions"],
+                batch["attention_mask"], batch["segment_ids"],
+                cfg.remat, cfg.entropy_coeff != 0.0,
+            )
+            batch = dict(batch, response_mask=batch["loss_mask"])
+        else:
+            logprobs, entropy = _model_logprobs_entropy(
+                params, self.model_cfg,
+                batch["input_ids"], batch["positions"], batch["attention_mask"],
+                batch["responses"], batch["response_mask"],
+                cfg.remat, cfg.entropy_coeff != 0.0, attn_fn=self.attn_fn,
+            )
         loss_fn = core_algos.get_policy_loss_fn(cfg.policy_loss)
         pg_loss, clipfrac, approx_kl, clipfrac_lower = loss_fn(
             batch["old_log_probs"], logprobs, batch["advantages"],
@@ -211,16 +252,22 @@ class StreamActor:
             self.params, self.opt_state, self.accum_grads, batch,
             jnp.asarray(loss_scale, jnp.float32),
         )
+        self._accum_scale = 0.0 if is_opt_step else self._accum_scale + loss_scale
         return metrics
 
     def flush_opt_step(self) -> dict:
         """Apply accumulated grads without new data — the stream trainer's
-        final flush when a short batch (dropped groups) ends mid-minibatch."""
+        final flush when a short batch (dropped groups) ends mid-minibatch.
+        Accumulated grads are renormalized by the summed loss_scale so the
+        partial minibatch's update has the same effective gradient scale
+        (mean over its micros) as a full minibatch, not a sum/G fraction."""
         self.load_opt_state()
         if not hasattr(self, "_flush_fn"):
             optimizer = self.optimizer
 
-            def flush(params, opt_state, accum_grads):
+            def flush(params, opt_state, accum_grads, inv_scale):
+                accum_grads = jax.tree_util.tree_map(
+                    lambda g: g * inv_scale, accum_grads)
                 updates, opt_state = optimizer.update(accum_grads, opt_state, params)
                 params = optax.apply_updates(params, updates)
                 gn = optax.global_norm(accum_grads)
@@ -228,8 +275,11 @@ class StreamActor:
                 return params, opt_state, accum_grads, gn
 
             self._flush_fn = jax.jit(flush, donate_argnums=(0, 1, 2))
+        inv = 1.0 / self._accum_scale if self._accum_scale > 0 else 1.0
         self.params, self.opt_state, self.accum_grads, gn = self._flush_fn(
-            self.params, self.opt_state, self.accum_grads)
+            self.params, self.opt_state, self.accum_grads,
+            jnp.asarray(inv, jnp.float32))
+        self._accum_scale = 0.0
         return {"actor/grad_norm": gn}
 
     def compute_log_prob(self, batch: dict, compute_entropy: bool = True):
@@ -245,6 +295,23 @@ class StreamActor:
             self.params, self.model_cfg,
             batch["input_ids"], batch["positions"], batch["attention_mask"],
             batch["responses"], batch["response_mask"],
+        )
+
+    def compute_log_prob_packed(self, batch: dict, compute_entropy: bool = True,
+                                params=None):
+        """Packed-row logprob pass: [R, L] per-column logprobs aligned so
+        loss_mask selects response tokens (see _packed_logprobs_entropy)."""
+        key = ("packed", compute_entropy)
+        if key not in self._logprob_fns:
+            self._logprob_fns[key] = jax.jit(
+                partial(_packed_logprobs_entropy, remat=False,
+                        compute_entropy=compute_entropy),
+                static_argnums=(1,),
+            )
+        return self._logprob_fns[key](
+            params if params is not None else self.params, self.model_cfg,
+            batch["input_ids"], batch["positions"], batch["attention_mask"],
+            batch["segment_ids"],
         )
 
 
@@ -266,11 +333,24 @@ class ReferencePolicy:
                     attn_fn=attn_fn),
             static_argnums=(1,),
         )
+        self._packed_fn = jax.jit(
+            partial(_packed_logprobs_entropy, remat=False,
+                    compute_entropy=False),
+            static_argnums=(1,),
+        )
 
     def compute_log_prob(self, batch: dict):
         lp, _ = self._fn(
             self.params, self.model_cfg,
             batch["input_ids"], batch["positions"], batch["attention_mask"],
             batch["responses"], batch["response_mask"],
+        )
+        return lp
+
+    def compute_log_prob_packed(self, batch: dict):
+        lp, _ = self._packed_fn(
+            self.params, self.model_cfg,
+            batch["input_ids"], batch["positions"], batch["attention_mask"],
+            batch["segment_ids"],
         )
         return lp

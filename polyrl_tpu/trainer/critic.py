@@ -81,6 +81,7 @@ class StreamCritic:
         )
         self.opt_state = self.optimizer.init(params)
         self.accum_grads = jax.tree_util.tree_map(jnp.zeros_like, params)
+        self._accum_scale = 0.0  # see StreamActor: tail-flush renormalization
         self._update_fns: dict = {}
         self._value_fn = None
 
@@ -122,14 +123,18 @@ class StreamCritic:
             self.params, self.opt_state, self.accum_grads, batch,
             jnp.asarray(loss_scale, jnp.float32),
         )
+        self._accum_scale = 0.0 if is_opt_step else self._accum_scale + loss_scale
         return metrics
 
     def flush_opt_step(self) -> dict:
-        """Apply accumulated grads without new data (see StreamActor)."""
+        """Apply accumulated grads without new data (see StreamActor);
+        renormalizes by the summed loss_scale so the partial minibatch's
+        effective gradient scale matches a full one."""
         if not hasattr(self, "_flush_fn"):
             optimizer = self.optimizer
 
-            def flush(params, opt_state, accum):
+            def flush(params, opt_state, accum, inv_scale):
+                accum = jax.tree_util.tree_map(lambda g: g * inv_scale, accum)
                 updates, opt_state = optimizer.update(accum, opt_state, params)
                 params = optax.apply_updates(params, updates)
                 gn = optax.global_norm(accum)
@@ -137,8 +142,11 @@ class StreamCritic:
                 return params, opt_state, accum, gn
 
             self._flush_fn = jax.jit(flush, donate_argnums=(0, 1, 2))
+        inv = 1.0 / self._accum_scale if self._accum_scale > 0 else 1.0
         self.params, self.opt_state, self.accum_grads, gn = self._flush_fn(
-            self.params, self.opt_state, self.accum_grads)
+            self.params, self.opt_state, self.accum_grads,
+            jnp.asarray(inv, jnp.float32))
+        self._accum_scale = 0.0
         return {"critic/grad_norm": gn}
 
     def compute_values(self, batch: dict) -> jnp.ndarray:

@@ -68,6 +68,13 @@ class TrainerConfig:
     # lengths
     max_prompt_length: int = 128
     max_response_length: int = 128
+    # packed-sequence (remove-padding) training + token-balanced micros
+    # (reference use_remove_padding stream_dp_actor.py:41-47 and
+    # prepare_dynamic_batch :35,136; recipe 16,384 tok/GPU): actor passes run
+    # on fixed [n_rows, pack_len] packed grids instead of [B, Tp+Tr] pads
+    use_remove_padding: bool = False
+    pack_len: int = 0                     # 0 → max_prompt+max_response
+    micro_token_budget: int = 0           # 0 → micro_batch_size rows
     # algorithm
     adv_estimator: str = "grpo"           # grpo | gae | rloo | reinforce_plus_plus | remax
     gamma: float = 1.0
@@ -154,6 +161,11 @@ class StreamRLTrainer:
         self._max_local_gen_s: float | None = None
         if cfg.adv_estimator == "gae" and critic is None:
             raise ValueError("GAE requires a critic")
+        if cfg.use_remove_padding and critic is not None:
+            raise ValueError(
+                "use_remove_padding currently packs the ACTOR passes only; "
+                "critic training still consumes padded [B, Tr] micros — run "
+                "the critic without remove_padding")
         self._ckpt = (
             ckpt_lib.CheckpointManager(cfg.ckpt_dir, max_to_keep=cfg.max_ckpt_keep)
             if cfg.ckpt_dir
@@ -318,20 +330,29 @@ class StreamRLTrainer:
         with marked_timer("reward", metrics):
             reward_out = self.reward_manager(ibatch)
             metrics.update(reward_out.metrics)
-        feed = {k: ibatch[k] for k in
-                ("input_ids", "positions", "attention_mask", "responses", "response_mask")}
-        with marked_timer("old_log_prob", metrics):
-            old_lp, entropy = self.actor.compute_log_prob(feed)
-            ibatch.tensors["old_log_probs"] = np.asarray(old_lp)
-            metrics.update({"actor/entropy_rollout": float(
-                core_algos.masked_mean(entropy, ibatch["response_mask"]))})
-        if self.ref_policy is not None:
-            with marked_timer("ref_log_prob", metrics):
-                ibatch.tensors["ref_log_probs"] = np.asarray(
-                    self.ref_policy.compute_log_prob(feed))
+        if cfg.use_remove_padding:
+            self._packed_logprob_pass(ibatch, metrics)
+        else:
+            feed = {k: ibatch[k] for k in
+                    ("input_ids", "positions", "attention_mask", "responses",
+                     "response_mask")}
+            with marked_timer("old_log_prob", metrics):
+                old_lp, entropy = self.actor.compute_log_prob(feed)
+                ibatch.tensors["old_log_probs"] = np.asarray(old_lp)
+                metrics.update({"actor/entropy_rollout": float(
+                    core_algos.masked_mean(entropy, ibatch["response_mask"]))})
+            if self.ref_policy is not None:
+                with marked_timer("ref_log_prob", metrics):
+                    ibatch.tensors["ref_log_probs"] = np.asarray(
+                        self.ref_policy.compute_log_prob(feed))
         if self.critic is not None:
+            # critic stays on the padded layout (values are per-response-token
+            # [B, Tr]); remove_padding currently accelerates the actor passes
+            cfeed = {k: ibatch[k] for k in
+                     ("input_ids", "positions", "attention_mask", "responses",
+                      "response_mask")}
             with marked_timer("values", metrics):
-                ibatch.tensors["values"] = np.asarray(self.critic.compute_values(feed))
+                ibatch.tensors["values"] = np.asarray(self.critic.compute_values(cfeed))
 
         with marked_timer("adv", metrics):
             token_scores = reward_out.token_level_scores
@@ -362,11 +383,117 @@ class StreamRLTrainer:
                 adv, ret = core_algos.compute_gae_advantage_return(
                     token_rewards, ibatch["values"], ibatch["response_mask"],
                     cfg.gamma, cfg.lam)
+            elif est == "remax":
+                baselines = self._compute_remax_baselines(ibatch, metrics)
+                adv, ret = core_algos.compute_remax_outcome_advantage(
+                    token_rewards, baselines, ibatch["response_mask"])
             else:
                 raise NotImplementedError(est)
             ibatch.tensors["advantages"] = np.asarray(adv)
             ibatch.tensors["returns"] = np.asarray(ret)
         return ibatch
+
+    # -- packed-sequence (remove-padding) path ---------------------------
+
+    def _pack_geometry(self) -> tuple[int, int]:
+        cfg = self.cfg
+        pack_len = cfg.pack_len or (cfg.max_prompt_length + cfg.max_response_length)
+        if cfg.micro_token_budget > 0:
+            n_rows = max(1, cfg.micro_token_budget // pack_len)
+        else:
+            n_rows = cfg.micro_batch_size
+        return pack_len, n_rows
+
+    def _packed_logprob_pass(self, ibatch: TensorBatch,
+                             metrics: MetricsTracker) -> None:
+        """old/ref logprobs + entropy on the packed layout (the padded
+        forward wastes FLOPs on pads — reference use_remove_padding), then
+        gathered back to [B, Tr] for the (host-side) advantage math. The
+        packs are stashed on the ibatch and reused for the update micros."""
+        from polyrl_tpu.data import packing
+
+        cfg = self.cfg
+        pack_len, n_rows = self._pack_geometry()
+        packs = list(packing.iter_packed_micros(
+            ibatch, cfg.max_prompt_length, pack_len, n_rows,
+            self.rollout.pad_token_id))
+        ibatch.meta_info["packs"] = packs
+        b, tr = len(ibatch), cfg.max_response_length
+        old_lp = np.zeros((b, tr), np.float32)
+        ref_lp = np.zeros((b, tr), np.float32) if self.ref_policy is not None else None
+        ent_num = ent_den = 0.0
+        with marked_timer("old_log_prob", metrics):
+            for pack, spec in packs:
+                feed = {k: pack[k] for k in
+                        ("input_ids", "positions", "attention_mask",
+                         "segment_ids")}
+                lp, ent = self.actor.compute_log_prob_packed(feed)
+                spec.gather_into(np.asarray(lp), old_lp)
+                lm = np.asarray(pack["loss_mask"])
+                ent_num += float((np.asarray(ent) * lm).sum())
+                ent_den += float(lm.sum())
+        ibatch.tensors["old_log_probs"] = old_lp
+        metrics.update({"actor/entropy_rollout": ent_num / max(ent_den, 1.0)})
+        if ref_lp is not None:
+            with marked_timer("ref_log_prob", metrics):
+                for pack, spec in packs:
+                    feed = {k: pack[k] for k in
+                            ("input_ids", "positions", "attention_mask",
+                             "segment_ids")}
+                    spec.gather_into(
+                        np.asarray(self.ref_policy.compute_log_prob_packed(feed)),
+                        ref_lp)
+            ibatch.tensors["ref_log_probs"] = ref_lp
+
+    def _packed_micros(self, ibatch: TensorBatch):
+        """Yield (packed_feed, n_trajectories) update micros, scattering the
+        now-computed advantages/old/ref logprobs into each pack's layout."""
+        packs = ibatch.meta_info["packs"]
+        adv = np.asarray(ibatch["advantages"])
+        old = np.asarray(ibatch["old_log_probs"])
+        ref = (np.asarray(ibatch["ref_log_probs"])
+               if "ref_log_probs" in ibatch else None)
+        for pack, spec in packs:
+            feed = {k: pack[k] for k in
+                    ("input_ids", "positions", "attention_mask",
+                     "segment_ids", "loss_mask")}
+            feed["advantages"] = spec.scatter(adv)
+            feed["old_log_probs"] = spec.scatter(old)
+            if ref is not None:
+                feed["ref_log_probs"] = spec.scatter(ref)
+            yield feed, len(spec.orig_idx)
+
+    def _compute_remax_baselines(self, ibatch: TensorBatch,
+                                 metrics: MetricsTracker) -> np.ndarray:
+        """REMAX baseline (reference estimator enum stream_ray_trainer.py:50,
+        377,387): ONE greedy rollout per prompt group, scored with the same
+        reward manager; its score is the per-trajectory reward baseline."""
+        cfg = self.cfg
+        group_ids = np.asarray(ibatch["group_ids"])
+        tp = cfg.max_prompt_length
+        input_ids = np.asarray(ibatch["input_ids"])
+        attn = np.asarray(ibatch["attention_mask"])
+        gts, sources = ibatch["ground_truth"], ibatch["data_source"]
+        uniq, first_idx = np.unique(group_ids, return_index=True)
+        prompts = [input_ids[i, :tp][attn[i, :tp] > 0].tolist()
+                   for i in first_idx]
+        sampling = SamplingParams(
+            temperature=0.0, top_p=1.0, top_k=0,
+            max_new_tokens=cfg.max_response_length,
+            stop_token_ids=(self.tokenizer.eos_token_id,))
+        with marked_timer("remax_baseline", metrics):
+            outs = self._generate_all(prompts, sampling)
+            base_batch = self._assemble_batch(
+                prompts, [gts[i] for i in first_idx],
+                [sources[i] for i in first_idx], outs,
+                list(range(len(prompts))))
+            base_scores = self.reward_manager(base_batch).scores
+        metrics.update({"reward/remax_baseline_mean":
+                        float(np.mean(base_scores)) if len(base_scores) else 0.0})
+        # expand group-level baselines to trajectory level
+        group_to_score = {int(g): float(s) for g, s in zip(uniq, base_scores)}
+        return np.asarray([group_to_score[int(g)] for g in group_ids],
+                          np.float32)
 
     # -- validation (reference _validate, stream_ray_trainer.py:304-315) --
 
@@ -492,7 +619,6 @@ class StreamRLTrainer:
             # micro so dropped groups never strand accumulated grads
             # (reference cum-minibatch logic, stream_ray_trainer.py:500-568).
             msize = cfg.ppo_mini_batch_size
-            grad_steps_per_mini = msize // cfg.micro_batch_size
             state = {"processed": 0, "n_tokens": 0, "bubble": 0.0}
 
             def micro_stream():
@@ -509,24 +635,34 @@ class StreamRLTrainer:
                     ibatch = self._process_ibatch(ibatch, metrics)
                     state["n_tokens"] += int(
                         np.asarray(ibatch["attention_mask"]).sum())
-                    yield from ibatch.split(cfg.micro_batch_size)
+                    if cfg.use_remove_padding:
+                        yield from self._packed_micros(ibatch)
+                    else:
+                        for m in ibatch.split(cfg.micro_batch_size):
+                            yield m, len(m)
 
-            def train_micro(micro):
+            def train_micro(micro, n_traj):
                 # boundary-CROSSING, not exact multiples: ragged micro sizes
-                # (streaming path with adv estimators that allow
+                # (packed micros, or streaming with adv estimators that allow
                 # min_stream_batch_size % rollout_n != 0) may step over an
                 # exact multiple and must still trigger the opt step
                 prev = state["processed"]
-                state["processed"] += len(micro)
+                state["processed"] += n_traj
                 is_opt = state["processed"] // msize > prev // msize
-                feed = {k: micro[k] for k in (
-                    "input_ids", "positions", "attention_mask", "responses",
-                    "response_mask", "advantages", "old_log_probs")}
-                if "ref_log_probs" in micro:
-                    feed["ref_log_probs"] = micro["ref_log_probs"]
+                # loss scale = the micro's trajectory share of the minibatch
+                # (1/grad_steps for fixed micros; ragged micros still sum to
+                # 1 over a full minibatch — reference loss_scale_factor)
+                scale = n_traj / msize
+                if isinstance(micro, dict):  # packed feed, actor-ready
+                    feed = micro
+                else:
+                    feed = {k: micro[k] for k in (
+                        "input_ids", "positions", "attention_mask", "responses",
+                        "response_mask", "advantages", "old_log_probs")}
+                    if "ref_log_probs" in micro:
+                        feed["ref_log_probs"] = micro["ref_log_probs"]
                 with marked_timer("update_actor", metrics):
-                    m = self.actor.update_stream(
-                        feed, is_opt, loss_scale=1.0 / grad_steps_per_mini)
+                    m = self.actor.update_stream(feed, is_opt, loss_scale=scale)
                     metrics.update({k: float(v) for k, v in m.items()})
                 if self.critic is not None:
                     cfeed = {k: micro[k] for k in (
@@ -534,14 +670,14 @@ class StreamRLTrainer:
                         "response_mask", "returns", "values")}
                     with marked_timer("update_critic", metrics):
                         cm = self.critic.update_stream(
-                            cfeed, is_opt, loss_scale=1.0 / grad_steps_per_mini)
+                            cfeed, is_opt, loss_scale=scale)
                         metrics.update({k: float(v) for k, v in cm.items()})
 
             # micros train the moment they exist (never idle behind the
             # blocking ibatch wait); if a short batch (dropped groups) ends
             # mid-minibatch, flush the accumulated grads afterwards
-            for micro in micro_stream():
-                train_micro(micro)
+            for micro, n_traj in micro_stream():
+                train_micro(micro, n_traj)
             if state["processed"] % msize != 0 and state["processed"] > 0:
                 metrics.update({k: float(v) for k, v in
                                 self.actor.flush_opt_step().items()})

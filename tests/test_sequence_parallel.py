@@ -121,3 +121,43 @@ def test_ring_memory_is_blockwise(sp_mesh, rng):
     want = dense_reference(q, k, v, tmask)
     np.testing.assert_allclose(np.asarray(out), np.asarray(want),
                                rtol=2e-5, atol=2e-5)
+
+
+def test_ulysses_minimal_gqa_expansion():
+    """hkv % sp != 0 expands KV by the SMALLEST valid factor, not to hq:
+    hkv=2, hq=8, sp=4 needs only 2x (to 4 heads), keeping half the GQA win."""
+    from polyrl_tpu.parallel.sequence import _expand_kv_minimal
+
+    b, t, d = 2, 8, 4
+    k = jnp.ones((b, t, 2, d)); v = jnp.ones((b, t, 2, d))
+    k2, v2 = _expand_kv_minimal(k, v, hq=8, sp=4)
+    assert k2.shape[2] == 4 and v2.shape[2] == 4
+    # divisible: untouched
+    k8 = jnp.ones((b, t, 8, d))
+    k3, _ = _expand_kv_minimal(k8, k8, hq=8, sp=4)
+    assert k3 is k8
+
+
+def test_ring_never_expands_kv(sp_mesh, rng):
+    """Ring attention keeps rotating K/V blocks at hkv heads (heads never
+    move between ranks, so GQA needs no expansion): the collective-permute
+    operands in the lowered HLO must be hkv-head-shaped."""
+    q, k, v, tmask = make_qkv(rng, b=2, t=32, hq=8, hkv=2, d=16)
+    fn = make_ring_attention(sp_mesh)
+    spec = NamedSharding(sp_mesh, P(("dp", "fsdp"), "sp", None, None))
+    mspec = NamedSharding(sp_mesh, P(("dp", "fsdp"), "sp"))
+    args = (jax.device_put(q, spec), jax.device_put(k, spec),
+            jax.device_put(v, spec), jax.device_put(tmask, mspec))
+    txt = jax.jit(fn).lower(*args).as_text()
+    perm_lines = [ln for ln in txt.splitlines() if "collective_permute" in ln]
+    kv_perm_lines = [ln for ln in perm_lines if "x16x" in ln or "x16>" in ln]
+    assert kv_perm_lines, "expected K/V collective_permutes in the program"
+    for ln in kv_perm_lines:
+        # per-shard K/V block: b/2 x t/4 x hkv x d = 1x8x2x16, never 8 heads
+        assert "1x8x2x16" in ln, ln
+        assert "1x8x8x16" not in ln, ln
+    # and parity still holds
+    got = jax.jit(fn)(*args)
+    want = dense_reference(q, k, v, tmask)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
