@@ -5,6 +5,7 @@
 
 #include <cmath>
 #include <cstdint>
+#include <limits>
 #include <map>
 #include <memory>
 #include <sstream>
@@ -45,7 +46,9 @@ class Value {
   bool as_bool(bool dflt = false) const { return is_bool() ? bool_ : dflt; }
   double as_num(double dflt = 0) const { return is_num() ? num_ : dflt; }
   int64_t as_int(int64_t dflt = 0) const {
-    return is_num() ? static_cast<int64_t>(num_) : dflt;
+    // non-finite → dflt: casting NaN/Inf to int64 is UB, and the parser
+    // can legitimately produce such values from engine streams
+    return is_num() && std::isfinite(num_) ? static_cast<int64_t>(num_) : dflt;
   }
   const std::string& as_str() const {
     static const std::string empty;
@@ -91,8 +94,13 @@ class Value {
       case Type::Null: os << "null"; break;
       case Type::Bool: os << (bool_ ? "true" : "false"); break;
       case Type::Num: {
-        if (std::isfinite(num_) && num_ == std::floor(num_) &&
-            std::fabs(num_) < 9.0e15) {
+        if (std::isnan(num_)) {
+          // match Python's json: "nan"/"inf" from ostream would be
+          // unparseable on the trainer side, killing the whole stream
+          os << "NaN";
+        } else if (std::isinf(num_)) {
+          os << (num_ < 0 ? "-Infinity" : "Infinity");
+        } else if (num_ == std::floor(num_) && std::fabs(num_) < 9.0e15) {
           os << static_cast<int64_t>(num_);
         } else {
           std::ostringstream tmp;
@@ -303,7 +311,24 @@ class Parser {
 
   Value parse_number() {
     size_t start = i_;
-    if (peek() == '-') next();
+    bool neg = false;
+    if (peek() == '-') { neg = true; next(); }
+    // Python's json.dumps emits NaN/Infinity/-Infinity for non-finite
+    // floats (not valid JSON, but real engines under test have produced
+    // them) — parse the EXACT literals instead of throwing, so one bad
+    // float can't kill a whole stream. Anything else alphabetic is still a
+    // decode error (a plaintext body must not silently become Infinity).
+    if (peek() == 'N' || peek() == 'I') {
+      size_t lit_start = i_;
+      while (i_ < s_.size() && isalpha(s_[i_])) ++i_;
+      std::string lit = s_.substr(lit_start, i_ - lit_start);
+      if (lit == "NaN")
+        return Value(std::numeric_limits<double>::quiet_NaN());  // -NaN == NaN
+      if (lit == "Infinity")
+        return Value(neg ? -std::numeric_limits<double>::infinity()
+                         : std::numeric_limits<double>::infinity());
+      throw std::runtime_error("json: bad literal " + lit);
+    }
     while (i_ < s_.size() && (isdigit(s_[i_]) || s_[i_] == '.' || s_[i_] == 'e' ||
                               s_[i_] == 'E' || s_[i_] == '+' || s_[i_] == '-'))
       ++i_;

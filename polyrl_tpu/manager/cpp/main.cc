@@ -62,15 +62,37 @@ class Manager {
     for (int attempt = 0; attempt < cfg_.max_generate_attempts; ++attempt) {
       InstancePtr inst = state_.next_instance(want_local,
                                               cfg_.schedule_wait_timeout_ms);
-      if (!inst) return error_response(rid, "no instance available");
-      bool finished = stream_from_instance(inst, current, acc);
+      if (!inst) {
+        // Busy pool ≠ dead pool: while any healthy/pending instance exists
+        // the request requeues without burning a retry attempt (matching the
+        // reference's indefinitely-blocking scheduler, state.rs:84-147) —
+        // a transiently busy pool must never destroy training data. Only an
+        // actually empty pool (every instance evicted/unhealthy) fails.
+        if (!state_.is_shutdown() && state_.has_prospective_instances()) {
+          log_line("scheduler starved (pool busy), requeueing rid " + rid);
+          --attempt;
+          continue;
+        }
+        return error_response(rid, "no instance available");
+      }
+      // per-attempt rid suffix: engine-side request keys must be unique even
+      // when a retry races the dying previous attempt's cleanup (fresh
+      // Object: pjson copies alias the shared map)
+      pjson::Object req_obj = current.as_obj();
+      req_obj["rid"] = Value(rid + "#a" + std::to_string(attempt));
+      Value attempt_req(std::move(req_obj));
+      bool request_error = false;
+      bool finished = stream_from_instance(inst, attempt_req, acc, request_error);
       // assigned_batches is a RATE quota: incremented on assignment, zeroed
       // by the stats tick — never decremented (reference state.rs:84-147).
       state_.notify_available();
       if (finished) return build_final_response(rid, acc);
-      // failure: evict remote instances (shutdown+deregister), keep locals
-      // (they fail by abort during time-slicing, not by dying)
-      if (!inst->is_local) {
+      // Transport/decode failure: evict remote instances (shutdown +
+      // deregister), keep locals (they fail by abort during time-slicing,
+      // not by dying). A REQUEST-level engine error (finish_reason=error)
+      // retries without eviction — one bad request must not shut down up
+      // to max_generate_attempts healthy engines.
+      if (!inst->is_local && !request_error) {
         log_line("evicting instance " + inst->endpoint + " after stream failure");
         state_.deregister(inst->endpoint);
         std::string ep = inst->endpoint;
@@ -90,8 +112,10 @@ class Manager {
   }
 
   // Stream one attempt; true iff the instance reported finished.
+  // ``request_error`` is set when the ENGINE reported a request-level error
+  // (finish_reason=error) — the instance itself is healthy.
   bool stream_from_instance(const InstancePtr& inst, const Value& request,
-                            PartialResponse& acc) {
+                            PartialResponse& acc, bool& request_error) {
     std::string host;
     int port;
     if (!phttp::split_endpoint(inst->endpoint, host, port)) return false;
@@ -117,6 +141,14 @@ class Manager {
         merge_chunk(acc, chunk);
         acc.finished = false;  // abort = time-slice preemption → continue elsewhere
         acc.finish_reason.clear();
+        return false;
+      }
+      if (chunk["finish_reason"].as_str() == "error") {
+        // engine-reported failure (e.g. duplicate rid, prefill error): the
+        // attempt failed — retry on another instance. Treating it as a
+        // finished stream would return success with an empty completion
+        // and silently poison the training batch.
+        request_error = true;
         return false;
       }
       merge_chunk(acc, chunk);

@@ -128,6 +128,27 @@ class AppState {
     return active_.size();
   }
 
+  // True while the pool can plausibly recover WITHOUT trainer action: an
+  // instance is pending its health check, active-but-busy (quota/queue —
+  // frees up on the next stats tick), or a drained remote mid-weight-update
+  // (the sender poll loop re-admits it). Time-sliced-out LOCALS do NOT
+  // count: their only re-admission path is resume_local_instances() at the
+  // trainer's next stream, which cannot happen while this batch blocks —
+  // waiting on them would deadlock a local-only pool at the window expiry.
+  // Used by the scheduler to distinguish "busy, requeue" from "dead, fail"
+  // (the reference blocks indefinitely, state.rs:84-147, but its pool is
+  // remote-only).
+  bool has_prospective_instances() {
+    std::lock_guard<std::mutex> g(mu_);
+    if (!pending_.empty()) return true;
+    for (auto& [ep, inst] : instances_) {
+      if (!inst->healthy.load()) continue;
+      if (active_.count(ep)) return true;
+      if (!inst->is_local) return true;
+    }
+    return false;
+  }
+
   // -- scheduling (reference next_instance_with_type, state.rs:84-147) --
 
   // Block until an instance is available: quota not exhausted AND zero
@@ -173,10 +194,18 @@ class AppState {
 
   // New trainer weights exist: drain the active pool (remote instances must
   // re-bootstrap through the sender), keep/re-add local instances (they get
-  // weights in-process).
+  // weights in-process). With NO transfer fabric registered there is no
+  // sender poll loop to re-admit a drained remote (reference re-admission:
+  // sender_agent.py:324-340 → handlers.rs:681-795), so draining would
+  // strand it forever — keep the pool as-is and only record the bump;
+  // remotes serve stale weights until a fabric is attached.
   int64_t update_weight_version() {
     std::lock_guard<std::mutex> g(mu_);
     ++weight_version_;
+    if (weight_senders_.empty()) {
+      cv_.notify_all();
+      return weight_version_;
+    }
     std::set<std::string> next_active;
     for (auto& ep : active_) {
       auto it = instances_.find(ep);

@@ -562,7 +562,8 @@ class CBEngine:
         private = list(pages)
         if self.prefix_cache is not None:
             published = self.prefix_cache.publish(
-                req.input_ids, all_pages, n_cached=len(matched_pages))
+                req.input_ids, all_pages, n_cached=len(matched_pages),
+                matched_entries=matched_entries)
             pub_pages = {e.page for _, e in published}
             private = [p for p in pages if p not in pub_pages]
             matched_entries += [e for _, e in published]

@@ -79,22 +79,31 @@ class PrefixCache:
         return pages, entries
 
     def publish(self, tokens: list[int], page_ids: list[int],
-                n_cached: int) -> list[tuple[int, _Entry]]:
+                n_cached: int,
+                matched_entries: "list[_Entry] | None" = None
+                ) -> list[tuple[int, _Entry]]:
         """Register the freshly prefilled full pages ``page_ids[n_cached:]``
         (ownership moves to the cache; caller keeps a ref). Returns
         ``(prompt_page_index, entry)`` for each page actually published —
-        pages whose key already exists stay caller-owned."""
+        pages whose key already exists stay caller-owned.
+
+        ``matched_entries`` is the entry list the caller got from
+        ``match()`` — the chain the request was actually verified against.
+        Resolving the parent by key alone could chain children to a
+        REPLACED or colliding entry under that key, making them silently
+        unreachable (parent-identity check fails on every later match)."""
         n_full = max(0, (len(tokens) - 1) // self.page_size)
         keys = self._keys_for(tokens, n_full)
         out: list[tuple[int, _Entry]] = []
         self._tick += 1
-        prev: _Entry | None = (
-            self._map.get(keys[n_cached - 1]) if n_cached > 0 else None)
-        if n_cached > 0 and prev is None:
-            # matched parent vanished (should not happen under the pool
-            # lock); publishing children would break the verified chain
-            self.misses += max(0, n_full - n_cached)
-            return out
+        if n_cached > 0:
+            # resolving by key instead would chain children to whatever entry
+            # NOW sits under that key — possibly a replaced/colliding one
+            assert matched_entries and len(matched_entries) >= n_cached, \
+                "publish with n_cached > 0 requires the match() entry list"
+            prev: _Entry | None = matched_entries[n_cached - 1]
+        else:
+            prev = None
         for i in range(n_cached, n_full):
             key = keys[i]
             page_toks = tuple(

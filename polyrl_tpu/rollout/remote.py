@@ -23,6 +23,7 @@ agent, returning the new version.
 
 from __future__ import annotations
 
+import itertools
 import logging
 import queue
 import threading
@@ -52,6 +53,10 @@ class RemoteRollout:
         self.weight_version = 0
         self.last_gen_throughput = 0.0
         self.dropped_groups = 0
+        # per-stream nonce keeps rids globally unique: concurrent streams
+        # (nested REMAX baselines, validation overlapping training) would
+        # otherwise collide on bare indices at the shared engines
+        self._stream_seq = itertools.count()
 
     # -- streaming generation ------------------------------------------------
 
@@ -62,12 +67,19 @@ class RemoteRollout:
         group_size: int,
         min_emit: int,
         max_local_gen_s: float | None = None,
+        nested: bool = False,
     ) -> Iterator[list[tuple[int, GenerateResult]]]:
         """Yield lists of (original_index, result) covering whole groups,
         ≥ ``min_emit`` entries per yield (except the final remainder).
         Requests ``i*group_size .. (i+1)*group_size-1`` form group ``i``.
         ``min_emit`` need not divide by group_size — emission granularity is
-        whole groups, the threshold just gates when to flush."""
+        whole groups, the threshold just gates when to flush.
+
+        ``nested=True`` marks a stream issued while an OUTER stream is still
+        active (e.g. REMAX baselines mid-ibatch): it must not touch the
+        colocated engine's resume/release lifecycle — release_memory would
+        pause the local engine while the outer stream's requests are still
+        being served on it."""
         assert len(prompt_ids) % group_size == 0
         # colocated time-slicing: the local engine serves during the window
         # (manager aborts it after max_local_gen_s, handlers.rs:500-513
@@ -75,7 +87,7 @@ class RemoteRollout:
         # release at window expiry (grace for the abort to drain) or at
         # stream end, whichever first.
         local_eng = (self.local_server.engine
-                     if self.local_server is not None else None)
+                     if self.local_server is not None and not nested else None)
         released = threading.Event()
 
         def _release() -> None:
@@ -91,11 +103,21 @@ class RemoteRollout:
         if local_eng is not None:
             if hasattr(local_eng, "resume_memory"):
                 local_eng.resume_memory()
+            # re-admit time-sliced-out locals to the manager's active pool:
+            # the watchdog removed them at the last window expiry
+            # (handlers.rs:500-513), and engine resume + pool re-admission
+            # must travel together or the pool starves while the engine
+            # idles with restored KV HBM.
+            try:
+                self.manager.resume_local_instances()
+            except Exception:  # noqa: BLE001 — manager may not be up yet
+                log.exception("resume_local_instances failed")
             if max_local_gen_s:
                 window_timer = threading.Timer(max_local_gen_s + 1.0, _release)
                 window_timer.daemon = True
                 window_timer.start()
-        reqs = [{"rid": str(i), "input_ids": list(p),
+        stream_tag = f"s{next(self._stream_seq)}:"
+        reqs = [{"rid": f"{stream_tag}{i}", "input_ids": list(p),
                  "sampling_params": {
                      "temperature": sampling.temperature,
                      "top_p": sampling.top_p,
@@ -132,41 +154,47 @@ class RemoteRollout:
         groups: dict[int, list[tuple[int, GenerateResult]]] = {}
         failed_groups: set[int] = set()
         pending: list[tuple[int, GenerateResult]] = []
-        while True:
-            item = q.get()
-            if item is None:
-                break
-            if isinstance(item, Exception):
-                raise item
-            res: GenerateResult = item
-            idx = int(res.rid)
-            g = idx // group_size
-            if g in failed_groups:
-                continue
-            if not res.success:
-                log.warning("group %d dropped: request %d failed: %s",
-                            g, idx, res.error)
-                failed_groups.add(g)
-                groups.pop(g, None)
-                self.dropped_groups += 1
-                continue
-            n_tokens += len(res.output_token_ids)
-            groups.setdefault(g, []).append((idx, res))
-            if len(groups[g]) == group_size:
-                pending.extend(sorted(groups.pop(g)))
-                if len(pending) >= min_emit:
-                    yield pending
-                    pending = []
-        if groups:  # stream ended with incomplete groups (should not happen)
-            log.warning("%d groups incomplete at stream end", len(groups))
-            self.dropped_groups += len(groups)
-        elapsed = gen_end[0] - gen_t0
-        self.last_gen_throughput = n_tokens / elapsed if elapsed > 0 else 0.0
-        if window_timer is not None:
-            window_timer.cancel()
-        _release()  # stream done: nothing left to serve locally
-        if pending:
-            yield pending
+        # try/finally: if the consumer abandons the generator or the stream
+        # raises, the window timer must die and the colocated engine's KV
+        # pool must still be handed back to training — leaking either starves
+        # the trainer of HBM for the rest of the run.
+        try:
+            while True:
+                item = q.get()
+                if item is None:
+                    break
+                if isinstance(item, Exception):
+                    raise item
+                res: GenerateResult = item
+                idx = int(res.rid.rsplit(":", 1)[-1])
+                g = idx // group_size
+                if g in failed_groups:
+                    continue
+                if not res.success:
+                    log.warning("group %d dropped: request %d failed: %s",
+                                g, idx, res.error)
+                    failed_groups.add(g)
+                    groups.pop(g, None)
+                    self.dropped_groups += 1
+                    continue
+                n_tokens += len(res.output_token_ids)
+                groups.setdefault(g, []).append((idx, res))
+                if len(groups[g]) == group_size:
+                    pending.extend(sorted(groups.pop(g)))
+                    if len(pending) >= min_emit:
+                        yield pending
+                        pending = []
+            if groups:  # stream ended with incomplete groups (should not happen)
+                log.warning("%d groups incomplete at stream end", len(groups))
+                self.dropped_groups += len(groups)
+            elapsed = gen_end[0] - gen_t0
+            self.last_gen_throughput = n_tokens / elapsed if elapsed > 0 else 0.0
+            if pending:
+                yield pending
+        finally:
+            if window_timer is not None:
+                window_timer.cancel()
+            _release()  # stream done/abandoned: nothing left to serve locally
 
     # -- weight + metrics plane ----------------------------------------------
 

@@ -188,16 +188,26 @@ class RolloutServer:
                sp: SamplingParams) -> queue.Queue:
         out: queue.Queue = queue.Queue()
         abort = threading.Event()
-        with self._aborts_lock:
-            if rid in self._aborts:
-                # duplicate in-flight rid: reject — a second registration
-                # would orphan the first request's abort event
+        # Duplicate in-flight rid: usually a manager retry racing the dying
+        # first attempt (its handler thread drops the rid only after seeing
+        # BrokenPipe on the next write). Abort the stale entry and give it a
+        # short grace to clear before rejecting — a second registration
+        # sharing the rid would orphan the first request's abort event.
+        deadline = time.monotonic() + 2.0
+        while True:
+            with self._aborts_lock:
+                stale = self._aborts.get(rid)
+                if stale is None:
+                    self._aborts[rid] = abort
+                    break
+                stale.set()
+            if time.monotonic() >= deadline:
                 out.put({"token_ids": [], "logprobs": [], "finished": True,
                          "finish_reason": "error",
                          "error": f"duplicate rid {rid!r} in flight"})
                 out.put(_SENTINEL)
                 return out
-            self._aborts[rid] = abort
+            time.sleep(0.01)
         if self.cb:
             self.engine.submit(rid, input_ids, sp, out=out, abort=abort)
         else:

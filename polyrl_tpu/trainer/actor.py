@@ -48,6 +48,11 @@ class ActorConfig:
     # phase in colocated time-slicing (the reference's FSDP optimizer CPU
     # offload, stream_fsdp_workers.py:308-316,386-389)
     offload_optimizer: bool = False
+    # Skip (don't apply) optimizer updates containing non-finite values: a
+    # single poisoned minibatch (corrupt rollout data, overflowed loss) must
+    # degrade one step, not NaN the params and cascade NaN logits into every
+    # engine at the next weight sync. 0 disables the guard.
+    max_nonfinite_skips: int = 100
     ppo_epochs: int = 1                   # reference guards ppo_epochs==1 (stream_dp_actor.py:145)
     remat: bool = True
 
@@ -62,10 +67,13 @@ def make_optimizer(cfg: ActorConfig, total_steps: int = 0) -> optax.GradientTran
         sched = optax.linear_schedule(0.0, cfg.lr, cfg.lr_warmup_steps)
     else:
         sched = cfg.lr
-    return optax.chain(
+    opt = optax.chain(
         optax.clip_by_global_norm(cfg.max_grad_norm),
         optax.adamw(sched, b1=0.9, b2=0.999, eps=1e-8, weight_decay=cfg.weight_decay),
     )
+    if cfg.max_nonfinite_skips > 0:
+        opt = optax.apply_if_finite(opt, max_consecutive_errors=cfg.max_nonfinite_skips)
+    return opt
 
 
 def default_train_attention():
@@ -88,19 +96,39 @@ def _model_logprobs_entropy(params, model_cfg, input_ids, positions, attn_mask,
     # logits at position i predict token i+1: responses occupy the last
     # t_resp positions of input_ids, so their predictors are shifted one left.
     pred_logits = logits[:, -t_resp - 1 : -1, :]
-    logprobs = core_algos.logprobs_from_logits(pred_logits, responses)
-    entropy = core_algos.entropy_from_logits(pred_logits) if compute_entropy else None
+    # Finiteness contract: padded positions must come out 0, not NaN/-inf —
+    # downstream the PPO ratio is exp(lp - old_lp) and `inf * mask(=0)` is
+    # NaN, so masking at the consumer cannot recover. The where goes on the
+    # LOGITS, before logsumexp/take_along_axis (double-where pattern): a
+    # where on the logprob output alone zeroes the forward value but its
+    # VJP still computes 0 * softmax(NaN) = NaN, poisoning the shared
+    # weight gradients for the whole batch.
+    pred_logits = jnp.where(response_mask[..., None] > 0, pred_logits, 0.0)
+    logprobs = jnp.where(
+        response_mask > 0,
+        core_algos.logprobs_from_logits(pred_logits, responses), 0.0)
+    if compute_entropy:
+        entropy = jnp.where(response_mask > 0,
+                            core_algos.entropy_from_logits(pred_logits), 0.0)
+    else:
+        entropy = None
     return logprobs, entropy
 
 
 def _packed_logprobs_entropy(params, model_cfg, input_ids, positions,
-                             attn_mask, segment_ids, remat, compute_entropy):
+                             attn_mask, segment_ids, remat, compute_entropy,
+                             loss_mask=None):
     """Packed-row (remove-padding) variant: rows hold several trajectories
     separated by segment ids (reference use_remove_padding + flash varlen,
     stream_dp_actor.py:41-47). Returns per-COLUMN logprobs [R, L]: column t
     holds the logprob of input_ids[:, t] predicted from column t-1 — response
     tokens are selected by the caller's loss_mask (never at column 0, since a
-    segment always starts with >= 1 prompt token)."""
+    segment always starts with >= 1 prompt token).
+
+    ``loss_mask`` (optional, [R, L]) enables the same double-where finiteness
+    guard as the padded path: logits at columns outside the mask are zeroed
+    BEFORE the logprob computation so a NaN there (pack-padding columns)
+    cannot reach the forward value or the gradient."""
     from polyrl_tpu.ops import flash
 
     attn = lambda q, k, v, am: flash.flash_attention_train(  # noqa: E731
@@ -109,12 +137,18 @@ def _packed_logprobs_entropy(params, model_cfg, input_ids, positions,
                                 attn_mask, remat=remat, attn_fn=attn)
     pred = logits[:, :-1, :]
     targets = input_ids[:, 1:]
+    if loss_mask is not None:
+        pred = jnp.where(loss_mask[:, 1:, None] > 0, pred, 0.0)
     lp = core_algos.logprobs_from_logits(pred, targets)
     lp = jnp.pad(lp, ((0, 0), (1, 0)))
     if compute_entropy:
         ent = jnp.pad(core_algos.entropy_from_logits(pred), ((0, 0), (1, 0)))
     else:
         ent = None
+    if loss_mask is not None:
+        lp = jnp.where(loss_mask > 0, lp, 0.0)
+        if ent is not None:
+            ent = jnp.where(loss_mask > 0, ent, 0.0)
     return lp, ent
 
 
@@ -184,6 +218,7 @@ class StreamActor:
                 batch["input_ids"], batch["positions"],
                 batch["attention_mask"], batch["segment_ids"],
                 cfg.remat, cfg.entropy_coeff != 0.0,
+                loss_mask=batch["loss_mask"],
             )
             batch = dict(batch, response_mask=batch["loss_mask"])
         else:
@@ -235,6 +270,8 @@ class StreamActor:
                 params = optax.apply_updates(params, updates)
                 metrics = dict(metrics)
                 metrics["actor/grad_norm"] = optax.global_norm(accum_grads)
+                if hasattr(opt_state, "total_notfinite"):
+                    metrics["actor/nonfinite_skips"] = opt_state.total_notfinite
                 accum_grads = jax.tree_util.tree_map(jnp.zeros_like, accum_grads)
             return params, opt_state, accum_grads, loss, metrics
 
@@ -311,7 +348,7 @@ class StreamActor:
         return self._logprob_fns[key](
             params if params is not None else self.params, self.model_cfg,
             batch["input_ids"], batch["positions"], batch["attention_mask"],
-            batch["segment_ids"],
+            batch["segment_ids"], loss_mask=batch.get("loss_mask"),
         )
 
 
@@ -351,6 +388,6 @@ class ReferencePolicy:
         lp, _ = self._packed_fn(
             self.params, self.model_cfg,
             batch["input_ids"], batch["positions"], batch["attention_mask"],
-            batch["segment_ids"],
+            batch["segment_ids"], loss_mask=batch.get("loss_mask"),
         )
         return lp

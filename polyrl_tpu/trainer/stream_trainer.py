@@ -426,7 +426,7 @@ class StreamRLTrainer:
             for pack, spec in packs:
                 feed = {k: pack[k] for k in
                         ("input_ids", "positions", "attention_mask",
-                         "segment_ids")}
+                         "segment_ids", "loss_mask")}
                 lp, ent = self.actor.compute_log_prob_packed(feed)
                 spec.gather_into(np.asarray(lp), old_lp)
                 lm = np.asarray(pack["loss_mask"])
@@ -439,7 +439,7 @@ class StreamRLTrainer:
                 for pack, spec in packs:
                     feed = {k: pack[k] for k in
                             ("input_ids", "positions", "attention_mask",
-                             "segment_ids")}
+                             "segment_ids", "loss_mask")}
                     spec.gather_into(
                         np.asarray(self.ref_policy.compute_log_prob_packed(feed)),
                         ref_lp)
@@ -482,14 +482,34 @@ class StreamRLTrainer:
             max_new_tokens=cfg.max_response_length,
             stop_token_ids=(self.tokenizer.eos_token_id,))
         with marked_timer("remax_baseline", metrics):
-            outs = self._generate_all(prompts, sampling)
+            # nested: the outer generate_stream is still active — the
+            # baseline stream must not pause/release the colocated engine
+            outs, failed = self._generate_all(prompts, sampling, nested=True)
             base_batch = self._assemble_batch(
                 prompts, [gts[i] for i in first_idx],
                 [sources[i] for i in first_idx], outs,
                 list(range(len(prompts))))
-            base_scores = self.reward_manager(base_batch).scores
-        metrics.update({"reward/remax_baseline_mean":
-                        float(np.mean(base_scores)) if len(base_scores) else 0.0})
+            base_scores = np.asarray(self.reward_manager(base_batch).scores,
+                                     np.float32)
+        if failed:
+            # a greedy baseline hole would otherwise silently become
+            # "baseline 0", biasing every advantage in the group upward.
+            # Fall back to the group's sampled-reward mean (the RLOO-style
+            # estimator) for exactly those groups, and surface a metric.
+            log.warning("REMAX: %d/%d greedy baselines failed; substituting "
+                        "group sampled-reward means", len(failed), len(prompts))
+            traj_scores = np.asarray(
+                ibatch["token_level_rewards"].sum(-1)
+                if "token_level_rewards" in ibatch else
+                self.reward_manager(ibatch).scores, np.float32)
+            for fi in failed:
+                base_scores[fi] = float(
+                    np.mean(traj_scores[group_ids == uniq[fi]]))
+        metrics.update({
+            "reward/remax_baseline_mean":
+                float(np.mean(base_scores)) if len(base_scores) else 0.0,
+            "reward/remax_baseline_failed": float(len(failed)),
+        })
         # expand group-level baselines to trajectory level
         group_to_score = {int(g): float(s) for g, s in zip(uniq, base_scores)}
         return np.asarray([group_to_score[int(g)] for g in group_ids],
@@ -497,22 +517,29 @@ class StreamRLTrainer:
 
     # -- validation (reference _validate, stream_ray_trainer.py:304-315) --
 
-    def _generate_all(self, prompts: list[list[int]], sampling: SamplingParams):
+    def _generate_all(self, prompts: list[list[int]], sampling: SamplingParams,
+                      nested: bool = False):
         """Generate for every prompt with either rollout flavour; returns
-        outputs aligned with ``prompts``."""
+        ``(outputs, failed_indices)`` with outputs aligned with ``prompts``
+        (failed slots hold an empty output). ``nested`` marks a call made
+        while an outer generate_stream is active (REMAX baselines)."""
         if isinstance(self.rollout, RemoteRollout):
             outs: list = [None] * len(prompts)
             for chunk in self.rollout.generate_stream(
-                    prompts, sampling, group_size=1, min_emit=len(prompts)):
+                    prompts, sampling, group_size=1, min_emit=len(prompts),
+                    nested=nested):
                 for i, res in chunk:
                     outs[i] = _ResultView(res)
-            # dropped groups leave holes; substitute empty outputs
+            # dropped groups leave holes; substitute empty outputs and tell
+            # the caller WHICH — silently zero-scoring them would skew
+            # val means / REMAX baselines with no observable signal
+            failed = [i for i, o in enumerate(outs) if o is None]
             empty = type("E", (), {"output_ids": np.zeros(0, np.int32),
                                    "output_token_logprobs": np.zeros(0, np.float32)})
-            return [o if o is not None else empty for o in outs]
+            return [o if o is not None else empty for o in outs], failed
         outs = self.rollout.generate(prompts, sampling,
                                      rng=jax.random.PRNGKey(0))
-        return [o if hasattr(o, "output_ids") else _ResultView(o) for o in outs]
+        return [o if hasattr(o, "output_ids") else _ResultView(o) for o in outs], []
 
     def _validate(self) -> dict:
         """Greedy eval over the val dataset: per-data-source mean score +
@@ -527,18 +554,27 @@ class StreamRLTrainer:
         )
         per_source: dict[str, list[float]] = {}
         dump_rows: list[dict] = []
+        num_failed = 0
         bs = max(cfg.train_batch_size, 1)
         for lo in range(0, len(records), bs):
             chunk = records[lo : lo + bs]
             prompts = [self.tokenizer.encode(r["prompt"])[: cfg.max_prompt_length]
                        for r in chunk]
-            outs = self._generate_all(prompts, sampling)
+            outs, failed = self._generate_all(prompts, sampling)
+            num_failed += len(failed)
             gts = [r.get("ground_truth", "") for r in chunk]
             sources = [r.get("data_source", "") for r in chunk]
             batch = self._assemble_batch(prompts, gts, sources, outs,
                                          list(range(len(chunk))))
             reward_out = self.reward_manager(batch)
-            for src, sc in zip(sources, reward_out.scores):
+            failed_set = set(failed)
+            for i, (src, sc) in enumerate(zip(sources, reward_out.scores)):
+                # a failed generation is a HOLE, not a zero-score sample:
+                # excluding it keeps val/test_score comparable across steps
+                # with different failure counts (val/num_failed carries the
+                # signal instead)
+                if i in failed_set:
+                    continue
                 per_source.setdefault(src or "default", []).append(float(sc))
             if cfg.rollout_data_dir or cfg.val_generations_to_log:
                 texts = self.tokenizer.batch_decode(
@@ -555,6 +591,7 @@ class StreamRLTrainer:
         all_scores = [s for v in per_source.values() for s in v]
         metrics["val/test_score/mean"] = (
             float(np.mean(all_scores)) if all_scores else 0.0)
+        metrics["val/num_failed"] = float(num_failed)
         if cfg.rollout_data_dir and dump_rows:
             import json
             import os

@@ -199,3 +199,90 @@ def test_shutdown_instances(manager):
         assert eng.shutdown_called.wait(timeout=5)
     finally:
         eng.stop()
+
+
+def test_no_fabric_version_bump_keeps_remotes_serving(manager):
+    """Regression (round-2 stranded-remote bug): with NO weight senders
+    registered there is no re-admission path, so a bare version bump must
+    NOT drain remotes from the active pool — the next batch must still be
+    served. Reference semantics: drained instances always rejoin via the
+    sender poll loop (sender_agent.py:324-340 → handlers.rs:681-795)."""
+    eng = FakeEngine().start()
+    try:
+        manager.register_rollout_instance(eng.endpoint)
+        wait_active(manager, 1)
+        v1 = manager.update_weight_version()
+        v2 = manager.update_weight_version()
+        assert v2 == v1 + 1
+        # the remote must still serve immediately (pre-fix: pool drained
+        # forever, 120 s starvation then 'no instance available')
+        t0 = time.monotonic()
+        res = manager.generate("nf1", [1, 2], {"max_new_tokens": 3})
+        assert res.success, res.error
+        assert time.monotonic() - t0 < 10
+        # and batch streaming works too
+        reqs = [{"rid": f"nf-b{i}", "input_ids": [1],
+                 "sampling_params": {"max_new_tokens": 2}} for i in range(3)]
+        results = list(manager.batch_generate_stream(reqs, max_local_gen_s=30))
+        assert len(results) == 3 and all(r.success for r in results)
+    finally:
+        eng.stop()
+
+
+def test_busy_pool_requeues_instead_of_failing():
+    """A transiently busy pool (instance mid-weight-update) must requeue the
+    request, not destroy it (reference blocks on instances_available_notify,
+    state.rs:84-147). Uses a short schedule-wait timeout so the pre-fix
+    behavior would fail fast with 'no instance available'."""
+    proc, port = spawn_rollout_manager(
+        "127.0.0.1:0",
+        extra_args=["--health-check-interval-s", "0.1",
+                    "--stats-poll-interval-s", "0.2",
+                    "--schedule-wait-timeout-ms", "300"])
+    client = ManagerClient(f"127.0.0.1:{port}")
+    client.wait_healthy()
+    eng = FakeEngine().start()
+    try:
+        client.update_weight_senders(["127.0.0.1:19999"])
+        client.register_rollout_instance(eng.endpoint)
+        time.sleep(0.5)  # healthy, but NOT active (sender set, stale weights)
+        client.update_weight_version()
+        recv = client.get_receive_instances()  # claim like a sender would
+        assert [i["endpoint"] for i in recv["instances"]] == [eng.endpoint]
+
+        import threading
+        result = {}
+
+        def gen():
+            result["res"] = client.generate("bz1", [1], {"max_new_tokens": 2})
+
+        t = threading.Thread(target=gen, daemon=True)
+        t.start()
+        # request must outlive several schedule-wait timeouts while the
+        # instance is updating (pre-fix: fails after one 300 ms timeout)
+        time.sleep(1.5)
+        assert "res" not in result
+        # transfer completes → instance re-enters pool → request served
+        client.update_weights([eng.endpoint], weight_version=1)
+        t.join(timeout=10)
+        assert result["res"].success, result["res"].error
+    finally:
+        proc.kill()
+        eng.stop()
+
+
+def test_empty_pool_still_fails_fast():
+    """Counterpart to requeueing: a pool with NO healthy/pending instance at
+    all must fail the request after the schedule timeout, not hang."""
+    proc, port = spawn_rollout_manager(
+        "127.0.0.1:0",
+        extra_args=["--schedule-wait-timeout-ms", "300"])
+    client = ManagerClient(f"127.0.0.1:{port}")
+    client.wait_healthy()
+    try:
+        t0 = time.monotonic()
+        res = client.generate("ep1", [1], {"max_new_tokens": 2})
+        assert not res.success
+        assert time.monotonic() - t0 < 5
+    finally:
+        proc.kill()
