@@ -72,6 +72,20 @@ class RolloutSection:
 
 
 @dataclass
+class ParallelSection:
+    """Mesh axes for the trainer's GSPMD sharding (parallel/mesh.py). With
+    every axis 1 and a single process, no mesh is built (single-chip path).
+    Multi-host runs (jax.distributed via JAX_COORDINATOR_ADDRESS et al.)
+    always build the mesh over the global device set."""
+    dp: int = 1
+    fsdp: int = 1                         # -1 absorbs remaining devices
+    tp: int = 1
+    sp: int = 1
+    pp: int = 1                           # config surface only (mesh.py guard)
+    ep: int = 1                           # config surface only
+
+
+@dataclass
 class RewardSection:
     manager: str = "naive"
     custom_score_path: str = ""           # python file defining compute_score
@@ -90,6 +104,7 @@ class RunConfig:
     tokenizer: TokenizerSection = field(default_factory=TokenizerSection)
     data: DataSection = field(default_factory=DataSection)
     rollout: RolloutSection = field(default_factory=RolloutSection)
+    parallel: ParallelSection = field(default_factory=ParallelSection)
     reward: RewardSection = field(default_factory=RewardSection)
     trainer: TrainerConfig = field(default_factory=TrainerConfig)
     actor: ActorConfig = field(default_factory=ActorConfig)

@@ -21,6 +21,11 @@ struct Config {
   int schedule_wait_timeout_ms = 120000;  // block on instance availability
   int groups_per_sender = 4;
   double initial_local_gen_s = 150.0;
+  // bounded concurrency (reference: tokio runtime; round-1 finding):
+  // connection workers serve HTTP (streaming batches hold one each);
+  // generate workers bound concurrent per-request engine streams.
+  int http_workers = 64;
+  int generate_workers = 128;
   std::vector<std::string> allowed_sender_ips;  // CIDR filters (doc only v0)
 };
 
@@ -78,6 +83,8 @@ inline Config load_config(int argc, char** argv) {
     if (auto* v = get("schedule_wait_timeout_ms")) cfg.schedule_wait_timeout_ms = std::stoi(*v);
     if (auto* v = get("groups_per_sender")) cfg.groups_per_sender = std::stoi(*v);
     if (auto* v = get("initial_local_gen_s")) cfg.initial_local_gen_s = std::stod(*v);
+    if (auto* v = get("http_workers")) cfg.http_workers = std::stoi(*v);
+    if (auto* v = get("generate_workers")) cfg.generate_workers = std::stoi(*v);
   }
   // pass 2: CLI overrides
   for (int i = 1; i < argc - 1; ++i) {
@@ -93,6 +100,8 @@ inline Config load_config(int argc, char** argv) {
     else if (a == "--schedule-wait-timeout-ms") cfg.schedule_wait_timeout_ms = std::stoi(v);
     else if (a == "--groups-per-sender") cfg.groups_per_sender = std::stoi(v);
     else if (a == "--initial-local-gen-s") cfg.initial_local_gen_s = std::stod(v);
+    else if (a == "--http-workers") cfg.http_workers = std::stoi(v);
+    else if (a == "--generate-workers") cfg.generate_workers = std::stoi(v);
   }
   return cfg;
 }

@@ -11,6 +11,7 @@
 
 #include <signal.h>
 
+#include <algorithm>
 #include <atomic>
 #include <chrono>
 #include <condition_variable>
@@ -45,7 +46,8 @@ static void log_line(const std::string& msg) {
 class Manager {
  public:
   explicit Manager(Config cfg)
-      : cfg_(std::move(cfg)), state_(cfg_.max_assigned_batches_per_stats_check) {
+      : cfg_(std::move(cfg)), state_(cfg_.max_assigned_batches_per_stats_check),
+        gen_pool_(static_cast<size_t>(std::max(cfg_.generate_workers, 1))) {
     state_.balance.set_initial_gen_s(cfg_.initial_local_gen_s);
   }
 
@@ -179,21 +181,10 @@ class Manager {
     size_t remaining = requests.size();
     std::atomic<int64_t> total_resp_tokens{0};
 
-    std::vector<std::thread> workers;
-    workers.reserve(requests.size());
-    for (const auto& r : requests) {
-      workers.emplace_back([this, r, &mu, &cv, &ready, &remaining, &total_resp_tokens] {
-        Value resp = process_generate(r);
-        total_resp_tokens += resp["completion_tokens"].as_int();
-        std::lock_guard<std::mutex> g(mu);
-        ready.push_back(resp.dump() + "\n");
-        --remaining;
-        cv.notify_all();
-      });
-    }
-
     // time-slice watchdog: after the local window, pull local engines from
-    // the pool and abort their in-flight requests (handlers.rs:500-513)
+    // the pool and abort their in-flight requests (handlers.rs:500-513).
+    // Started BEFORE the submit loop — submit can block on gen-pool
+    // backpressure, and the window is promised from batch start.
     std::atomic<bool> batch_done{false};
     std::thread watchdog([this, max_local_gen_s, &batch_done] {
       double waited = 0;
@@ -203,13 +194,37 @@ class Manager {
       }
       if (batch_done.load()) return;
       auto locals = state_.remove_local_from_active();
-      double local_window = max_local_gen_s;
       for (auto& inst : locals) {
         log_line("time-slice: aborting local instance " + inst->endpoint +
-                 " after " + std::to_string(local_window) + "s");
+                 " after " + std::to_string(max_local_gen_s) + "s");
         phttp::request("POST", inst->endpoint, "/abort_request", "{\"abort_all\":true}", 2000);
       }
     });
+
+    // bounded request concurrency via the shared generate pool (round-1
+    // finding: thread-per-request was unbounded). submit() applies
+    // backpressure when the pool queue fills; results drain concurrently
+    // below, so a huge batch just streams through generate_workers at a
+    // time. Everything the task touches stays alive until remaining == 0,
+    // which the drain loop waits for before returning.
+    for (const auto& r : requests) {
+      bool ok = gen_pool_.submit(
+          [this, r, &mu, &cv, &ready, &remaining, &total_resp_tokens] {
+            Value resp = process_generate(r);
+            total_resp_tokens += resp["completion_tokens"].as_int();
+            std::lock_guard<std::mutex> g(mu);
+            ready.push_back(resp.dump() + "\n");
+            --remaining;
+            cv.notify_all();
+          });
+      if (!ok) {  // pool stopped (shutdown): account the request as failed
+        std::string rid = r["rid"].as_str();
+        std::lock_guard<std::mutex> g(mu);
+        ready.push_back(error_response(rid, "manager shutdown").dump() + "\n");
+        --remaining;
+        cv.notify_all();
+      }
+    }
 
     // drain results to the trainer as they finish
     {
@@ -227,7 +242,6 @@ class Manager {
     }
     batch_done = true;
     watchdog.join();
-    for (auto& w : workers) w.join();
 
     double total_s = std::chrono::duration<double>(
                          std::chrono::steady_clock::now() - t_start).count();
@@ -286,6 +300,7 @@ class Manager {
  private:
   Config cfg_;
   AppState state_;
+  phttp::WorkerPool gen_pool_;
   std::thread stats_thread_;
 };
 
@@ -491,7 +506,7 @@ int main(int argc, char** argv) {
   signal(SIGPIPE, SIG_IGN);
   manager::Config cfg = manager::load_config(argc, argv);
   manager::Manager mgr(cfg);
-  phttp::Server server;
+  phttp::Server server(static_cast<size_t>(std::max(cfg.http_workers, 1)));
   manager::register_routes(server, mgr);
 
   std::string host;

@@ -35,8 +35,29 @@ class MeshConfig:
     fsdp: int = -1  # -1: absorb remaining devices
     tp: int = 1
     sp: int = 1
+    # Pipeline / expert parallelism: config surface only, matching the
+    # reference's depth — it exposes infer_pp / expert-parallel knobs in its
+    # rollout config but never executes them either
+    # (workers/config/rollout.py:132-134,193-202). On TPU both would be
+    # mesh axes (pp: stage-sharded layer stack via shard_map+ppermute
+    # microbatching; ep: expert axis with all_to_all dispatch); neither is
+    # needed for the reference's supported model families, so use sites
+    # raise until an implementation lands.
+    pp: int = 1
+    ep: int = 1
 
     def resolve(self, n_devices: int) -> tuple[int, int, int, int]:
+        if self.pp != 1:
+            raise NotImplementedError(
+                "pipeline parallelism (pp) is config-surface only — the "
+                "reference exposes but does not execute infer_pp either "
+                "(workers/config/rollout.py:132-134); shard layers over "
+                "fsdp/tp instead")
+        if self.ep != 1:
+            raise NotImplementedError(
+                "expert parallelism (ep) is config-surface only — no MoE "
+                "model family is implemented (reference parity: expert "
+                "knobs stubbed at workers/config/rollout.py:193-202)")
         dims = [self.dp, self.fsdp, self.tp, self.sp]
         fixed = 1
         for d in dims:
@@ -82,6 +103,26 @@ REPLICATED = P()
 
 def sharding(mesh: Mesh, spec: P) -> NamedSharding:
     return NamedSharding(mesh, spec)
+
+
+def shard_params(mesh: Mesh, params, specs):
+    """device_put a param pytree with per-leaf specs from a matching (or
+    partially matching) spec tree: leaves without a spec (e.g. a critic's
+    value head absent from ``decoder.param_specs``) fall back to replicated.
+    The single shared implementation for actor/critic GSPMD placement."""
+
+    def put(path, x):
+        node = specs
+        try:
+            for k in path:
+                node = node[k.key]
+        except (KeyError, TypeError):
+            node = P()
+        if not isinstance(node, P):
+            node = P()
+        return jax.device_put(x, NamedSharding(mesh, node))
+
+    return jax.tree_util.tree_map_with_path(put, params)
 
 
 def shard_batch(mesh: Mesh, tree, spec: P = BATCH_SPEC):

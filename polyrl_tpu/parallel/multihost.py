@@ -1,0 +1,72 @@
+"""Multi-host SPMD helpers for the stream trainer.
+
+TPU-native replacement for the reference's Ray single-controller worker
+groups (``stream_fsdp_workers.py:262-546``): instead of a driver scattering
+work to N ranks, every host runs the SAME ``fit`` loop (SPMD), the jitted
+compute shards over one global mesh (GSPMD inserts the collectives), and the
+CONTROL plane — rollout-manager IO, reward scoring, the weight-transfer
+fabric, logging — runs on process 0 only, with the assembled batches
+broadcast to the other hosts over the jax.distributed client.
+
+The broadcast rides ``multihost_utils.broadcast_one_to_all`` (device
+collectives under the hood, so it works over ICI/DCN without a side
+channel). Payloads are pickled — batches are host-side numpy at this point
+in the pipeline, and control-plane payloads are small next to a generation
+phase.
+"""
+
+from __future__ import annotations
+
+import pickle
+from typing import Any
+
+import jax
+import numpy as np
+
+
+def process_count() -> int:
+    return jax.process_count()
+
+
+def is_main() -> bool:
+    return jax.process_index() == 0
+
+
+def broadcast_obj(obj: Any = None) -> Any:
+    """Broadcast an arbitrary picklable object from process 0 to all
+    processes. Non-0 processes pass anything (ignored). Two rounds: size,
+    then the padded payload (broadcast_one_to_all needs matching shapes)."""
+    from jax.experimental import multihost_utils as mhu
+
+    if process_count() == 1:
+        return obj
+    if is_main():
+        payload = np.frombuffer(pickle.dumps(obj), np.uint8)
+        size = np.int64(payload.size)
+    else:
+        payload = np.zeros(0, np.uint8)
+        size = np.int64(0)
+    size = int(mhu.broadcast_one_to_all(size))
+    buf = np.zeros(size, np.uint8)
+    if is_main():
+        buf[: payload.size] = payload
+    buf = np.asarray(mhu.broadcast_one_to_all(buf))
+    return pickle.loads(buf.tobytes())
+
+
+class NullRollout:
+    """Rollout placeholder for non-main processes in multi-host runs: the
+    control plane (manager streaming, weight push, balancer metrics) lives
+    on process 0; other hosts receive their batches via ``broadcast_obj``
+    and must never open their own manager/fabric connections."""
+
+    def __init__(self, pad_token_id: int = 0):
+        self.pad_token_id = pad_token_id
+        self.last_gen_throughput = 0.0
+        self.dropped_groups = 0
+
+    def update_weights(self, params: Any, version: int | None = None) -> int:
+        return 0
+
+    def update_metrics(self, **stats) -> dict:
+        return {}

@@ -145,10 +145,39 @@ def _build_rollout(cfg: RunConfig, mcfg, params, tokenizer, cleanup: list):
                          pad_token_id=pad)
 
 
+def _build_mesh(cfg: RunConfig):
+    """Build the global GSPMD mesh when parallelism is configured or the run
+    is multi-process (jax.distributed). Returns None single-chip — the
+    actor then skips sharding entirely."""
+    import jax
+
+    from polyrl_tpu.parallel import distributed
+    from polyrl_tpu.parallel import mesh as meshlib
+
+    p = cfg.parallel
+    axes = (p.dp, p.fsdp, p.tp, p.sp)
+    if jax.process_count() == 1 and all(a == 1 for a in axes):
+        return None
+    fsdp = p.fsdp
+    if all(a == 1 for a in axes):
+        # multi-process with no axes configured: absorb the global device
+        # count into fsdp (MeshConfig's own default) so a plain multi-host
+        # launch works without hand-set parallel: overrides
+        fsdp = -1
+    mcfg = meshlib.MeshConfig(dp=p.dp, fsdp=fsdp, tp=p.tp, sp=p.sp,
+                              pp=p.pp, ep=p.ep)
+    mesh = distributed.make_hybrid_mesh(config=mcfg)
+    log.info("mesh: %s over %d devices (%d processes)",
+             dict(zip(mesh.axis_names, mesh.devices.shape)),
+             jax.device_count(), jax.process_count())
+    return mesh
+
+
 def build_trainer(cfg: RunConfig, cleanup: list | None = None):
     """Assemble the full trainer from a RunConfig. ``cleanup`` collects
     teardown callables (spawned manager, fabric threads)."""
     from polyrl_tpu.data.dataset import PromptDataLoader
+    from polyrl_tpu.parallel import multihost
     from polyrl_tpu.rewards.manager import load_reward_manager
     from polyrl_tpu.trainer.actor import ReferencePolicy, StreamActor
     from polyrl_tpu.trainer.critic import StreamCritic, init_critic_params
@@ -157,8 +186,14 @@ def build_trainer(cfg: RunConfig, cleanup: list | None = None):
 
     cleanup = [] if cleanup is None else cleanup
     tokenizer = build_tokenizer(cfg)
+    mesh = _build_mesh(cfg)
     mcfg, params = _build_model(cfg)
-    rollout = _build_rollout(cfg, mcfg, params, tokenizer, cleanup)
+    if multihost.is_main():
+        rollout = _build_rollout(cfg, mcfg, params, tokenizer, cleanup)
+    else:
+        # non-main hosts never open manager/fabric connections — batches
+        # arrive via the trainer's broadcast plane (parallel/multihost.py)
+        rollout = multihost.NullRollout(pad_token_id=tokenizer.pad_token_id)
 
     compute_score = (load_custom_score(cfg.reward.custom_score_path)
                      if cfg.reward.custom_score_path else None)
@@ -170,13 +205,13 @@ def build_trainer(cfg: RunConfig, cleanup: list | None = None):
     loader = PromptDataLoader(dataset, cfg.trainer.train_batch_size,
                               shuffle=cfg.data.shuffle, seed=cfg.data.seed)
 
-    actor = StreamActor(mcfg, cfg.actor, params)
+    actor = StreamActor(mcfg, cfg.actor, params, mesh=mesh)
     critic = None
     if cfg.trainer.adv_estimator == "gae":
         import jax
 
         critic = StreamCritic(mcfg, cfg.critic, init_critic_params(
-            jax.random.PRNGKey(cfg.trainer.seed + 1), mcfg))
+            jax.random.PRNGKey(cfg.trainer.seed + 1), mcfg), mesh=mesh)
     ref_policy = (ReferencePolicy(mcfg, params)
                   if (cfg.trainer.use_kl_in_reward or cfg.actor.use_kl_loss)
                   else None)
@@ -204,6 +239,11 @@ def main(argv: list[str] | None = None) -> int:
     logging.basicConfig(
         level=logging.INFO,
         format="%(asctime)s %(name)s %(levelname)s %(message)s")
+    # multi-host bring-up first (no-op single-process): jax.distributed from
+    # the standard env vars, before any backend use (parallel/distributed.py)
+    from polyrl_tpu.parallel import distributed
+
+    distributed.initialize()
     cfg = load_config(args.config, args.overrides)
     if args.print_config:
         import yaml

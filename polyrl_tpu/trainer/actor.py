@@ -167,6 +167,16 @@ class StreamActor:
         self.cfg = cfg
         self.mesh = mesh
         self.attn_fn = attn_fn if attn_fn is not None else default_train_attention()
+        if mesh is not None:
+            # GSPMD entry: params shard over (fsdp, tp) per decoder.param_specs
+            # and every feed shards over the batch spec (see update_stream);
+            # grads/opt state inherit the layout through jit propagation.
+            # Works identically for single-host multi-chip and jax.distributed
+            # multi-host (the mesh just spans more processes).
+            from polyrl_tpu.parallel import mesh as meshlib
+
+            params = meshlib.shard_params(mesh, params,
+                                          decoder.param_specs(model_cfg))
         self.params = params
         self.optimizer = make_optimizer(cfg)
         self.opt_state = self.optimizer.init(params)
@@ -277,10 +287,21 @@ class StreamActor:
 
         return jax.jit(update, donate_argnums=(0, 1, 2))
 
+    def _shard_feed(self, batch: dict) -> dict:
+        """Batch-shard a host-side feed over the mesh (no-op without one).
+        Each process supplies the FULL array; device_put slices the local
+        shards — the jax multi-host data path (per-host data sharding)."""
+        if self.mesh is None:
+            return batch
+        from polyrl_tpu.parallel import mesh as meshlib
+
+        return meshlib.shard_batch(self.mesh, batch)
+
     def update_stream(self, batch: dict, is_opt_step: bool, loss_scale: float = 1.0) -> dict:
         """One sub-minibatch fwd/bwd (+opt step at boundary). ``batch`` is a
         dict of arrays: input_ids, positions, attention_mask, responses,
         response_mask, advantages, old_log_probs [, ref_log_probs]."""
+        batch = self._shard_feed(batch)
         self.load_opt_state()
         if is_opt_step not in self._update_fns:
             self._update_fns[is_opt_step] = self._build_update(is_opt_step)
@@ -321,6 +342,7 @@ class StreamActor:
 
     def compute_log_prob(self, batch: dict, compute_entropy: bool = True):
         """Old-logprob pass (no grad). Returns (logprobs, entropy|None)."""
+        batch = self._shard_feed(batch)
         if compute_entropy not in self._logprob_fns:
             self._logprob_fns[compute_entropy] = jax.jit(
                 partial(_model_logprobs_entropy, remat=False,
@@ -338,6 +360,7 @@ class StreamActor:
                                 params=None):
         """Packed-row logprob pass: [R, L] per-column logprobs aligned so
         loss_mask selects response tokens (see _packed_logprobs_entropy)."""
+        batch = self._shard_feed(batch)
         key = ("packed", compute_entropy)
         if key not in self._logprob_fns:
             self._logprob_fns[key] = jax.jit(

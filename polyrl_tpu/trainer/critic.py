@@ -73,7 +73,15 @@ class StreamCritic:
 
         self.model_cfg = model_cfg
         self.cfg = cfg
+        self.mesh = mesh
         self.attn_fn = attn_fn if attn_fn is not None else default_train_attention()
+        if mesh is not None:
+            # backbone leaves follow decoder.param_specs; critic-only leaves
+            # (the [D, 1] value head) fall back to replicated
+            from polyrl_tpu.parallel import mesh as meshlib
+
+            params = meshlib.shard_params(mesh, params,
+                                          decoder.param_specs(model_cfg))
         self.params = params
         self.optimizer = optax.chain(
             optax.clip_by_global_norm(cfg.max_grad_norm),
@@ -116,7 +124,15 @@ class StreamCritic:
 
         return jax.jit(update, donate_argnums=(0, 1, 2))
 
+    def _shard_feed(self, batch: dict) -> dict:
+        if self.mesh is None:
+            return batch
+        from polyrl_tpu.parallel import mesh as meshlib
+
+        return meshlib.shard_batch(self.mesh, batch)
+
     def update_stream(self, batch: dict, is_opt_step: bool, loss_scale: float = 1.0) -> dict:
+        batch = self._shard_feed(batch)
         if is_opt_step not in self._update_fns:
             self._update_fns[is_opt_step] = self._build_update(is_opt_step)
         self.params, self.opt_state, self.accum_grads, _, metrics = self._update_fns[is_opt_step](
@@ -150,6 +166,7 @@ class StreamCritic:
         return {"critic/grad_norm": gn}
 
     def compute_values(self, batch: dict) -> jnp.ndarray:
+        batch = self._shard_feed(batch)
         if self._value_fn is None:
             self._value_fn = jax.jit(
                 lambda p, b: forward_values(

@@ -156,6 +156,15 @@ class StreamRLTrainer:
         self.logger = logger
         self.val_dataset = val_dataset
         self.global_step = 0
+        # multi-host SPMD: every process runs this same fit loop; process 0
+        # owns the control plane (manager streaming, reward scoring, weight
+        # fabric, logging) and broadcasts batches/scores to the others
+        # (parallel/multihost.py; reference worker-group scatter,
+        # stream_fsdp_workers.py:262-546)
+        from polyrl_tpu.parallel import multihost
+        self._mh = multihost
+        self._is_main = multihost.is_main()
+        self._multi = multihost.process_count() > 1
         # local-generation budget from the manager's balancer (None until the
         # first update_metrics round trip; manager default applies)
         self._max_local_gen_s: float | None = None
@@ -295,7 +304,41 @@ class StreamRLTrainer:
 
     def _ibatch_iter(self, records: list[dict], rng, metrics: MetricsTracker):
         """Yield TensorBatch ibatches. Colocated: generate all, slice.
-        Remote: stream group-complete chunks while generation continues."""
+        Remote: stream group-complete chunks while generation continues.
+        Multi-host: process 0 streams from the manager and broadcasts each
+        ibatch; the other hosts replay the broadcast (their jitted updates
+        then shard the same global batch over the mesh)."""
+        cfg = self.cfg
+        if self._multi:
+            if self._is_main:
+                # error sentinel: if the control plane raises mid-stream the
+                # other hosts must be released from their blocking collective
+                # (they'd otherwise hang in broadcast_one_to_all forever)
+                it = self._ibatch_iter_local(records, rng, metrics)
+                while True:
+                    try:
+                        ib = next(it)
+                    except StopIteration:
+                        self._mh.broadcast_obj(("end", None))
+                        return
+                    except Exception as exc:
+                        self._mh.broadcast_obj(("error", repr(exc)))
+                        raise
+                    self._mh.broadcast_obj(("batch", ib))
+                    yield ib
+            else:
+                while True:
+                    kind, ib = self._mh.broadcast_obj(None)
+                    if kind == "end":
+                        return
+                    if kind == "error":
+                        raise RuntimeError(f"main-process rollout failed: {ib}")
+                    yield ib
+            return
+        yield from self._ibatch_iter_local(records, rng, metrics)
+
+    def _ibatch_iter_local(self, records: list[dict], rng,
+                           metrics: MetricsTracker):
         cfg = self.cfg
         prompts, gts, sources = self._prepare_prompts(records)
         if isinstance(self.rollout, RemoteRollout):
@@ -321,6 +364,32 @@ class StreamRLTrainer:
             batch = self._assemble_batch(prompts, gts, sources, outs, group_ids)
             yield from batch.split(cfg.min_stream_batch_size)
 
+    def _push_weights(self) -> None:
+        """Push actor weights to the rollout plane. The push itself is
+        control-plane (process 0 / no-op NullRollout elsewhere), but
+        GATHERING cross-host-sharded params is collective — every host
+        allgathers to host numpy first, or pack_params on process 0 would
+        raise on non-addressable shards."""
+        params = self.actor.params
+        if self._multi:
+            from jax.experimental import multihost_utils as mhu
+
+            params = jax.tree_util.tree_map(
+                lambda x: np.asarray(mhu.process_allgather(x, tiled=True)),
+                params)
+        self.rollout.update_weights(params)
+
+    def _to_host(self, x) -> np.ndarray:
+        """jit output → host numpy. Multi-host: jitted outputs are GLOBAL
+        arrays whose shards live on other processes; np.asarray would raise
+        (non-addressable) — allgather the global value instead. The host-side
+        advantage math then runs identically on every process."""
+        if self._multi:
+            from jax.experimental import multihost_utils as mhu
+
+            return np.asarray(mhu.process_allgather(x, tiled=True))
+        return np.asarray(x)
+
     # -- per-ibatch pipeline ---------------------------------------------
 
     def _process_ibatch(self, ibatch: TensorBatch, metrics: MetricsTracker) -> TensorBatch:
@@ -328,8 +397,27 @@ class StreamRLTrainer:
         stream_ray_trainer.py:406-498)."""
         cfg = self.cfg
         with marked_timer("reward", metrics):
-            reward_out = self.reward_manager(ibatch)
-            metrics.update(reward_out.metrics)
+            # reward scoring is control-plane work (python scorers, possibly
+            # remote reward endpoints): process 0 only, scores broadcast.
+            # Errors broadcast too so non-main hosts fail fast instead of
+            # hanging in the collective.
+            err: Exception | None = None
+            payload = None
+            if self._is_main:
+                try:
+                    reward_out = self.reward_manager(ibatch)
+                    payload = ("ok", (reward_out.token_level_scores,
+                                      reward_out.metrics))
+                except Exception as exc:  # noqa: BLE001 — re-raised below
+                    err = exc
+                    payload = ("error", repr(exc))
+            if self._multi:
+                payload = self._mh.broadcast_obj(payload)
+            if payload[0] == "error":
+                raise err if err is not None else RuntimeError(
+                    f"main-process reward failed: {payload[1]}")
+            token_level_scores, reward_metrics = payload[1]
+            metrics.update(reward_metrics)
         if cfg.use_remove_padding:
             self._packed_logprob_pass(ibatch, metrics)
         else:
@@ -338,12 +426,13 @@ class StreamRLTrainer:
                      "response_mask")}
             with marked_timer("old_log_prob", metrics):
                 old_lp, entropy = self.actor.compute_log_prob(feed)
-                ibatch.tensors["old_log_probs"] = np.asarray(old_lp)
+                ibatch.tensors["old_log_probs"] = self._to_host(old_lp)
                 metrics.update({"actor/entropy_rollout": float(
-                    core_algos.masked_mean(entropy, ibatch["response_mask"]))})
+                    core_algos.masked_mean(self._to_host(entropy),
+                                           ibatch["response_mask"]))})
             if self.ref_policy is not None:
                 with marked_timer("ref_log_prob", metrics):
-                    ibatch.tensors["ref_log_probs"] = np.asarray(
+                    ibatch.tensors["ref_log_probs"] = self._to_host(
                         self.ref_policy.compute_log_prob(feed))
         if self.critic is not None:
             # critic stays on the padded layout (values are per-response-token
@@ -352,10 +441,11 @@ class StreamRLTrainer:
                      ("input_ids", "positions", "attention_mask", "responses",
                       "response_mask")}
             with marked_timer("values", metrics):
-                ibatch.tensors["values"] = np.asarray(self.critic.compute_values(cfeed))
+                ibatch.tensors["values"] = self._to_host(
+                    self.critic.compute_values(cfeed))
 
         with marked_timer("adv", metrics):
-            token_scores = reward_out.token_level_scores
+            token_scores = token_level_scores
             if cfg.use_kl_in_reward and "ref_log_probs" in ibatch:
                 token_rewards, kl_mean = core_algos.apply_kl_penalty(
                     token_scores, ibatch["old_log_probs"], ibatch["ref_log_probs"],
@@ -384,7 +474,12 @@ class StreamRLTrainer:
                     token_rewards, ibatch["values"], ibatch["response_mask"],
                     cfg.gamma, cfg.lam)
             elif est == "remax":
-                baselines = self._compute_remax_baselines(ibatch, metrics)
+                # baseline generation + scoring is control-plane (manager
+                # stream + reward manager): process 0 computes, broadcasts
+                baselines = (self._compute_remax_baselines(ibatch, metrics)
+                             if self._is_main else None)
+                if self._multi:
+                    baselines = self._mh.broadcast_obj(baselines)
                 adv, ret = core_algos.compute_remax_outcome_advantage(
                     token_rewards, baselines, ibatch["response_mask"])
             else:
@@ -428,9 +523,9 @@ class StreamRLTrainer:
                         ("input_ids", "positions", "attention_mask",
                          "segment_ids", "loss_mask")}
                 lp, ent = self.actor.compute_log_prob_packed(feed)
-                spec.gather_into(np.asarray(lp), old_lp)
+                spec.gather_into(self._to_host(lp), old_lp)
                 lm = np.asarray(pack["loss_mask"])
-                ent_num += float((np.asarray(ent) * lm).sum())
+                ent_num += float((self._to_host(ent) * lm).sum())
                 ent_den += float(lm.sum())
         ibatch.tensors["old_log_probs"] = old_lp
         metrics.update({"actor/entropy_rollout": ent_num / max(ent_den, 1.0)})
@@ -441,7 +536,8 @@ class StreamRLTrainer:
                             ("input_ids", "positions", "attention_mask",
                              "segment_ids", "loss_mask")}
                     spec.gather_into(
-                        np.asarray(self.ref_policy.compute_log_prob_packed(feed)),
+                        self._to_host(
+                            self.ref_policy.compute_log_prob_packed(feed)),
                         ref_lp)
             ibatch.tensors["ref_log_probs"] = ref_lp
 
@@ -611,7 +707,7 @@ class StreamRLTrainer:
 
     def _maybe_validate(self, metrics: MetricsTracker, *, force: bool = False) -> None:
         cfg = self.cfg
-        if self.val_dataset is None:
+        if self.val_dataset is None or not self._is_main:
             return
         due = force or (cfg.test_freq > 0 and self.global_step > 0
                         and self.global_step % cfg.test_freq == 0)
@@ -632,7 +728,7 @@ class StreamRLTrainer:
             self.logger.log({"training/resumed_from_step": self.global_step},
                             step=self.global_step)
         # bootstrap weights into the rollout engine (reference fit :340)
-        self.rollout.update_weights(self.actor.params)
+        self._push_weights()
         if cfg.val_before_train and self.val_dataset is not None:
             pre = MetricsTracker()
             self._maybe_validate(pre, force=True)
@@ -723,7 +819,7 @@ class StreamRLTrainer:
                                     self.critic.flush_opt_step().items()})
 
             with marked_timer("update_weight", metrics):
-                self.rollout.update_weights(self.actor.params)
+                self._push_weights()
             # free optimizer HBM for the generation phase (colocated
             # time-slicing; no-op unless actor.cfg.offload_optimizer)
             self.actor.offload_opt_state()
@@ -765,7 +861,7 @@ class StreamRLTrainer:
                     self._save_checkpoint()
             record = metrics.as_dict()
             history.append(record)
-            if self.logger is not None:
+            if self.logger is not None and self._is_main:
                 self.logger.log(record, step=self.global_step)
         self._profile_gate(-1)  # close any open trace
         if self._ckpt is not None:

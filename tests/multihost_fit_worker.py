@@ -1,0 +1,101 @@
+"""Worker for the 2-process jax.distributed CPU test (launched by
+tests/test_multihost.py): one fit step of the stream trainer with the
+process-0 control plane + broadcast data plane + dp=2 mesh sharding.
+
+argv: coordinator_port process_id manager_port_file
+"""
+
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    coord_port, pid = sys.argv[1], int(sys.argv[2])
+
+    import jax
+
+    jax.distributed.initialize(f"127.0.0.1:{coord_port}", num_processes=2,
+                               process_id=pid)
+    import jax.numpy as jnp
+    import numpy as np
+
+    from polyrl_tpu.data.dataset import PromptDataLoader, make_arithmetic_dataset
+    from polyrl_tpu.models import decoder
+    from polyrl_tpu.parallel import mesh as meshlib
+    from polyrl_tpu.parallel import multihost
+    from polyrl_tpu.rewards.manager import load_reward_manager
+    from polyrl_tpu.trainer.actor import ActorConfig, StreamActor
+    from polyrl_tpu.trainer.stream_trainer import StreamRLTrainer, TrainerConfig
+    from polyrl_tpu.utils.tokenizer import ByteTokenizer
+
+    assert jax.process_count() == 2, jax.process_count()
+    assert jax.device_count() == 2, jax.device_count()
+
+    # dp=2 over the two hosts' devices: per-host data sharding — each
+    # process computes its half of every batch, GSPMD inserts the psums
+    mesh = meshlib.make_mesh(meshlib.MeshConfig(dp=2, fsdp=1, tp=1, sp=1))
+    cfg = decoder.get_config("tiny", dtype=jnp.float32)
+    params = decoder.init_params(jax.random.PRNGKey(0), cfg)
+    tok = ByteTokenizer()
+    actor = StreamActor(cfg, ActorConfig(lr=1e-4, remat=False), params,
+                        mesh=mesh)
+
+    if multihost.is_main():
+        # control plane lives here only: manager + fake instance + adapter
+        from polyrl_tpu.manager.client import ManagerClient, spawn_rollout_manager
+        from polyrl_tpu.rollout.remote import RemoteRollout
+        from tests.fake_engine import FakeEngine
+
+        eng = FakeEngine(start_token=100).start()  # in-vocab tokens
+        proc, mport = spawn_rollout_manager(
+            "127.0.0.1:0",
+            extra_args=["--health-check-interval-s", "0.1",
+                        "--stats-poll-interval-s", "0.2"])
+        mgr = ManagerClient(f"127.0.0.1:{mport}")
+        mgr.wait_healthy()
+        mgr.register_rollout_instance(eng.endpoint)
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < 15:
+            st = mgr.get_instances_status()
+            if any(i["healthy"] for i in st["instances"]):
+                break
+            time.sleep(0.1)
+        rollout = RemoteRollout(mgr, pad_token_id=tok.pad_token_id)
+    else:
+        rollout = multihost.NullRollout(pad_token_id=tok.pad_token_id)
+
+    tcfg = TrainerConfig(
+        train_batch_size=4, rollout_n=2, ppo_mini_batch_size=8,
+        micro_batch_size=8, min_stream_batch_size=8,
+        max_prompt_length=16, max_response_length=8,
+        adv_estimator="grpo", total_steps=1, temperature=1.0)
+    trainer = StreamRLTrainer(
+        tcfg, actor, rollout, tok,
+        load_reward_manager("naive", tok, num_workers=1),
+        PromptDataLoader(make_arithmetic_dataset(8), 4))
+    history = trainer.fit()
+    assert len(history) == 1, history
+    assert trainer.global_step == 1
+
+    # params must be bit-identical across hosts after the sharded update
+    from jax.experimental import multihost_utils as mhu
+
+    leaf_sum = float(sum(float(jnp.sum(jnp.abs(x)))
+                         for x in jax.tree_util.tree_leaves(actor.params)))
+    sums = np.asarray(mhu.process_allgather(np.float64(leaf_sum)))
+    assert np.allclose(sums, sums[0]), sums
+    assert np.isfinite(sums).all(), sums
+
+    if multihost.is_main():
+        proc.kill()
+        eng.stop()
+    print(f"MULTIHOST_OK pid={pid} param_sum={leaf_sum:.6f}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -61,3 +61,20 @@ def test_recipe_yaml_loads():
     assert cfg.trainer.min_stream_batch_size == 16
     assert cfg.trainer.rollout_n == 8
     assert cfg.trainer.max_response_length == 14336
+    # the round-2 features must actually be ON in the flagship recipe
+    # (reference trains varlen-packed with a dynamic token budget,
+    # run_async_grpo_pipeline.sh:29)
+    assert cfg.trainer.use_remove_padding is True
+    assert cfg.trainer.micro_token_budget == 16384
+
+
+def test_hybrid_recipe_yaml_loads():
+    from polyrl_tpu import config as cfg_lib
+
+    cfg = cfg_lib.load_config(
+        "examples/configs/stream_grpo_qwen3_1p7b_hybrid.yaml")
+    assert cfg.rollout.colocated_local is True
+    assert cfg.rollout.mode == "disaggregated"
+    assert cfg.trainer.use_remove_padding is True
+    assert cfg.actor.offload_optimizer is True
+    assert "--initial-local-gen-s" in cfg.rollout.manager_args

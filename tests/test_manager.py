@@ -286,3 +286,28 @@ def test_empty_pool_still_fails_fast():
         assert time.monotonic() - t0 < 5
     finally:
         proc.kill()
+
+
+def test_bounded_generate_pool_completes_large_batch():
+    """generate_workers=2 with an 8-request batch: requests queue through the
+    bounded pool (no thread-per-request) and all still complete."""
+    proc, port = spawn_rollout_manager(
+        "127.0.0.1:0",
+        extra_args=["--health-check-interval-s", "0.1",
+                    "--stats-poll-interval-s", "0.2",
+                    "--generate-workers", "2",
+                    "--http-workers", "4"])
+    client = ManagerClient(f"127.0.0.1:{port}")
+    client.wait_healthy()
+    eng = FakeEngine().start()
+    try:
+        client.register_rollout_instance(eng.endpoint)
+        wait_active(client, 1)
+        reqs = [{"rid": f"bp{i}", "input_ids": [1, 2],
+                 "sampling_params": {"max_new_tokens": 3}} for i in range(8)]
+        results = list(client.batch_generate_stream(reqs, max_local_gen_s=30))
+        assert len(results) == 8
+        assert all(r.success for r in results)
+    finally:
+        proc.kill()
+        eng.stop()

@@ -1,0 +1,58 @@
+"""Multi-host stream trainer: 2 jax.distributed CPU processes run one fit
+step — process-0 control plane (manager/reward/weight push), broadcast data
+plane, dp=2 mesh sharding of the jitted updates (SURVEY.md L4; reference
+worker groups stream_fsdp_workers.py:262-546)."""
+
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.mark.slow
+def test_two_process_fit_step(tmp_path):
+    port = _free_port()
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        PALLAS_AXON_POOL_IPS="",     # no TPU plugin in the workers
+        XLA_FLAGS="--xla_force_host_platform_device_count=1",
+        JAX_ENABLE_X64="0",
+    )
+    # drop any inherited distributed env from the conftest/session
+    for k in ("JAX_COORDINATOR_ADDRESS", "JAX_NUM_PROCESSES", "JAX_PROCESS_ID"):
+        env.pop(k, None)
+    worker = os.path.join(os.path.dirname(__file__), "multihost_fit_worker.py")
+    procs = [
+        subprocess.Popen([sys.executable, worker, str(port), str(pid), ""],
+                         env=env, stdout=subprocess.PIPE,
+                         stderr=subprocess.STDOUT, text=True,
+                         cwd="/root/repo")
+        for pid in (0, 1)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=600)
+            outs.append(out)
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        raise
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {pid} rc={p.returncode}:\n{out[-4000:]}"
+        assert "MULTIHOST_OK" in out, f"worker {pid}:\n{out[-4000:]}"
+    # identical param sums printed by both (cross-checked in-process too)
+    s0 = [ln for ln in outs[0].splitlines() if "MULTIHOST_OK" in ln][0]
+    s1 = [ln for ln in outs[1].splitlines() if "MULTIHOST_OK" in ln][0]
+    assert s0.split("param_sum=")[1] == s1.split("param_sum=")[1]

@@ -81,8 +81,21 @@ def test_manager_tsan_concurrent_load():
             except Exception as exc:  # noqa: BLE001
                 errors.append(exc)
 
+        def batch_worker(wid):
+            # exercises the bounded generate pool: many requests fanned out
+            # through /batch_generate_requests while other planes churn
+            try:
+                reqs = [{"rid": f"bw{wid}-{i}", "input_ids": [1, 2],
+                         "sampling_params": {"max_new_tokens": 3}}
+                        for i in range(12)]
+                list(client.batch_generate_stream(reqs, max_local_gen_s=30))
+            except Exception as exc:  # noqa: BLE001
+                errors.append(exc)
+
         threads = ([threading.Thread(target=gen_worker, args=(i,))
                     for i in range(4)]
+                   + [threading.Thread(target=batch_worker, args=(i,))
+                      for i in range(2)]
                    + [threading.Thread(target=weight_worker),
                       threading.Thread(target=metrics_worker)])
         for t in threads:
@@ -138,3 +151,21 @@ def test_hybrid_mesh_falls_back_single_slice():
 
     mesh = distributed.make_hybrid_mesh(dcn_dp=1)
     assert set(mesh.axis_names) == {"dp", "fsdp", "tp", "sp"}
+
+
+def test_pp_ep_config_surface_guarded():
+    """PP/EP exist as mesh-config knobs (reference parity: config surface
+    only, workers/config/rollout.py:132-134,193-202) and raise a clear
+    NotImplementedError at resolution, not a shape error deep in jit."""
+    import pytest as _pytest
+
+    from polyrl_tpu.parallel import mesh as meshlib
+
+    cfg = meshlib.MeshConfig(pp=2)
+    with _pytest.raises(NotImplementedError, match="pipeline"):
+        cfg.resolve(8)
+    cfg = meshlib.MeshConfig(ep=2)
+    with _pytest.raises(NotImplementedError, match="expert"):
+        cfg.resolve(8)
+    # defaults stay executable
+    assert meshlib.MeshConfig(dp=2, fsdp=2, tp=2, sp=1).resolve(8) == (2, 2, 2, 1)
