@@ -374,14 +374,58 @@ def forward(
 
 def make_paged_pools(cfg: ModelConfig, num_pages: int, page_size: int,
                      dtype=None) -> tuple:
-    """Paged KV pool: (k, v) each [L, num_pages, page_size, Hkv, D].
+    """Paged KV pool: (k, v), each a PER-LAYER tuple of
+    [Hkv, num_pages, page_size, D] arrays.
+
+    Head-major layout: each layer's pool is exactly the
+    [num_kv_heads, total_pages, page_size, head_dim] shape the TPU paged
+    decode kernel streams (one (kv_head, page-block) DMA per grid step), so
+    the hot loop never transposes the multi-GB pool. Per-layer arrays, not
+    one stacked [L, ...]: the decode step's KV scatter prefers a physical
+    layout the stacked form lets XLA actually pick — which then forces a
+    full-pool copy per scan iteration to satisfy the attention kernel's
+    standard-layout operand (observed: 2×3.5 GB temps, OOM at 128 slots).
+    Separate 4-D buffers keep scatter and kernel in layout agreement.
 
     Page 0 is reserved as the null page — inactive slots and padding scatter
     their garbage KV there so every decode step has uniform static shapes
     (the TPU answer to SGLang's paged allocator, SURVEY.md §2.2 row 1)."""
     dtype = dtype or cfg.dtype
-    shape = (cfg.num_layers, num_pages, page_size, cfg.num_kv_heads, cfg.head_dim_)
-    return (jnp.zeros(shape, dtype=dtype), jnp.zeros(shape, dtype=dtype))
+    shape = (cfg.num_kv_heads, num_pages, page_size, cfg.head_dim_)
+    return (tuple(jnp.zeros(shape, dtype=dtype) for _ in range(cfg.num_layers)),
+            tuple(jnp.zeros(shape, dtype=dtype) for _ in range(cfg.num_layers)))
+
+
+def _scatter_token_kv(pool, write_page, write_off, upd):
+    """Scatter one token's KV per slot into ``pool`` [Hkv, N, ps, D];
+    ``upd`` is [S, Hkv, D]. Written as a ROW scatter in the flattened
+    [Hkv·N·ps, D] view: the update window is then the minor-most dim alone,
+    so XLA's layout assignment keeps the pool in standard layout — the
+    4-D form's split window (Hkv major + D minor) made layout assignment
+    pick a permuted physical layout, and the attention kernel's
+    standard-layout operand constraint then forced a full-pool copy every
+    decode iteration."""
+    hkv, n, ps, d = pool.shape
+    s = write_page.shape[0]
+    flat = pool.reshape(hkv * n * ps, d)
+    head_off = jnp.arange(hkv, dtype=jnp.int32)[:, None] * (n * ps)
+    idx = (head_off + (write_page * ps + write_off)[None, :]).reshape(-1)
+    flat = flat.at[idx].set(
+        upd.transpose(1, 0, 2).reshape(hkv * s, d).astype(pool.dtype))
+    return flat.reshape(hkv, n, ps, d)
+
+
+def _scatter_pages_kv(pool, page_ids, upd):
+    """Scatter whole pages into ``pool`` [Hkv, N, ps, D]; ``upd`` is
+    [Hkv, n_pg, ps, D]. Same flat-row trick as ``_scatter_token_kv``
+    ([Hkv·N, ps·D] rows) to keep the pool in standard layout."""
+    hkv, n, ps, d = pool.shape
+    npg = page_ids.shape[0]
+    flat = pool.reshape(hkv * n, ps * d)
+    idx = (jnp.arange(hkv, dtype=jnp.int32)[:, None] * n
+           + page_ids[None, :].astype(jnp.int32)).reshape(-1)
+    flat = flat.at[idx].set(upd.reshape(hkv * npg, ps * d).astype(pool.dtype))
+    return flat.reshape(hkv, n, ps, d)
 
 
 def forward_paged_decode(
@@ -389,36 +433,45 @@ def forward_paged_decode(
     cfg: ModelConfig,
     tokens: jnp.ndarray,      # [S] int32 — one new token per slot
     positions: jnp.ndarray,   # [S] int32 — absolute position of that token
-    pools: tuple,             # (k, v) each [L, N, page_size, Hkv, D]
+    pools: tuple,             # (k, v): per-layer tuples of [Hkv, N, page, D]
     page_table: jnp.ndarray,  # [S, P] int32
     seq_lens: jnp.ndarray,    # [S] int32 tokens already in cache (== positions)
     attn_fn=None,
+    active: jnp.ndarray | None = None,  # [S] bool — mask KV writes
 ) -> tuple[jnp.ndarray, tuple]:
     """One decode step for every slot at once: write the new token's KV into
     each slot's current page, then paged-attend over [0, seq_len]. Returns
     (logits [S, V] f32, updated pools). Static shapes regardless of the mix
-    of live requests — the continuous-batching hot loop."""
+    of live requests — the continuous-batching hot loop.
+
+    ``active`` routes INACTIVE slots' writes to the null page 0: a finished
+    slot's pages return to the allocator while its device page_table row is
+    still stale, so an unmasked write would corrupt whichever request
+    reuses those pages (one garbage KV token per later dispatch)."""
     from polyrl_tpu.ops.paged_attention import paged_attention
 
     attn_fn = attn_fn or paged_attention
     s = tokens.shape[0]
     hq, hkv, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim_
-    page_size = pools[0].shape[2]
+    page_size = pools[0][0].shape[2]
 
     x = params["embed"][tokens]  # [S, d]
     cos, sin = rope_cos_sin(cfg, positions[:, None])  # [S, 1, hd/2]
     write_page = page_table[jnp.arange(s), seq_lens // page_size]  # [S]
     write_off = seq_lens % page_size
+    if active is not None:
+        write_page = jnp.where(active, write_page, 0)
+        write_off = jnp.where(active, write_off, 0)
     attn_lens = seq_lens + 1  # include the token written this step
 
     layers = params["layers"]
 
     # UNROLLED layer loop, static layer indices: pool writes are per-token
-    # scatters and pool reads are lazily-fused views. A scan would copy
-    # entire pool layers per step (ys restacking or dynamic layer slicing) —
-    # catastrophic when the pool IS the whole KV memory.
-    k_pools, v_pools = pools
-    n_layers = k_pools.shape[0]
+    # scatters and pool reads are the per-layer buffers directly. A scan
+    # would copy entire pool layers per step (ys restacking or dynamic layer
+    # slicing) — catastrophic when the pool IS the whole KV memory.
+    k_pools, v_pools = list(pools[0]), list(pools[1])
+    n_layers = len(k_pools)
     for l in range(n_layers):
         lp = jax.tree_util.tree_map(lambda a: a[l], layers)
         h = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
@@ -430,10 +483,8 @@ def forward_paged_decode(
             k = rms_norm(k, lp["k_norm"], cfg.rms_norm_eps)
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
-        k_pools = k_pools.at[l, write_page, write_off].set(
-            k[:, 0].astype(k_pools.dtype))
-        v_pools = v_pools.at[l, write_page, write_off].set(
-            v[:, 0].astype(v_pools.dtype))
+        k_pools[l] = _scatter_token_kv(k_pools[l], write_page, write_off, k[:, 0])
+        v_pools[l] = _scatter_token_kv(v_pools[l], write_page, write_off, v[:, 0])
         attn_out = attn_fn(q[:, 0], k_pools[l], v_pools[l], page_table,
                            attn_lens)  # [S, Hq, D]
         x = x + attn_out.reshape(s, hq * hd) @ lp["wo"]
@@ -443,7 +494,7 @@ def forward_paged_decode(
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
     head = params["embed"].T if cfg.tie_word_embeddings else params["lm_head"]
     logits = jnp.einsum("sd,dv->sv", x, head, preferred_element_type=jnp.float32)
-    return logits, (k_pools, v_pools)
+    return logits, (tuple(k_pools), tuple(v_pools))
 
 
 def prefill_into_pages(
@@ -458,7 +509,7 @@ def prefill_into_pages(
     (updated pools, last-token logits [V] f32). Padding positions write into
     the null page / the tail of the last real page — never attended (masking
     is by seq_len everywhere)."""
-    page_size = pools[0].shape[2]
+    page_size = pools[0][0].shape[2]
     pb = ids.shape[0]
     n_pg = pb // page_size
     layers = cfg.num_layers
@@ -466,16 +517,57 @@ def prefill_into_pages(
 
     mask = (jnp.arange(pb) < prompt_len).astype(jnp.float32)[None]
     positions = jnp.arange(pb, dtype=jnp.int32)[None]
-    cache = make_cache(cfg, 1, pb, dtype=pools[0].dtype)
+    cache = make_cache(cfg, 1, pb, dtype=pools[0][0].dtype)
     last_logits, (k_new, v_new) = forward(
         params, cfg, ids[None], positions, mask, cache=cache, write_idx=0,
         logits_for=jnp.maximum(prompt_len - 1, 0)[None])
 
-    k_r = k_new[:, 0].reshape(layers, n_pg, page_size, hkv, hd)
-    v_r = v_new[:, 0].reshape(layers, n_pg, page_size, hkv, hd)
-    k_pools = pools[0].at[:, page_ids].set(k_r.astype(pools[0].dtype))
-    v_pools = pools[1].at[:, page_ids].set(v_r.astype(pools[1].dtype))
+    # [L, pb, hkv, hd] → per layer [hkv, n_pg, page, hd] (head-major pools)
+    k_r = k_new[:, 0].reshape(layers, n_pg, page_size, hkv, hd).transpose(0, 3, 1, 2, 4)
+    v_r = v_new[:, 0].reshape(layers, n_pg, page_size, hkv, hd).transpose(0, 3, 1, 2, 4)
+    k_pools = tuple(_scatter_pages_kv(pools[0][l], page_ids, k_r[l])
+                    for l in range(layers))
+    v_pools = tuple(_scatter_pages_kv(pools[1][l], page_ids, v_r[l])
+                    for l in range(layers))
     return (k_pools, v_pools), last_logits[0]
+
+
+def prefill_batch_into_pages(
+    params: dict,
+    cfg: ModelConfig,
+    ids: jnp.ndarray,          # [B, pb] int32 right-padded prompts
+    prompt_lens: jnp.ndarray,  # [B] int32
+    pools: tuple,
+    page_ids: jnp.ndarray,     # [B, pb // page_size] int32
+) -> tuple[tuple, jnp.ndarray]:
+    """Batched admission prefill: B prompts in ONE dispatch. Dispatch count
+    is the admission bottleneck on dispatch-latency-bound links (and still
+    wins on real hardware: one [B, pb] forward beats B serialized [pb]
+    forwards). Returns (updated pools, last-token logits [B, V] f32).
+    Duplicate page rows (wave padding repeats a real request) write the
+    same content twice — benign."""
+    page_size = pools[0][0].shape[2]
+    b, pb = ids.shape
+    n_pg = pb // page_size
+    layers = cfg.num_layers
+    hkv, hd = cfg.num_kv_heads, cfg.head_dim_
+
+    mask = (jnp.arange(pb)[None, :] < prompt_lens[:, None]).astype(jnp.float32)
+    positions = jnp.broadcast_to(jnp.arange(pb, dtype=jnp.int32), (b, pb))
+    cache = make_cache(cfg, b, pb, dtype=pools[0][0].dtype)
+    last_logits, (k_new, v_new) = forward(
+        params, cfg, ids, positions, mask, cache=cache, write_idx=0,
+        logits_for=jnp.maximum(prompt_lens - 1, 0))
+
+    # [L, B, pb, hkv, hd] → per layer [hkv, B·n_pg, page, hd]
+    k_r = k_new.reshape(layers, b * n_pg, page_size, hkv, hd).transpose(0, 3, 1, 2, 4)
+    v_r = v_new.reshape(layers, b * n_pg, page_size, hkv, hd).transpose(0, 3, 1, 2, 4)
+    flat_pages = page_ids.reshape(-1)
+    k_pools = tuple(_scatter_pages_kv(pools[0][l], flat_pages, k_r[l])
+                    for l in range(layers))
+    v_pools = tuple(_scatter_pages_kv(pools[1][l], flat_pages, v_r[l])
+                    for l in range(layers))
+    return (k_pools, v_pools), last_logits
 
 
 def prefill_suffix_into_pages(
@@ -497,7 +589,7 @@ def prefill_suffix_into_pages(
     ``n_prefix_pg·page_size``, padded entries null); suffix KV is scattered
     into ``page_ids``. Returns (updated pools, last-token logits [V] f32).
     """
-    page_size = pools[0].shape[2]
+    page_size = pools[0][0].shape[2]
     pb = ids.shape[0]
     n_pg = pb // page_size
     n_prefix_pg = prefix_page_ids.shape[0]
@@ -507,9 +599,12 @@ def prefill_suffix_into_pages(
 
     # dense scratch cache: [prefix_cap | suffix chunk]
     s_total = prefix_cap + pb
-    cache = make_cache(cfg, 1, s_total, dtype=pools[0].dtype)
-    k_pre = pools[0][:, prefix_page_ids]  # [L, n_pre, page, hkv, hd]
-    v_pre = pools[1][:, prefix_page_ids]
+    cache = make_cache(cfg, 1, s_total, dtype=pools[0][0].dtype)
+    # per layer [hkv, n_pre, page, hd] → dense [L, prefix_cap, hkv, hd]
+    k_pre = jnp.stack([pools[0][l][:, prefix_page_ids] for l in range(layers)])
+    v_pre = jnp.stack([pools[1][l][:, prefix_page_ids] for l in range(layers)])
+    k_pre = k_pre.transpose(0, 2, 3, 1, 4)
+    v_pre = v_pre.transpose(0, 2, 3, 1, 4)
     cache = (
         cache[0].at[:, 0, :prefix_cap].set(
             k_pre.reshape(layers, prefix_cap, hkv, hd)),
@@ -530,10 +625,12 @@ def prefill_suffix_into_pages(
 
     k_sfx = jax.lax.dynamic_slice_in_dim(k_all[:, 0], prefix_len, pb, axis=1)
     v_sfx = jax.lax.dynamic_slice_in_dim(v_all[:, 0], prefix_len, pb, axis=1)
-    k_r = k_sfx.reshape(layers, n_pg, page_size, hkv, hd)
-    v_r = v_sfx.reshape(layers, n_pg, page_size, hkv, hd)
-    k_pools = pools[0].at[:, page_ids].set(k_r.astype(pools[0].dtype))
-    v_pools = pools[1].at[:, page_ids].set(v_r.astype(pools[1].dtype))
+    k_r = k_sfx.reshape(layers, n_pg, page_size, hkv, hd).transpose(0, 3, 1, 2, 4)
+    v_r = v_sfx.reshape(layers, n_pg, page_size, hkv, hd).transpose(0, 3, 1, 2, 4)
+    k_pools = tuple(_scatter_pages_kv(pools[0][l], page_ids, k_r[l])
+                    for l in range(layers))
+    v_pools = tuple(_scatter_pages_kv(pools[1][l], page_ids, v_r[l])
+                    for l in range(layers))
     return (k_pools, v_pools), last_logits[0]
 
 

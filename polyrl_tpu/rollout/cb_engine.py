@@ -111,6 +111,7 @@ class CBEngine:
         pad_token_id: int = 0,
         seed: int = 0,
         enable_prefix_cache: bool = True,
+        steps_per_dispatch: int = 8,
     ):
         assert all(b % page_size == 0 for b in prompt_buckets), \
             "prompt buckets must be page-aligned"
@@ -171,6 +172,11 @@ class CBEngine:
         self._dev_state: dict | None = None
         self._emit_q: collections.deque = collections.deque()
         self.pipeline_depth = 2
+        # fused decode steps per dispatch (multi-step scheduling): divides
+        # dispatch/fetch overhead by k at the cost of ≤(k-1) wasted
+        # device iterations per finished slot and up to k steps of
+        # abort/admission latency
+        self.steps_per_dispatch = max(1, int(steps_per_dispatch))
 
         # serving telemetry (server_info contract)
         self.weight_version = 0
@@ -182,39 +188,59 @@ class CBEngine:
 
     # -- compiled pieces ----------------------------------------------------
 
-    def _get_step(self, use_filters: bool):
-        """One decode step that also ADVANCES the control state on device:
-        the host loop keeps np mirrors for admission decisions but never
+    def _get_step(self, use_filters: bool, k: int = 1):
+        """``k`` fused decode steps per dispatch, state advanced on device.
+
+        The host loop keeps np mirrors for admission decisions but never
         re-uploads state between steps (each host→device array was a tunnel
         round trip — at ~10 uploads + 3 fetches per step the old loop was
-        RTT-bound at <100 tok/s on real hardware)."""
-        if use_filters not in self._step_fns:
+        RTT-bound at <100 tok/s on real hardware). Fusing k steps into one
+        ``lax.scan`` divides the remaining per-dispatch overhead (enqueue
+        RPC + fetch RTT + host bookkeeping) by k as well — the same
+        multi-step scheduling vLLM/SGLang use, but expressed as a compiled
+        on-device loop. Slots that finish mid-scan go inactive and emit pad
+        tokens for the remaining iterations (filtered host-side); inactive
+        slots' KV writes are routed to the null page (their freed pages may
+        already belong to another request — see forward_paged_decode's
+        ``active`` mask). Outputs are [k, slots]."""
+        key = (use_filters, k)
+        if key not in self._step_fns:
             cfg, pad = self.cfg, self.pad_token_id
 
             def step(params, kp, vp, rng, page_table, seq_lens, last_tokens,
                      n_generated, budgets, active, temps, top_ps, top_ks,
                      stop_table):
-                logits, (kp, vp) = decoder.forward_paged_decode(
-                    params, cfg, last_tokens, seq_lens, (kp, vp),
-                    page_table, seq_lens)
-                rng, sub = jax.random.split(rng)
-                token, logp = sample_token_vec(
-                    logits, sub, temps, top_ps, top_ks, use_filters=use_filters)
-                n_gen = n_generated + active.astype(jnp.int32)
-                hit_stop = jnp.any(token[:, None] == stop_table, axis=-1)
-                done = active & (hit_stop | (n_gen >= budgets))
-                token = jnp.where(active, token, pad)
-                logp = jnp.where(active, logp, 0.0)
-                # device-side state advance
-                new_active = active & ~done
-                new_seq_lens = seq_lens + active.astype(jnp.int32)
-                new_last = jnp.where(active, token, last_tokens)
-                return (kp, vp, rng, token, logp, done,
-                        new_seq_lens, new_last, n_gen, new_active)
+                def body(carry, _):
+                    kp, vp, rng, seq_lens, last_tokens, n_generated, active = carry
+                    logits, (kp, vp) = decoder.forward_paged_decode(
+                        params, cfg, last_tokens, seq_lens, (kp, vp),
+                        page_table, seq_lens, active=active)
+                    rng, sub = jax.random.split(rng)
+                    token, logp = sample_token_vec(
+                        logits, sub, temps, top_ps, top_ks,
+                        use_filters=use_filters)
+                    n_gen = n_generated + active.astype(jnp.int32)
+                    hit_stop = jnp.any(token[:, None] == stop_table, axis=-1)
+                    done = active & (hit_stop | (n_gen >= budgets))
+                    token = jnp.where(active, token, pad)
+                    logp = jnp.where(active, logp, 0.0)
+                    new_active = active & ~done
+                    new_seq = seq_lens + active.astype(jnp.int32)
+                    new_last = jnp.where(active, token, last_tokens)
+                    return ((kp, vp, rng, new_seq, new_last, n_gen, new_active),
+                            (token, logp, done))
 
-            self._step_fns[use_filters] = jax.jit(
+                carry, (token, logp, done) = jax.lax.scan(
+                    body,
+                    (kp, vp, rng, seq_lens, last_tokens, n_generated, active),
+                    None, length=k)
+                kp, vp, rng, seq_lens, last_tokens, n_generated, active = carry
+                return (kp, vp, rng, token, logp, done,
+                        seq_lens, last_tokens, n_generated, active)
+
+            self._step_fns[key] = jax.jit(
                 step, donate_argnums=(1, 2, 5, 6, 7, 9), static_argnames=())
-        return self._step_fns[use_filters]
+        return self._step_fns[key]
 
     def _insert_slot_state(self, st: dict, slot, prompt_len, token, done,
                            budget, temp, top_p, top_k, stop_row, row):
@@ -298,6 +324,53 @@ class CBEngine:
             self._prefill_fns[key] = jax.jit(prefill, donate_argnums=(1, 2))
         return self._prefill_fns[key]
 
+    def _get_prefill_batch(self, pb: int, nb: int, use_filters: bool):
+        """Fused BATCHED admission: nb requests prefill + sample + insert in
+        ONE dispatch (admission dispatch count bounds serving throughput on
+        dispatch-latency-bound links — 256 serialized admissions were the
+        whole serve wall). ``packed`` is [nb, row]; wave padding rows target
+        the dedicated SINK state row (see _ensure_dev_state) so their
+        independently sampled tokens can't collide with a real slot."""
+        key = ("batch", pb, nb, use_filters)
+        if key not in self._prefill_fns:
+            cfg = self.cfg
+            n_pg, pps = pb // self.page_size, self.pages_per_slot
+
+            def prefill(params, kp, vp, packed, rng, **state):
+                o = 0
+                ids = packed[:, o:o + pb]; o += pb
+                page_ids = packed[:, o:o + n_pg]; o += n_pg
+                rows = packed[:, o:o + pps]; o += pps
+                stop_rows = packed[:, o:o + MAX_STOP_TOKENS]; o += MAX_STOP_TOKENS
+                sc = packed[:, o:]
+                prompt_lens, slots = sc[:, 0], sc[:, 2]
+                budgets, top_ks = sc[:, 3], sc[:, 4]
+                temps = jax.lax.bitcast_convert_type(sc[:, 5], jnp.float32)
+                top_ps = jax.lax.bitcast_convert_type(sc[:, 6], jnp.float32)
+                (kp, vp), last_logits = decoder.prefill_batch_into_pages(
+                    params, cfg, ids, prompt_lens, (kp, vp), page_ids)
+                rng, sub = jax.random.split(rng)
+                token, logp = sample_token_vec(
+                    last_logits, sub, temps, top_ps, top_ks,
+                    use_filters=use_filters)
+                done = (jnp.any(token[:, None] == stop_rows, axis=-1)
+                        | (budgets <= 1))
+                st = dict(state)
+                st["seq_lens"] = st["seq_lens"].at[slots].set(prompt_lens)
+                st["last_tokens"] = st["last_tokens"].at[slots].set(token)
+                st["n_generated"] = st["n_generated"].at[slots].set(1)
+                st["budgets"] = st["budgets"].at[slots].set(budgets)
+                st["active"] = st["active"].at[slots].set(~done)
+                st["temps"] = st["temps"].at[slots].set(temps)
+                st["top_ps"] = st["top_ps"].at[slots].set(top_ps)
+                st["top_ks"] = st["top_ks"].at[slots].set(top_ks)
+                st["stop_table"] = st["stop_table"].at[slots].set(stop_rows)
+                st["page_table"] = st["page_table"].at[slots].set(rows)
+                return kp, vp, rng, token, logp, done, st
+
+            self._prefill_fns[key] = jax.jit(prefill, donate_argnums=(1, 2))
+        return self._prefill_fns[key]
+
     def _get_prefill_suffix(self, pb: int, n_prefix_pg: int, use_filters: bool):
         """Prefix-cache-hit fused prefill: compute only the suffix, attend
         over cached prefix pages. Compile key = (suffix bucket, prefix-page
@@ -367,6 +440,14 @@ class CBEngine:
             # cached KV belongs to the old weights (the reference flushes the
             # radix cache after every update, patches.py:374-377)
             with self._pool_lock:
+                self.prefix_cache.flush()
+
+    def flush_prefix_cache(self) -> None:
+        """Invalidate all cached prefix pages (public surface — weight
+        updates do this implicitly; benchmarks/tests use it to isolate
+        phases)."""
+        with self._pool_lock:
+            if self.prefix_cache is not None:
                 self.prefix_cache.flush()
 
     def release_memory(self) -> None:
@@ -446,16 +527,47 @@ class CBEngine:
                 break
         self.num_queued = len(self._pending)
 
+    ADMIT_WAVE = 8  # max admissions fused into one batched prefill dispatch
+
     def _admit(self) -> None:
         while self._pending:
-            free_slots = np.flatnonzero(~self._active & np.asarray(
-                [s is None for s in self._slots]))
-            if len(free_slots) == 0:
-                if self._emit_q:
+            wave = self._collect_wave()
+            if not wave:
+                break
+            try:
+                if len(wave) == 1:
+                    req, slot, pages, budget, mp, me = wave[0]
+                    self._prefill_request(slot, req, pages, budget, mp, me)
+                else:
+                    self._prefill_wave(wave)
+            except Exception:
+                for req, _slot, pages, _b, _mp, me in wave:
+                    self.allocator.free(pages)
+                    if self.prefix_cache is not None:
+                        self.prefix_cache.release(me)
+                    self._emit_error(req, "prefill failed")
+                raise  # pools may be donation-poisoned: let _recover reset
+        self.num_queued = len(self._pending)
+
+    def _collect_wave(self) -> list:
+        """Pop up to ADMIT_WAVE admissible requests, reserving a slot + pages
+        for each: (req, slot, pages, budget, matched_pages, matched_entries).
+        A prefix-cache hit is only ever a singleton (the suffix-prefill
+        variant is per-request) and ends a forming wave."""
+        wave: list = []
+        assigned: set[int] = set()
+        wave_page_keys: set = set()
+        while self._pending and len(wave) < self.ADMIT_WAVE:
+            free = [int(i) for i in np.flatnonzero(
+                        ~self._active & np.asarray(
+                            [s is None for s in self._slots]))
+                    if int(i) not in assigned]
+            if not free:
+                if not wave and self._emit_q:
                     # finished slots may be hiding behind undrained outputs
                     self._drain_emit_q()
                     continue
-                return
+                break
             req = self._pending[0]
             if req.abort is not None and req.abort.is_set():
                 self._pending.popleft()
@@ -475,6 +587,21 @@ class CBEngine:
             if self.prefix_cache is not None:
                 matched_pages, matched_entries = self.prefix_cache.match(
                     req.input_ids)
+            if matched_pages and wave:
+                # flush the no-hit wave first; re-match next round
+                self.prefix_cache.release(matched_entries)
+                break
+            if self.prefix_cache is not None and not matched_pages:
+                # a prompt sharing full pages with one ALREADY in this wave
+                # must wait for that request's publish (GRPO sends n samples
+                # of each prompt together — batching them into one wave
+                # would structurally defeat the prefix cache)
+                first_key = (self.prefix_cache._keys_for(req.input_ids, 1)[0]
+                             if (n_prompt - 1) >= self.page_size else None)
+                if first_key is not None and first_key in wave_page_keys:
+                    break
+                if first_key is not None:
+                    wave_page_keys.add(first_key)
             need = n_pages - len(matched_pages)
             pages = self.allocator.alloc(need)
             if pages is None and self._emit_q:
@@ -488,18 +615,96 @@ class CBEngine:
             if pages is None:
                 if self.prefix_cache is not None:
                     self.prefix_cache.release(matched_entries)
-                return  # head-of-line waits for pages to free
+                break  # head-of-line waits for pages to free
             self._pending.popleft()
-            try:
-                self._prefill_request(int(free_slots[0]), req, pages, budget,
-                                      matched_pages, matched_entries)
-            except Exception:
-                self.allocator.free(pages)
-                if self.prefix_cache is not None:
-                    self.prefix_cache.release(matched_entries)
-                self._emit_error(req, "prefill failed")
-                raise  # pools may be donation-poisoned: let _recover reset
-        self.num_queued = len(self._pending)
+            slot = free[0]
+            assigned.add(slot)
+            wave.append((req, slot, pages, budget, matched_pages,
+                         matched_entries))
+            if matched_pages:
+                break  # prefix hits admit as singletons
+        return wave
+
+    def _prefill_wave(self, wave: list) -> None:
+        """Batched fused admission: ONE dispatch prefills every request in
+        the wave (see _get_prefill_batch). The wave is padded to a size
+        bucket by repeating row 0 — duplicate scatters write identical
+        values and duplicate outputs are never emitted."""
+        self._ensure_dev_state()
+        state_kwargs = {k: self._dev_state[k] for k in self._STATE_KEYS}
+        pb = next_bucket(max(len(r.input_ids) for r, *_ in wave),
+                         self.prompt_buckets)
+        use_filters = any(r.sampling.top_p < 1.0 or r.sampling.top_k > 0
+                          for r, *_ in wave)
+        rows_np, metas = [], []
+        for req, slot, pages, budget, _mp, _me in wave:
+            sp = req.sampling
+            n_prompt = len(req.input_ids)
+            n_pp = -(-n_prompt // self.page_size)
+            page_ids = np.zeros((pb // self.page_size,), np.int32)
+            page_ids[:n_pp] = pages[:n_pp]
+            row = np.zeros((self.pages_per_slot,), np.int32)
+            row[:len(pages)] = pages
+            stops = np.full((MAX_STOP_TOKENS,), -1, np.int32)
+            for i, t in enumerate(sp.stop_token_ids[:MAX_STOP_TOKENS]):
+                stops[i] = t
+            ids = np.full((pb,), self.pad_token_id, np.int32)
+            ids[:n_prompt] = req.input_ids
+            rows_np.append(self._pack_prefill(
+                ids, page_ids, row, stops, np.zeros((0,), np.int32),
+                n_prompt, 0, slot, budget, sp))
+            metas.append((req, slot, pages, budget, row, stops))
+        nb = next_bucket(len(wave), (2, 4, 8))
+        if len(rows_np) < nb:
+            # padding rows target the SINK state row (index max_slots):
+            # budget 0 → immediately done/inactive, pages all null — a
+            # duplicated REAL row would scatter a conflicting sampled token
+            # into the real slot's last_tokens/active
+            pad_sp = SamplingParams(temperature=1.0, top_p=1.0, top_k=0,
+                                    max_new_tokens=0, stop_token_ids=())
+            pad_row = self._pack_prefill(
+                np.full((pb,), self.pad_token_id, np.int32),
+                np.zeros((pb // self.page_size,), np.int32),
+                np.zeros((self.pages_per_slot,), np.int32),
+                np.full((MAX_STOP_TOKENS,), -1, np.int32),
+                np.zeros((0,), np.int32),
+                1, 0, self.max_slots, 0, pad_sp)
+            while len(rows_np) < nb:
+                rows_np.append(pad_row)
+        fn = self._get_prefill_batch(pb, nb, use_filters)
+        kp, vp, self._rng, token, logp, done, new_st = fn(
+            self.params, self._pools[0], self._pools[1],
+            jnp.asarray(np.stack(rows_np)), self._rng, **state_kwargs)
+        self._pools = (kp, vp)
+        self._dev_state = new_st
+
+        idxs = []
+        for req, slot, pages, budget, row, stops in metas:
+            private = list(pages)
+            entries: list = []
+            if self.prefix_cache is not None:
+                published = self.prefix_cache.publish(
+                    req.input_ids, pages, n_cached=0)
+                pub_pages = {e.page for _, e in published}
+                private = [p for p in pages if p not in pub_pages]
+                entries = [e for _, e in published]
+            sp = req.sampling
+            n_prompt = len(req.input_ids)
+            self._page_table[slot] = row
+            self._seq_lens[slot] = n_prompt
+            self._last_tokens[slot] = self.pad_token_id
+            self._n_generated[slot] = 1
+            self._budgets[slot] = budget
+            self._active[slot] = True
+            self._temps[slot] = sp.temperature
+            self._top_ps[slot] = sp.top_p
+            self._top_ks[slot] = sp.top_k
+            self._stop_table[slot] = stops
+            self._slots[slot] = _SlotInfo(req, private, set(sp.stop_token_ids),
+                                          cache_entries=entries)
+            self._slot_gen[slot] += 1
+            idxs.append((slot, int(self._slot_gen[slot])))
+        self._emit_q.append(("prefillb", token, logp, done, idxs))
 
     def _prefill_request(self, slot: int, req: _Request, pages: list[int],
                          budget: int, matched_pages: list[int] | None = None,
@@ -599,17 +804,26 @@ class CBEngine:
         # carry device-side first tokens (mirror last_tokens is a
         # placeholder until drained)
         self._drain_emit_q()
+        # device state carries ONE extra row (index max_slots): the SINK —
+        # admission-wave padding rows insert there (never active, pages all
+        # null), so padded batch prefills can't collide with a real slot's
+        # sampled token / active flag
         self._dev_state = {
-            "page_table": jnp.asarray(self._page_table),
-            "seq_lens": jnp.asarray(self._seq_lens),
-            "last_tokens": jnp.asarray(self._last_tokens),
-            "n_generated": jnp.asarray(self._n_generated),
-            "budgets": jnp.asarray(self._budgets),
-            "active": jnp.asarray(self._active),
-            "temps": jnp.asarray(self._temps),
-            "top_ps": jnp.asarray(self._top_ps),
-            "top_ks": jnp.asarray(self._top_ks),
-            "stop_table": jnp.asarray(self._stop_table),
+            "page_table": jnp.asarray(np.concatenate(
+                [self._page_table,
+                 np.zeros((1, self.pages_per_slot), np.int32)])),
+            "seq_lens": jnp.asarray(np.append(self._seq_lens, 0).astype(np.int32)),
+            "last_tokens": jnp.asarray(np.append(
+                self._last_tokens, self.pad_token_id).astype(np.int32)),
+            "n_generated": jnp.asarray(np.append(self._n_generated, 0).astype(np.int32)),
+            "budgets": jnp.asarray(np.append(self._budgets, 0).astype(np.int32)),
+            "active": jnp.asarray(np.append(self._active, False)),
+            "temps": jnp.asarray(np.append(self._temps, 1.0).astype(np.float32)),
+            "top_ps": jnp.asarray(np.append(self._top_ps, 1.0).astype(np.float32)),
+            "top_ks": jnp.asarray(np.append(self._top_ks, 0).astype(np.int32)),
+            "stop_table": jnp.asarray(np.concatenate(
+                [self._stop_table,
+                 np.full((1, MAX_STOP_TOKENS), -1, np.int32)])),
         }
 
     def _drain_emit_q(self, keep: int = 0) -> None:
@@ -626,6 +840,11 @@ class CBEngine:
         for (kind, _t, _l, _d, tail), (token, logp, done) in zip(entries, fetched):
             if kind == "step":
                 self._emit_fetched(token, logp, done, tail)
+            elif kind == "prefillb":
+                # batched admission wave: one output row per real request
+                for j, slot_gen in enumerate(tail):
+                    self._emit_prefill(int(token[j]), float(logp[j]),
+                                       bool(done[j]), slot_gen)
             else:
                 self._emit_prefill(int(token), float(logp), bool(done), tail)
 
@@ -653,40 +872,48 @@ class CBEngine:
                 self._invalidate_dev_state()
 
     def _emit_fetched(self, token, logp, done, idxs) -> None:
-        """Stream one fetched step to the requests; ``idxs`` is a list of
-        (slot, generation) pairs and may be a superset of live slots
-        (mirrors lag the pipeline by one step) — finished slots and slots
-        reused by a newer admission (generation mismatch) are filtered."""
+        """Stream one fetched dispatch ([k, slots] token/logp/done rows, one
+        per fused step) to the requests; ``idxs`` is a list of (slot,
+        generation) pairs and may be a superset of live slots (mirrors lag
+        the pipeline by one step) — finished slots, slots that finished in
+        an EARLIER row of this same dispatch (pad-token tail of the scan),
+        and slots reused by a newer admission (generation mismatch) are all
+        filtered."""
+        token, logp, done = (np.atleast_2d(np.asarray(a))
+                             for a in (token, logp, done))
         n_emitted = 0
         host_stop_fix = False
-        for i, gen in idxs:
-            info = self._slots[i]
-            if info is None or not self._active[i] or self._slot_gen[i] != gen:
-                continue
-            t = int(token[i])
-            # host check is authoritative: covers stop tokens beyond the
-            # MAX_STOP_TOKENS device table
-            fin = bool(done[i]) or t in info.stop_set
-            reason = ""
-            if fin:
-                reason = "stop" if t in info.stop_set else "length"
-            info.req.out.put({"token_ids": [t], "logprobs": [float(logp[i])],
-                              "finished": fin, "finish_reason": reason})
-            n_emitted += 1
-            self._seq_lens[i] += 1
-            self._last_tokens[i] = t
-            self._n_generated[i] += 1
-            if fin:
-                info.req.out.put(STREAM_END)
-                self._active[i] = False
-                self._finalize(i)
-                if not bool(done[i]):
-                    # device missed this stop (beyond its table): its active
-                    # mask is stale — force a state re-upload. Any step
-                    # already in flight writes one garbage token into the
-                    # freed pages, which is safe: a later prefill reusing
-                    # them is ordered after it by the pools data dependency.
-                    host_stop_fix = True
+        for r in range(token.shape[0]):
+            for i, gen in idxs:
+                info = self._slots[i]
+                if info is None or not self._active[i] or self._slot_gen[i] != gen:
+                    continue
+                t = int(token[r, i])
+                # host check is authoritative: covers stop tokens beyond the
+                # MAX_STOP_TOKENS device table
+                fin = bool(done[r, i]) or t in info.stop_set
+                reason = ""
+                if fin:
+                    reason = "stop" if t in info.stop_set else "length"
+                info.req.out.put({"token_ids": [t],
+                                  "logprobs": [float(logp[r, i])],
+                                  "finished": fin, "finish_reason": reason})
+                n_emitted += 1
+                self._seq_lens[i] += 1
+                self._last_tokens[i] = t
+                self._n_generated[i] += 1
+                if fin:
+                    info.req.out.put(STREAM_END)
+                    self._active[i] = False
+                    self._finalize(i)
+                    if not bool(done[r, i]):
+                        # device missed this stop (beyond its table): its
+                        # active mask is stale — force a state re-upload. Any
+                        # step already in flight writes one garbage token into
+                        # the freed pages, which is safe: a later prefill
+                        # reusing them is ordered after it by the pools data
+                        # dependency.
+                        host_stop_fix = True
         if host_stop_fix:
             self._invalidate_dev_state()
         self._count_tokens(n_emitted)
@@ -718,7 +945,7 @@ class CBEngine:
             (self._top_ps[self._active] < 1.0) | (self._top_ks[self._active] > 0)))
         self._ensure_dev_state()
         st = self._dev_state
-        fn = self._get_step(use_filters)
+        fn = self._get_step(use_filters, self.steps_per_dispatch)
         (kp, vp, self._rng, token, logp, done, st["seq_lens"],
          st["last_tokens"], st["n_generated"], st["active"]) = fn(
             self.params, self._pools[0], self._pools[1], self._rng,

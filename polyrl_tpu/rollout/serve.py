@@ -34,7 +34,8 @@ def create_server(model: str, manager_endpoint: str | None = None,
                   max_slots: int = 64,
                   page_size: int = 64,
                   max_seq_len: int = 16384,
-                  num_pages: int | None = None):
+                  num_pages: int | None = None,
+                  steps_per_dispatch: int = 8):
     """Build engine + server, register with the manager, attach receiver.
 
     ``backend="cb"`` (default) serves with the paged continuous-batching
@@ -54,7 +55,7 @@ def create_server(model: str, manager_endpoint: str | None = None,
         engine = CBEngine(
             cfg, params, pad_token_id=0, kv_cache_dtype=getattr(jnp, dtype),
             max_slots=max_slots, page_size=page_size, max_seq_len=max_seq_len,
-            num_pages=num_pages,
+            num_pages=num_pages, steps_per_dispatch=steps_per_dispatch,
             prompt_buckets=tuple(prompt_buckets) if prompt_buckets
             else (128, 256, 512, 1024, 2048, 4096), seed=seed)
     else:
@@ -116,6 +117,8 @@ def main() -> None:
     p.add_argument("--max-slots", type=int, default=64)
     p.add_argument("--page-size", type=int, default=64)
     p.add_argument("--max-seq-len", type=int, default=16384)
+    p.add_argument("--steps-per-dispatch", type=int, default=8,
+                   help="fused decode steps per device dispatch")
     args = p.parse_args()
 
     logging.basicConfig(level=logging.INFO)
@@ -125,7 +128,8 @@ def main() -> None:
                            transfer_streams=args.transfer_streams,
                            backend=args.backend, max_slots=args.max_slots,
                            page_size=args.page_size,
-                           max_seq_len=args.max_seq_len)
+                           max_seq_len=args.max_seq_len,
+                           steps_per_dispatch=args.steps_per_dispatch)
     log.info("rollout server on %s", server.endpoint)
     try:
         while True:

@@ -159,7 +159,12 @@ class RolloutServer:
                 finally:
                     outer._drop_abort(rid)
 
-        self._http = ThreadingHTTPServer((host, port), Handler)
+        # default request_queue_size (listen backlog) is 5: a burst of
+        # concurrent clients (the manager fanning a batch out) gets
+        # connection resets before accept() ever runs
+        server_cls = type("_RolloutHTTPServer", (ThreadingHTTPServer,),
+                          {"request_queue_size": 1024})
+        self._http = server_cls((host, port), Handler)
         self.port = self._http.server_address[1]
         self.endpoint = f"{advertise_host}:{self.port}"
 

@@ -66,6 +66,32 @@ def forward_values(params, model_cfg, input_ids, positions, attn_mask, responses
     return values[:, -t_resp - 1 : -1, 0].astype(jnp.float32)
 
 
+def forward_values_packed(params, model_cfg, input_ids, positions, attn_mask,
+                          segment_ids, remat, loss_mask=None):
+    """Per-column values [R, L] on the packed (remove-padding) layout
+    (reference packed critic, stream_dp_critic.py:35,83): column t holds the
+    value predicted from column t-1 — the same one-left shift as
+    ``forward_values`` and the packed logprob pass, so the caller's
+    loss_mask/gather spec selects response-token values directly.
+    ``loss_mask`` zeroes columns outside the mask (finiteness guard, same
+    double-where rationale as the actor's packed pass)."""
+    from polyrl_tpu.ops import flash
+
+    attn = lambda q, k, v, am: flash.flash_attention_train(  # noqa: E731
+        q, k, v, am, causal=True, segment_ids=segment_ids)
+    value_params = dict(params)
+    head = value_params.pop("value_head")
+    value_params["lm_head"] = head
+    cfg = dataclasses.replace(model_cfg, tie_word_embeddings=False)
+    values, _ = decoder.forward(value_params, cfg, input_ids, positions,
+                                attn_mask, remat=remat, attn_fn=attn)
+    v = values[:, :-1, 0].astype(jnp.float32)
+    v = jnp.pad(v, ((0, 0), (1, 0)))
+    if loss_mask is not None:
+        v = jnp.where(loss_mask > 0, v, 0.0)
+    return v
+
+
 class StreamCritic:
     def __init__(self, model_cfg: decoder.ModelConfig, cfg: CriticConfig,
                  params: Any, mesh=None, attn_fn=None):
@@ -94,13 +120,23 @@ class StreamCritic:
         self._value_fn = None
 
     def _loss(self, params, batch, loss_scale):
-        vpreds = forward_values(
-            params, self.model_cfg, batch["input_ids"], batch["positions"],
-            batch["attention_mask"], batch["responses"], self.cfg.remat,
-            attn_fn=self.attn_fn,
-        )
+        if "segment_ids" in batch:  # packed (remove-padding) layout
+            vpreds = forward_values_packed(
+                params, self.model_cfg, batch["input_ids"],
+                batch["positions"], batch["attention_mask"],
+                batch["segment_ids"], self.cfg.remat,
+                loss_mask=batch["loss_mask"],
+            )
+            mask = batch["loss_mask"]
+        else:
+            vpreds = forward_values(
+                params, self.model_cfg, batch["input_ids"], batch["positions"],
+                batch["attention_mask"], batch["responses"], self.cfg.remat,
+                attn_fn=self.attn_fn,
+            )
+            mask = batch["response_mask"]
         vf_loss, clipfrac = core_algos.compute_value_loss(
-            vpreds, batch["returns"], batch["values"], batch["response_mask"],
+            vpreds, batch["returns"], batch["values"], mask,
             cliprange_value=self.cfg.cliprange_value,
             loss_agg_mode=self.cfg.loss_agg_mode,
         )
@@ -176,3 +212,16 @@ class StreamCritic:
                 )
             )
         return self._value_fn(self.params, batch)
+
+    def compute_values_packed(self, batch: dict) -> jnp.ndarray:
+        """[R, L] per-column values on a packed feed (no grad)."""
+        batch = self._shard_feed(batch)
+        if not hasattr(self, "_value_fn_packed"):
+            self._value_fn_packed = jax.jit(
+                lambda p, b: forward_values_packed(
+                    p, self.model_cfg, b["input_ids"], b["positions"],
+                    b["attention_mask"], b["segment_ids"], False,
+                    loss_mask=b.get("loss_mask"),
+                )
+            )
+        return self._value_fn_packed(self.params, batch)

@@ -170,11 +170,6 @@ class StreamRLTrainer:
         self._max_local_gen_s: float | None = None
         if cfg.adv_estimator == "gae" and critic is None:
             raise ValueError("GAE requires a critic")
-        if cfg.use_remove_padding and critic is not None:
-            raise ValueError(
-                "use_remove_padding currently packs the ACTOR passes only; "
-                "critic training still consumes padded [B, Tr] micros — run "
-                "the critic without remove_padding")
         self._ckpt = (
             ckpt_lib.CheckpointManager(cfg.ckpt_dir, max_to_keep=cfg.max_ckpt_keep)
             if cfg.ckpt_dir
@@ -435,14 +430,28 @@ class StreamRLTrainer:
                     ibatch.tensors["ref_log_probs"] = self._to_host(
                         self.ref_policy.compute_log_prob(feed))
         if self.critic is not None:
-            # critic stays on the padded layout (values are per-response-token
-            # [B, Tr]); remove_padding currently accelerates the actor passes
-            cfeed = {k: ibatch[k] for k in
-                     ("input_ids", "positions", "attention_mask", "responses",
-                      "response_mask")}
             with marked_timer("values", metrics):
-                ibatch.tensors["values"] = self._to_host(
-                    self.critic.compute_values(cfeed))
+                if cfg.use_remove_padding:
+                    # packed values ride the same packs/gather specs as the
+                    # logprob pass (reference packed critic,
+                    # stream_dp_critic.py:35,83) — no padded [B, Tp+Tr]
+                    # forward is ever built when the actor runs packed
+                    vals = np.zeros((len(ibatch), cfg.max_response_length),
+                                    np.float32)
+                    for pack, spec in ibatch.meta_info["packs"]:
+                        feed = {k: pack[k] for k in
+                                ("input_ids", "positions", "attention_mask",
+                                 "segment_ids", "loss_mask")}
+                        spec.gather_into(
+                            self._to_host(self.critic.compute_values_packed(feed)),
+                            vals)
+                    ibatch.tensors["values"] = vals
+                else:
+                    cfeed = {k: ibatch[k] for k in
+                             ("input_ids", "positions", "attention_mask",
+                              "responses", "response_mask")}
+                    ibatch.tensors["values"] = self._to_host(
+                        self.critic.compute_values(cfeed))
 
         with marked_timer("adv", metrics):
             token_scores = token_level_scores
@@ -549,6 +558,10 @@ class StreamRLTrainer:
         old = np.asarray(ibatch["old_log_probs"])
         ref = (np.asarray(ibatch["ref_log_probs"])
                if "ref_log_probs" in ibatch else None)
+        ret = (np.asarray(ibatch["returns"])
+               if self.critic is not None and "returns" in ibatch else None)
+        vals = (np.asarray(ibatch["values"])
+                if self.critic is not None and "values" in ibatch else None)
         for pack, spec in packs:
             feed = {k: pack[k] for k in
                     ("input_ids", "positions", "attention_mask",
@@ -557,6 +570,10 @@ class StreamRLTrainer:
             feed["old_log_probs"] = spec.scatter(old)
             if ref is not None:
                 feed["ref_log_probs"] = spec.scatter(ref)
+            if ret is not None:
+                feed["returns"] = spec.scatter(ret)
+            if vals is not None:
+                feed["values"] = spec.scatter(vals)
             yield feed, len(spec.orig_idx)
 
     def _compute_remax_baselines(self, ibatch: TensorBatch,
@@ -798,9 +815,12 @@ class StreamRLTrainer:
                     m = self.actor.update_stream(feed, is_opt, loss_scale=scale)
                     metrics.update({k: float(v) for k, v in m.items()})
                 if self.critic is not None:
-                    cfeed = {k: micro[k] for k in (
-                        "input_ids", "positions", "attention_mask", "responses",
-                        "response_mask", "returns", "values")}
+                    if isinstance(micro, dict):  # packed feed: critic-ready
+                        cfeed = micro
+                    else:
+                        cfeed = {k: micro[k] for k in (
+                            "input_ids", "positions", "attention_mask",
+                            "responses", "response_mask", "returns", "values")}
                     with marked_timer("update_critic", metrics):
                         cm = self.critic.update_stream(
                             cfeed, is_opt, loss_scale=scale)

@@ -16,10 +16,11 @@ PAGE = 8
 
 def _make_case(rng, s=3, hq=4, hkv=2, d=16, n_pool=32, max_pages=4,
                lens=(5, 17, 1)):
-    """Random pool + scattered page tables + a dense mirror of the same KV."""
+    """Random pool (head-major [Hkv, N, page, D]) + scattered page tables +
+    a dense mirror of the same KV."""
     assert len(lens) == s
-    k_pool = rng.standard_normal((n_pool, PAGE, hkv, d)).astype(np.float32)
-    v_pool = rng.standard_normal((n_pool, PAGE, hkv, d)).astype(np.float32)
+    k_pool = rng.standard_normal((hkv, n_pool, PAGE, d)).astype(np.float32)
+    v_pool = rng.standard_normal((hkv, n_pool, PAGE, d)).astype(np.float32)
     q = rng.standard_normal((s, hq, d)).astype(np.float32)
 
     free = list(range(1, n_pool))
@@ -33,8 +34,8 @@ def _make_case(rng, s=3, hq=4, hkv=2, d=16, n_pool=32, max_pages=4,
         pages = [free.pop() for _ in range(n_pages)]
         table[i, :n_pages] = pages
         for j, pg in enumerate(pages):
-            k_dense[i, j * PAGE:(j + 1) * PAGE] = k_pool[pg]
-            v_dense[i, j * PAGE:(j + 1) * PAGE] = v_pool[pg]
+            k_dense[i, j * PAGE:(j + 1) * PAGE] = k_pool[:, pg].transpose(1, 0, 2)
+            v_dense[i, j * PAGE:(j + 1) * PAGE] = v_pool[:, pg].transpose(1, 0, 2)
     return q, k_pool, v_pool, table, np.asarray(lens, np.int32), k_dense, v_dense
 
 
