@@ -165,6 +165,21 @@ def bench_cb(cfg, params, batch, prompt_len, new_tokens, max_slots=64,
 
     n_workers = min(64, batch)
     per = -(-batch // n_workers)
+    # sample the engine's 10 s-window throughput during the run: the peak is
+    # the steady-state number with ramp-up/drain and admission stalls
+    # excluded (what a continuous training stream would sustain). Reset the
+    # window first or the direct phase's number leaks into the serve peak.
+    engine.reset_throughput_window()
+    peak = [0.0]
+    stop_sampling = threading.Event()
+
+    def sampler() -> None:
+        while not stop_sampling.is_set():
+            peak[0] = max(peak[0], engine.last_gen_throughput)
+            time.sleep(0.5)
+
+    sampler_t = threading.Thread(target=sampler, daemon=True)
+    sampler_t.start()
     t0 = time.monotonic()
     threads = [threading.Thread(target=worker,
                                 args=(w * per, min((w + 1) * per, batch)))
@@ -174,6 +189,8 @@ def bench_cb(cfg, params, batch, prompt_len, new_tokens, max_slots=64,
     for t in threads:
         t.join()
     dt_serve = time.monotonic() - t0
+    stop_sampling.set()
+    sampler_t.join(timeout=5.0)  # before del engine: the closure reads it
     serve_tokens = sum(counts)
     server.stop()
     del engine
@@ -187,6 +204,7 @@ def bench_cb(cfg, params, batch, prompt_len, new_tokens, max_slots=64,
                      max(direct_tokens / dt_direct, 1e-9)), 1),
         "errors": len(errs),
         "error_sample": errs[0][:200] if errs else "",
+        "serve_peak_tok_s": round(peak[0], 1),
     }
 
 

@@ -442,6 +442,12 @@ class CBEngine:
             with self._pool_lock:
                 self.prefix_cache.flush()
 
+    def reset_throughput_window(self) -> None:
+        """Zero the rolling tok/s window (serving telemetry). Benchmarks use
+        it so one phase's throughput can't leak into the next's peak."""
+        self._tok_window.clear()
+        self.last_gen_throughput = 0.0
+
     def flush_prefix_cache(self) -> None:
         """Invalidate all cached prefix pages (public surface — weight
         updates do this implicitly; benchmarks/tests use it to isolate

@@ -5,6 +5,7 @@ import threading
 import time
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 import pytest
 
@@ -229,3 +230,49 @@ def test_slot_reuse_stale_emit_guard(tiny):
     assert int(cbe._n_generated[0]) == 1     # only B's prefill token counted
     assert int(cbe._seq_lens[0]) == 2       # B's prompt length, un-bumped
     cbe.stop()
+
+
+def test_multi_step_decode_stop_and_budget_mid_scan():
+    """Multi-step decode (steps_per_dispatch > 1): stop tokens and budget
+    exhaustion landing MID-scan must terminate streams at exactly the right
+    token — the pad tail of the fused scan is never emitted — and the freed
+    pages must be safely reusable by later admissions (inactive slots write
+    to the null page only)."""
+    cfg = decoder.get_config("tiny", dtype=jnp.float32)
+    params = decoder.init_params(jax.random.PRNGKey(0), cfg)
+    eng = CBEngine(cfg, params, pad_token_id=0, kv_cache_dtype=jnp.float32,
+                   max_slots=4, page_size=8, max_seq_len=64,
+                   prompt_buckets=(16,), steps_per_dispatch=4,
+                   enable_prefix_cache=False)
+    k1 = CBEngine(cfg, params, pad_token_id=0, kv_cache_dtype=jnp.float32,
+                  max_slots=4, page_size=8, max_seq_len=64,
+                  prompt_buckets=(16,), steps_per_dispatch=1,
+                  enable_prefix_cache=False)
+    prompts = [[7, 3, 9], [5, 5, 2, 8], [1, 2, 3, 4, 5]]
+    # greedy: K-fused decode must produce EXACTLY the K=1 stream, including
+    # budgets (6, not a multiple of K) that end mid-scan
+    sp = SamplingParams(temperature=0.0, max_new_tokens=6, stop_token_ids=())
+    outs_k = eng.generate(prompts, sp)
+    outs_1 = k1.generate(prompts, sp)
+    for a, b in zip(outs_k, outs_1):
+        assert a["token_ids"] == b["token_ids"]
+        assert len(a["token_ids"]) == 6
+        assert a["finish_reason"] == "length"
+    # greedy with the first generated token as the stop token → stream ends
+    # at token 1 even though the scan ran K=4 steps
+    stop_tok = outs_k[0]["token_ids"][0]
+    sp_stop = SamplingParams(temperature=0.0, max_new_tokens=6,
+                             stop_token_ids=(stop_tok,))
+    out_stop = eng.generate([prompts[0]], sp_stop)[0]
+    assert out_stop["token_ids"] == [stop_tok]
+    assert out_stop["finish_reason"] == "stop"
+    # page-reuse safety: run several generations so freed pages recycle
+    # through new admissions while older slots' device rows are stale; the
+    # greedy outputs must stay reproducible (no KV corruption)
+    ref = eng.generate(prompts, sp)
+    for _ in range(3):
+        again = eng.generate(prompts, sp)
+        for a, b in zip(again, ref):
+            assert a["token_ids"] == b["token_ids"]
+    eng.stop()
+    k1.stop()
