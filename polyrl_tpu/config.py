@@ -25,8 +25,12 @@ from polyrl_tpu.trainer.stream_trainer import TrainerConfig
 
 @dataclass
 class ModelSection:
-    preset: str = "tiny"                  # tiny | qwen3-1.7b | qwen3-8b | llama3-8b
+    preset: str = "tiny"                  # any decoder.PRESETS key (tiny, qwen3-1.7b/8b, qwen2.5-0.5b/7b/32b, llama3-8b/70b)
     dtype: str = "bfloat16"
+    # local HF checkpoint dir (config.json + safetensors): when set, the
+    # architecture comes from the checkpoint's config.json and the weights
+    # load pretrained instead of random-init (models/hf_loader.py)
+    hf_path: str = ""
     # raw ModelConfig field overrides (vocab_size, num_layers, ...)
     overrides: dict = field(default_factory=dict)
 

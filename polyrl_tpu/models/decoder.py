@@ -59,6 +59,7 @@ class ModelConfig:
     rms_norm_eps: float = 1e-5
     tie_word_embeddings: bool = False
     use_qk_norm: bool = False  # Qwen3
+    attention_bias: bool = False  # Qwen2/2.5 family (qkv projection bias)
     max_position_embeddings: int = 131072
     dtype: Any = jnp.bfloat16
 
@@ -94,6 +95,34 @@ PRESETS: dict[str, ModelConfig] = {
         vocab_size=151936, hidden_size=4096, intermediate_size=12288,
         num_layers=36, num_heads=32, num_kv_heads=8, head_dim=128,
         rope_theta=1000000.0, use_qk_norm=True,
+    ),
+    # Qwen2.5-0.5B (BASELINE config 1: GRPO on GSM8K)
+    "qwen2.5-0.5b": ModelConfig(
+        vocab_size=151936, hidden_size=896, intermediate_size=4864,
+        num_layers=24, num_heads=14, num_kv_heads=2, rope_theta=1000000.0,
+        attention_bias=True, tie_word_embeddings=True,
+        max_position_embeddings=32768,
+    ),
+    # Qwen2.5-7B — also the DeepSeek-R1-Distill-Qwen-7B architecture
+    # (BASELINE config 3: long-CoT GRPO on MATH)
+    "qwen2.5-7b": ModelConfig(
+        vocab_size=152064, hidden_size=3584, intermediate_size=18944,
+        num_layers=28, num_heads=28, num_kv_heads=4, rope_theta=1000000.0,
+        attention_bias=True, max_position_embeddings=131072,
+    ),
+    # Qwen2.5-32B (BASELINE config 4: TP-sharded RLHF)
+    "qwen2.5-32b": ModelConfig(
+        vocab_size=152064, hidden_size=5120, intermediate_size=27648,
+        num_layers=64, num_heads=40, num_kv_heads=8, rope_theta=1000000.0,
+        attention_bias=True, max_position_embeddings=131072,
+    ),
+    # Llama-3.1-70B (BASELINE config 5: disaggregated multi-slice PPO)
+    "llama3-70b": ModelConfig(
+        vocab_size=128256, hidden_size=8192, intermediate_size=28672,
+        num_layers=80, num_heads=64, num_kv_heads=8, rope_theta=500000.0,
+        rope_scaling=RopeScaling(factor=8.0, low_freq_factor=1.0,
+                                 high_freq_factor=4.0,
+                                 original_max_position_embeddings=8192),
     ),
 }
 
@@ -134,6 +163,10 @@ def init_params(rng: jax.Array, cfg: ModelConfig) -> dict:
     if cfg.use_qk_norm:
         params["layers"]["q_norm"] = jnp.ones((L, hd), dtype=cfg.dtype)
         params["layers"]["k_norm"] = jnp.ones((L, hd), dtype=cfg.dtype)
+    if cfg.attention_bias:
+        params["layers"]["bq"] = jnp.zeros((L, hq * hd), dtype=cfg.dtype)
+        params["layers"]["bk"] = jnp.zeros((L, hkv * hd), dtype=cfg.dtype)
+        params["layers"]["bv"] = jnp.zeros((L, hkv * hd), dtype=cfg.dtype)
     if not cfg.tie_word_embeddings:
         params["lm_head"] = norm(jax.random.fold_in(rng, 99), d, cfg.vocab_size)
     return params
@@ -155,6 +188,10 @@ def param_specs(cfg: ModelConfig) -> dict:
     if cfg.use_qk_norm:
         layer["q_norm"] = P(None, None)
         layer["k_norm"] = P(None, None)
+    if cfg.attention_bias:
+        layer["bq"] = P(None, TP)
+        layer["bk"] = P(None, TP)
+        layer["bv"] = P(None, TP)
     specs = {
         "embed": P(TP, FSDP),
         "final_norm": P(None),
@@ -232,9 +269,12 @@ def _layer_forward(cfg, x, lp, cos, sin, mask, layer_cache, attn_fn=None):
     hq, hkv, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim_
 
     h = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
-    q = (h @ lp["wq"]).reshape(b, t, hq, hd)
-    k = (h @ lp["wk"]).reshape(b, t, hkv, hd)
-    v = (h @ lp["wv"]).reshape(b, t, hkv, hd)
+    q, k, v = h @ lp["wq"], h @ lp["wk"], h @ lp["wv"]
+    if cfg.attention_bias:  # Qwen2/2.5 family
+        q, k, v = q + lp["bq"], k + lp["bk"], v + lp["bv"]
+    q = q.reshape(b, t, hq, hd)
+    k = k.reshape(b, t, hkv, hd)
+    v = v.reshape(b, t, hkv, hd)
     if cfg.use_qk_norm:
         q = rms_norm(q, lp["q_norm"], cfg.rms_norm_eps)
         k = rms_norm(k, lp["k_norm"], cfg.rms_norm_eps)
@@ -336,9 +376,12 @@ def forward(
         for l in range(n_layers):
             lp = jax.tree_util.tree_map(lambda a: a[l], layers)
             h = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
-            q = (h @ lp["wq"]).reshape(b, t_chunk, hq, hd)
-            k = (h @ lp["wk"]).reshape(b, t_chunk, hkv, hd)
-            v = (h @ lp["wv"]).reshape(b, t_chunk, hkv, hd)
+            q, k, v = h @ lp["wq"], h @ lp["wk"], h @ lp["wv"]
+            if cfg.attention_bias:
+                q, k, v = q + lp["bq"], k + lp["bk"], v + lp["bv"]
+            q = q.reshape(b, t_chunk, hq, hd)
+            k = k.reshape(b, t_chunk, hkv, hd)
+            v = v.reshape(b, t_chunk, hkv, hd)
             if cfg.use_qk_norm:
                 q = rms_norm(q, lp["q_norm"], cfg.rms_norm_eps)
                 k = rms_norm(k, lp["k_norm"], cfg.rms_norm_eps)
@@ -475,9 +518,12 @@ def forward_paged_decode(
     for l in range(n_layers):
         lp = jax.tree_util.tree_map(lambda a: a[l], layers)
         h = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
-        q = (h @ lp["wq"]).reshape(s, 1, hq, hd)
-        k = (h @ lp["wk"]).reshape(s, 1, hkv, hd)
-        v = (h @ lp["wv"]).reshape(s, 1, hkv, hd)
+        q, k, v = h @ lp["wq"], h @ lp["wk"], h @ lp["wv"]
+        if cfg.attention_bias:
+            q, k, v = q + lp["bq"], k + lp["bk"], v + lp["bv"]
+        q = q.reshape(s, 1, hq, hd)
+        k = k.reshape(s, 1, hkv, hd)
+        v = v.reshape(s, 1, hkv, hd)
         if cfg.use_qk_norm:
             q = rms_norm(q, lp["q_norm"], cfg.rms_norm_eps)
             k = rms_norm(k, lp["k_norm"], cfg.rms_norm_eps)

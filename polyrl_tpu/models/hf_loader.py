@@ -1,0 +1,192 @@
+"""Load HuggingFace checkpoints (safetensors) into the decoder's pytree.
+
+The reference never loads weights itself — verl/SGLang consume HF
+checkpoints directly (reference recipe `run_async_grpo_pipeline.sh:17`
+points at Qwen/Qwen3-1.7B). A standalone framework needs its own loader:
+this maps the HF llama/qwen parameter naming onto ``decoder.init_params``'s
+STACKED-layer pytree, so `get_config(preset) + load_hf_params(ckpt_dir)`
+drops pretrained weights straight into training and serving.
+
+Mapping (HF name → pytree path):
+- model.embed_tokens.weight            → embed
+- model.norm.weight                    → final_norm
+- lm_head.weight                       → lm_head (transposed [D, V]; absent
+                                         when tie_word_embeddings)
+- model.layers.{i}.input_layernorm     → layers.attn_norm[i]
+- model.layers.{i}.post_attention_layernorm → layers.mlp_norm[i]
+- model.layers.{i}.self_attn.{q,k,v,o}_proj → layers.w{q,k,v,o}[i]
+  (transposed: HF Linear stores [out, in], the decoder matmuls x @ W)
+- model.layers.{i}.mlp.{gate,up,down}_proj  → layers.w_{gate,up,down}[i]
+- model.layers.{i}.self_attn.{q,k}_norm     → layers.{q,k}_norm[i] (Qwen3)
+
+Per-layer tensors are stacked along a leading L axis to match the scan
+layout. Loading streams one safetensors shard at a time (file mmap via
+``safetensors.safe_open``), so peak host memory ≈ params + one shard.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import jax.numpy as jnp
+import numpy as np
+
+from polyrl_tpu.models import decoder
+
+_LAYER_MAP = {
+    "input_layernorm.weight": "attn_norm",
+    "post_attention_layernorm.weight": "mlp_norm",
+    "self_attn.q_proj.weight": "wq",
+    "self_attn.k_proj.weight": "wk",
+    "self_attn.v_proj.weight": "wv",
+    "self_attn.o_proj.weight": "wo",
+    "mlp.gate_proj.weight": "w_gate",
+    "mlp.up_proj.weight": "w_up",
+    "mlp.down_proj.weight": "w_down",
+    "self_attn.q_norm.weight": "q_norm",
+    "self_attn.k_norm.weight": "k_norm",
+    "self_attn.q_proj.bias": "bq",  # Qwen2/2.5 attention bias
+    "self_attn.k_proj.bias": "bk",
+    "self_attn.v_proj.bias": "bv",
+}
+_TRANSPOSED = {"wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down"}
+
+
+def _shard_files(ckpt_dir: str) -> list[str]:
+    index = os.path.join(ckpt_dir, "model.safetensors.index.json")
+    if os.path.exists(index):
+        with open(index) as f:
+            weight_map = json.load(f)["weight_map"]
+        return sorted({os.path.join(ckpt_dir, v) for v in weight_map.values()})
+    single = os.path.join(ckpt_dir, "model.safetensors")
+    if os.path.exists(single):
+        return [single]
+    raise FileNotFoundError(f"no safetensors checkpoint under {ckpt_dir}")
+
+
+def config_from_hf(ckpt_dir: str, dtype=jnp.bfloat16) -> decoder.ModelConfig:
+    """Build a ModelConfig from the checkpoint's config.json (llama/qwen2/
+    qwen3 architectures)."""
+    with open(os.path.join(ckpt_dir, "config.json")) as f:
+        hf = json.load(f)
+    rope_scaling = None
+    rs = hf.get("rope_scaling") or {}
+    rs_type = rs.get("rope_type", rs.get("type"))
+    if rs_type == "llama3":
+        rope_scaling = decoder.RopeScaling(
+            factor=rs["factor"], low_freq_factor=rs["low_freq_factor"],
+            high_freq_factor=rs["high_freq_factor"],
+            original_max_position_embeddings=rs["original_max_position_embeddings"])
+    elif rs_type not in (None, "default"):
+        # silently running yarn/linear/dynamic checkpoints with UNSCALED
+        # frequencies would be quietly wrong at long context
+        raise NotImplementedError(
+            f"rope_scaling type {rs_type!r} is not supported (llama3 only)")
+    return decoder.ModelConfig(
+        vocab_size=hf["vocab_size"],
+        hidden_size=hf["hidden_size"],
+        intermediate_size=hf["intermediate_size"],
+        num_layers=hf["num_hidden_layers"],
+        num_heads=hf["num_attention_heads"],
+        num_kv_heads=hf.get("num_key_value_heads", hf["num_attention_heads"]),
+        head_dim=hf.get("head_dim"),
+        rope_theta=float(hf.get("rope_theta", 10000.0)),
+        rope_scaling=rope_scaling,
+        rms_norm_eps=float(hf.get("rms_norm_eps", 1e-5)),
+        tie_word_embeddings=bool(hf.get("tie_word_embeddings", False)),
+        use_qk_norm="qwen3" in hf.get("model_type", ""),
+        attention_bias=bool(hf.get("attention_bias",
+                                   hf.get("model_type") == "qwen2")),
+        max_position_embeddings=hf.get("max_position_embeddings", 131072),
+        dtype=dtype,
+    )
+
+
+def load_hf_params(ckpt_dir: str, cfg: decoder.ModelConfig | None = None,
+                   dtype=None) -> dict:
+    """Load a safetensors checkpoint into the decoder pytree. ``cfg``
+    defaults to ``config_from_hf(ckpt_dir)``; ``dtype`` defaults to
+    ``cfg.dtype``."""
+    from safetensors import safe_open
+
+    cfg = cfg or config_from_hf(ckpt_dir)
+    dtype = dtype or cfg.dtype
+    np_dtype = jnp.dtype(dtype)
+    L = cfg.num_layers
+
+    flat: dict[str, np.ndarray] = {}
+    layer_parts: dict[str, list] = {}
+    for path in _shard_files(ckpt_dir):
+        with safe_open(path, framework="np") as f:
+            for name in f.keys():
+                t = f.get_tensor(name)
+                if name == "model.embed_tokens.weight":
+                    flat["embed"] = t
+                elif name == "model.norm.weight":
+                    flat["final_norm"] = t
+                elif name == "lm_head.weight":
+                    flat["lm_head"] = t.T  # [V, D] → [D, V]
+                elif name.startswith("model.layers."):
+                    rest = name.split(".", 2)[2]          # "{i}.suffix"
+                    idx_s, suffix = rest.split(".", 1)
+                    key = _LAYER_MAP.get(suffix)
+                    if key is None:
+                        raise KeyError(f"unmapped HF layer tensor {name}")
+                    if key in _TRANSPOSED:
+                        t = t.T                            # [out,in] → [in,out]
+                    layer_parts.setdefault(key, [None] * L)[int(idx_s)] = t
+                else:
+                    raise KeyError(f"unmapped HF tensor {name}")
+
+    layers = {}
+    for key in list(layer_parts):
+        parts = layer_parts.pop(key)  # free numpy refs as we convert
+        missing = [i for i, p in enumerate(parts) if p is None]
+        if missing:
+            raise ValueError(f"layer tensors missing for {key}: {missing}")
+        layers[key] = jnp.asarray(np.stack(parts), np_dtype)
+
+    params = {
+        "embed": jnp.asarray(flat["embed"], np_dtype),
+        "final_norm": jnp.asarray(flat["final_norm"], np_dtype),
+        "layers": layers,
+    }
+    if not cfg.tie_word_embeddings:
+        if "lm_head" not in flat:
+            raise ValueError("checkpoint has no lm_head but config does not "
+                             "tie word embeddings")
+        params["lm_head"] = jnp.asarray(flat["lm_head"], np_dtype)
+    # structural + shape validation against the config: catches both
+    # preset/checkpoint mixups and structurally mismatched checkpoints (a
+    # missing q_norm would otherwise surface as an opaque KeyError in jit;
+    # an extra bias tensor would be silently ignored at forward time)
+    import jax
+
+    shapes = jax.eval_shape(
+        lambda: decoder.init_params(jax.random.PRNGKey(0), cfg))
+    got = {jax.tree_util.keystr(p): tuple(l.shape)
+           for p, l in jax.tree_util.tree_leaves_with_path(params)}
+    want = {jax.tree_util.keystr(p): tuple(l.shape)
+            for p, l in jax.tree_util.tree_leaves_with_path(shapes)}
+    if set(got) != set(want):
+        raise ValueError(
+            f"checkpoint structure != config: missing {sorted(set(want) - set(got))},"
+            f" unexpected {sorted(set(got) - set(want))}")
+    for k in got:
+        if got[k] != want[k]:
+            raise ValueError(
+                f"{k}: checkpoint shape {got[k]} != config shape {want[k]}")
+    return params
+
+
+def build_from_hf(ckpt_dir: str, dtype=jnp.bfloat16,
+                  overrides: dict | None = None):
+    """One-stop: (ModelConfig, params) from a local HF checkpoint dir —
+    the shared recipe for the train and serve entry points."""
+    import dataclasses
+
+    cfg = config_from_hf(ckpt_dir, dtype=dtype)
+    if overrides:
+        cfg = dataclasses.replace(cfg, **overrides)
+    return cfg, load_hf_params(ckpt_dir, cfg)

@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import argparse
 import logging
+import os
 import time
 
 log = logging.getLogger(__name__)
@@ -48,9 +49,16 @@ def create_server(model: str, manager_endpoint: str | None = None,
     from polyrl_tpu.rollout.engine import RolloutEngine
     from polyrl_tpu.rollout.server import RolloutServer
 
-    cfg = decoder.get_config(model, dtype=getattr(jnp, dtype),
-                             **(model_overrides or {}))
-    params = jax.jit(lambda: decoder.init_params(jax.random.PRNGKey(seed), cfg))()
+    if os.path.isdir(model):
+        # a local HF checkpoint dir: pretrained weights + config.json arch
+        from polyrl_tpu.models.hf_loader import build_from_hf
+
+        cfg, params = build_from_hf(model, dtype=getattr(jnp, dtype),
+                                    overrides=model_overrides)
+    else:
+        cfg = decoder.get_config(model, dtype=getattr(jnp, dtype),
+                                 **(model_overrides or {}))
+        params = jax.jit(lambda: decoder.init_params(jax.random.PRNGKey(seed), cfg))()
     if backend == "cb":
         engine = CBEngine(
             cfg, params, pad_token_id=0, kv_cache_dtype=getattr(jnp, dtype),

@@ -60,6 +60,14 @@ def _build_model(cfg: RunConfig):
 
     from polyrl_tpu.models import decoder
 
+    if cfg.model.hf_path:
+        from polyrl_tpu.models.hf_loader import build_from_hf
+
+        mcfg, params = build_from_hf(cfg.model.hf_path,
+                                     dtype=getattr(jnp, cfg.model.dtype),
+                                     overrides=cfg.model.overrides)
+        log.info("loaded pretrained weights from %s", cfg.model.hf_path)
+        return mcfg, params
     mcfg = decoder.get_config(cfg.model.preset, dtype=getattr(jnp, cfg.model.dtype),
                               **cfg.model.overrides)
     params = jax.jit(lambda: decoder.init_params(
