@@ -87,6 +87,9 @@ class ParallelSection:
     sp: int = 1
     pp: int = 1                           # config surface only (mesh.py guard)
     ep: int = 1                           # config surface only
+    # sequence-parallel attention flavor when sp > 1 (parallel/sequence.py):
+    # ulysses (head all-to-all) | ring (KV ppermute) | dense (GSPMD decides)
+    sp_mode: str = "ulysses"
 
 
 @dataclass

@@ -196,6 +196,34 @@ def build_trainer(cfg: RunConfig, cleanup: list | None = None):
     tokenizer = build_tokenizer(cfg)
     mesh = _build_mesh(cfg)
     mcfg, params = _build_model(cfg)
+
+    # SP attention setup + config validation FIRST: a bad combination must
+    # fail before the manager/fabric/reward workers are spawned and torn
+    # back down on every attempt
+    attn_fn = None
+    if mesh is not None and mesh.shape.get("sp", 1) > 1:
+        # long-context: shard the sequence dim with a dedicated SP attention
+        # (Ulysses all-to-all / ring ppermute) instead of whatever GSPMD
+        # derives for dense attention over a sharded seq axis
+        from polyrl_tpu.parallel.sequence import make_sp_attention
+
+        sp = mesh.shape["sp"]
+        if cfg.trainer.use_remove_padding:
+            raise NotImplementedError(
+                "use_remove_padding with parallel.sp > 1 is not supported "
+                "yet — the packed passes run their own segment-id flash "
+                "attention; run packed OR sequence-parallel")
+        if mesh.shape.get("tp", 1) > 1:
+            raise NotImplementedError(
+                "parallel.sp > 1 with parallel.tp > 1 is not supported: the "
+                "SP attention replicates the head dim, which would silently "
+                "all-gather tensor-parallel q/k/v every layer")
+        if cfg.parallel.sp_mode == "ulysses" and mcfg.num_heads % sp != 0:
+            raise ValueError(
+                f"ulysses SP needs num_heads ({mcfg.num_heads}) divisible "
+                f"by sp ({sp}); use sp_mode=ring or a different sp")
+        attn_fn = make_sp_attention(mesh, cfg.parallel.sp_mode)
+
     if multihost.is_main():
         rollout = _build_rollout(cfg, mcfg, params, tokenizer, cleanup)
     else:
@@ -213,13 +241,18 @@ def build_trainer(cfg: RunConfig, cleanup: list | None = None):
     loader = PromptDataLoader(dataset, cfg.trainer.train_batch_size,
                               shuffle=cfg.data.shuffle, seed=cfg.data.seed)
 
-    actor = StreamActor(mcfg, cfg.actor, params, mesh=mesh)
+    actor = StreamActor(mcfg, cfg.actor, params, mesh=mesh, attn_fn=attn_fn)
     critic = None
     if cfg.trainer.adv_estimator == "gae":
         import jax
 
         critic = StreamCritic(mcfg, cfg.critic, init_critic_params(
-            jax.random.PRNGKey(cfg.trainer.seed + 1), mcfg), mesh=mesh)
+            jax.random.PRNGKey(cfg.trainer.seed + 1), mcfg), mesh=mesh,
+            attn_fn=attn_fn)
+    # ReferencePolicy stays mesh-FREE deliberately: its params are a local
+    # replicated copy and its feeds arrive as host numpy on every process —
+    # a mesh-bound shard_map attn_fn would drag the global mesh into a
+    # computation that must stay process-local in multi-host runs
     ref_policy = (ReferencePolicy(mcfg, params)
                   if (cfg.trainer.use_kl_in_reward or cfg.actor.use_kl_loss)
                   else None)
