@@ -30,6 +30,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P
 
+from polyrl_tpu.models.quant import mm, unembed
 from polyrl_tpu.ops.attention import attention, causal_mask
 from polyrl_tpu.parallel.mesh import DP, FSDP, SP, TP
 
@@ -269,7 +270,7 @@ def _layer_forward(cfg, x, lp, cos, sin, mask, layer_cache, attn_fn=None):
     hq, hkv, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim_
 
     h = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
-    q, k, v = h @ lp["wq"], h @ lp["wk"], h @ lp["wv"]
+    q, k, v = mm(h, lp["wq"]), mm(h, lp["wk"]), mm(h, lp["wv"])
     if cfg.attention_bias:  # Qwen2/2.5 family
         q, k, v = q + lp["bq"], k + lp["bk"], v + lp["bv"]
     q = q.reshape(b, t, hq, hd)
@@ -294,12 +295,12 @@ def _layer_forward(cfg, x, lp, cos, sin, mask, layer_cache, attn_fn=None):
         attn_out = attention(q, k, v, mask=mask)
         new_cache = None
 
-    attn_out = attn_out.reshape(b, t, hq * hd) @ lp["wo"]
+    attn_out = mm(attn_out.reshape(b, t, hq * hd), lp["wo"])
     x = x + attn_out
 
     h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
-    gate = jax.nn.silu((h @ lp["w_gate"]).astype(jnp.float32)).astype(h.dtype)
-    mlp_out = (gate * (h @ lp["w_up"])) @ lp["w_down"]
+    gate = jax.nn.silu(mm(h, lp["w_gate"]).astype(jnp.float32)).astype(h.dtype)
+    mlp_out = mm(gate * mm(h, lp["w_up"]), lp["w_down"])
     x = x + mlp_out
     return x, new_cache
 
@@ -376,7 +377,7 @@ def forward(
         for l in range(n_layers):
             lp = jax.tree_util.tree_map(lambda a: a[l], layers)
             h = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
-            q, k, v = h @ lp["wq"], h @ lp["wk"], h @ lp["wv"]
+            q, k, v = mm(h, lp["wq"]), mm(h, lp["wk"]), mm(h, lp["wv"])
             if cfg.attention_bias:
                 q, k, v = q + lp["bq"], k + lp["bk"], v + lp["bv"]
             q = q.reshape(b, t_chunk, hq, hd)
@@ -392,10 +393,10 @@ def forward(
             v_cache = jax.lax.dynamic_update_slice(
                 v_cache, v[None].astype(v_cache.dtype), (l, 0, write_idx, 0, 0))
             attn_out = attention(q, k_cache[l], v_cache[l], mask=mask)
-            x = x + attn_out.reshape(b, t_chunk, hq * hd) @ lp["wo"]
+            x = x + mm(attn_out.reshape(b, t_chunk, hq * hd), lp["wo"])
             h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
-            gate = jax.nn.silu((h @ lp["w_gate"]).astype(jnp.float32)).astype(h.dtype)
-            x = x + (gate * (h @ lp["w_up"])) @ lp["w_down"]
+            gate = jax.nn.silu(mm(h, lp["w_gate"]).astype(jnp.float32)).astype(h.dtype)
+            x = x + mm(gate * mm(h, lp["w_up"]), lp["w_down"])
         new_cache = (k_cache, v_cache)
 
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
@@ -405,11 +406,8 @@ def forward(
         # real token's logits, and [B, T, V] f32 for a long chunk is the
         # dominant HBM transient (e.g. 4k x 152k f32 = 2.5 GB per prompt)
         x = jnp.take_along_axis(x, logits_for[:, None, None], axis=1)[:, 0]
-        logits = jnp.einsum("bd,dv->bv", x, head,
-                            preferred_element_type=jnp.float32)
-        return logits, new_cache
-    logits = jnp.einsum("btd,dv->btv", x, head, preferred_element_type=jnp.float32)
-    return logits, new_cache
+        return unembed(x, head, "bd,dv->bv"), new_cache
+    return unembed(x, head, "btd,dv->btv"), new_cache
 
 
 # -- paged KV (continuous batching) -----------------------------------------
@@ -518,7 +516,7 @@ def forward_paged_decode(
     for l in range(n_layers):
         lp = jax.tree_util.tree_map(lambda a: a[l], layers)
         h = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
-        q, k, v = h @ lp["wq"], h @ lp["wk"], h @ lp["wv"]
+        q, k, v = mm(h, lp["wq"]), mm(h, lp["wk"]), mm(h, lp["wv"])
         if cfg.attention_bias:
             q, k, v = q + lp["bq"], k + lp["bk"], v + lp["bv"]
         q = q.reshape(s, 1, hq, hd)
@@ -533,14 +531,13 @@ def forward_paged_decode(
         v_pools[l] = _scatter_token_kv(v_pools[l], write_page, write_off, v[:, 0])
         attn_out = attn_fn(q[:, 0], k_pools[l], v_pools[l], page_table,
                            attn_lens)  # [S, Hq, D]
-        x = x + attn_out.reshape(s, hq * hd) @ lp["wo"]
+        x = x + mm(attn_out.reshape(s, hq * hd), lp["wo"])
         h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
-        gate = jax.nn.silu((h @ lp["w_gate"]).astype(jnp.float32)).astype(h.dtype)
-        x = x + (gate * (h @ lp["w_up"])) @ lp["w_down"]
+        gate = jax.nn.silu(mm(h, lp["w_gate"]).astype(jnp.float32)).astype(h.dtype)
+        x = x + mm(gate * mm(h, lp["w_up"]), lp["w_down"])
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
     head = params["embed"].T if cfg.tie_word_embeddings else params["lm_head"]
-    logits = jnp.einsum("sd,dv->sv", x, head, preferred_element_type=jnp.float32)
-    return logits, (tuple(k_pools), tuple(v_pools))
+    return unembed(x, head, "sd,dv->sv"), (tuple(k_pools), tuple(v_pools))
 
 
 def prefill_into_pages(

@@ -104,12 +104,23 @@ def config_from_hf(ckpt_dir: str, dtype=jnp.bfloat16) -> decoder.ModelConfig:
 
 
 def load_hf_params(ckpt_dir: str, cfg: decoder.ModelConfig | None = None,
-                   dtype=None) -> dict:
+                   dtype=None, quantize: str = "") -> dict:
     """Load a safetensors checkpoint into the decoder pytree. ``cfg``
     defaults to ``config_from_hf(ckpt_dir)``; ``dtype`` defaults to
-    ``cfg.dtype``."""
+    ``cfg.dtype``.
+
+    ``quantize="int8"``: matmul weights are quantized ON HOST (numpy) and
+    only the int8 tensors + scales are transferred — the full-precision
+    tree never exists on device, so an 8B checkpoint loads onto a 16 GiB
+    chip (models/quant.py; 8B_FEASIBILITY.md)."""
     from safetensors import safe_open
 
+    from polyrl_tpu.models.quant import (
+        QUANTIZED_LAYER_KEYS, QuantWeight, quantize_tensor,
+    )
+
+    if quantize not in ("", "int8"):
+        raise ValueError(f"unknown quantize mode {quantize!r}")
     cfg = cfg or config_from_hf(ckpt_dir)
     dtype = dtype or cfg.dtype
     np_dtype = jnp.dtype(dtype)
@@ -145,7 +156,13 @@ def load_hf_params(ckpt_dir: str, cfg: decoder.ModelConfig | None = None,
         missing = [i for i, p in enumerate(parts) if p is None]
         if missing:
             raise ValueError(f"layer tensors missing for {key}: {missing}")
-        layers[key] = jnp.asarray(np.stack(parts), np_dtype)
+        stacked = np.stack(parts)
+        if quantize == "int8" and key in QUANTIZED_LAYER_KEYS:
+            qw = quantize_tensor(stacked, contract_axis=-2)  # host-side
+            layers[key] = QuantWeight(q=jnp.asarray(qw.q),
+                                      scale=jnp.asarray(qw.scale))
+        else:
+            layers[key] = jnp.asarray(stacked, np_dtype)
 
     params = {
         "embed": jnp.asarray(flat["embed"], np_dtype),
@@ -156,15 +173,28 @@ def load_hf_params(ckpt_dir: str, cfg: decoder.ModelConfig | None = None,
         if "lm_head" not in flat:
             raise ValueError("checkpoint has no lm_head but config does not "
                              "tie word embeddings")
-        params["lm_head"] = jnp.asarray(flat["lm_head"], np_dtype)
+        if quantize == "int8":
+            qw = quantize_tensor(np.ascontiguousarray(flat["lm_head"]),
+                                 contract_axis=0)
+            params["lm_head"] = QuantWeight(q=jnp.asarray(qw.q),
+                                            scale=jnp.asarray(qw.scale))
+        else:
+            params["lm_head"] = jnp.asarray(flat["lm_head"], np_dtype)
     # structural + shape validation against the config: catches both
     # preset/checkpoint mixups and structurally mismatched checkpoints (a
     # missing q_norm would otherwise surface as an opaque KeyError in jit;
     # an extra bias tensor would be silently ignored at forward time)
     import jax
 
-    shapes = jax.eval_shape(
-        lambda: decoder.init_params(jax.random.PRNGKey(0), cfg))
+    if quantize == "int8":
+        from polyrl_tpu.models.quant import quantize_params
+
+        shapes = jax.eval_shape(
+            lambda: quantize_params(
+                decoder.init_params(jax.random.PRNGKey(0), cfg)))
+    else:
+        shapes = jax.eval_shape(
+            lambda: decoder.init_params(jax.random.PRNGKey(0), cfg))
     got = {jax.tree_util.keystr(p): tuple(l.shape)
            for p, l in jax.tree_util.tree_leaves_with_path(params)}
     want = {jax.tree_util.keystr(p): tuple(l.shape)
@@ -181,7 +211,7 @@ def load_hf_params(ckpt_dir: str, cfg: decoder.ModelConfig | None = None,
 
 
 def build_from_hf(ckpt_dir: str, dtype=jnp.bfloat16,
-                  overrides: dict | None = None):
+                  overrides: dict | None = None, quantize: str = ""):
     """One-stop: (ModelConfig, params) from a local HF checkpoint dir —
     the shared recipe for the train and serve entry points."""
     import dataclasses
@@ -189,4 +219,4 @@ def build_from_hf(ckpt_dir: str, dtype=jnp.bfloat16,
     cfg = config_from_hf(ckpt_dir, dtype=dtype)
     if overrides:
         cfg = dataclasses.replace(cfg, **overrides)
-    return cfg, load_hf_params(ckpt_dir, cfg)
+    return cfg, load_hf_params(ckpt_dir, cfg, quantize=quantize)

@@ -433,7 +433,18 @@ class CBEngine:
 
     def update_weights(self, params: Any, version: int | None = None) -> None:
         # atomic ref swap; the loop picks it up on its next step (shapes and
-        # shardings identical → the compiled step keeps working)
+        # shardings identical → the compiled step keeps working). Structure
+        # must match exactly: a mismatch (e.g. a bf16 tree swapped into a
+        # quantized engine — the caller should re-quantize first, see
+        # server.weight_preprocess) would silently retrace every compiled
+        # step and double weight HBM; fail loudly instead.
+        import jax
+
+        if (jax.tree_util.tree_structure(params)
+                != jax.tree_util.tree_structure(self.params)):
+            raise ValueError(
+                "update_weights tree structure mismatch (quantized engines "
+                "need the push re-quantized first — models/quant.py)")
         self.params = params
         self.weight_version = self.weight_version + 1 if version is None else version
         if self.prefix_cache is not None:

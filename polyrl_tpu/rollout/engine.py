@@ -98,6 +98,13 @@ class RolloutEngine:
     #    release/resume_memory_occupation) --------------------------------
 
     def update_weights(self, params: Any, version: int | None = None) -> None:
+        import jax
+
+        if (jax.tree_util.tree_structure(params)
+                != jax.tree_util.tree_structure(self.params)):
+            raise ValueError(
+                "update_weights tree structure mismatch (quantized engines "
+                "need the push re-quantized first — models/quant.py)")
         self.params = params
         self.weight_version = self.weight_version + 1 if version is None else version
 

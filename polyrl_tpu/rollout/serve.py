@@ -36,11 +36,16 @@ def create_server(model: str, manager_endpoint: str | None = None,
                   page_size: int = 64,
                   max_seq_len: int = 16384,
                   num_pages: int | None = None,
-                  steps_per_dispatch: int = 8):
+                  steps_per_dispatch: int = 8,
+                  weight_quant: str = ""):
     """Build engine + server, register with the manager, attach receiver.
 
     ``backend="cb"`` (default) serves with the paged continuous-batching
-    engine; ``backend="step"`` keeps the bucketed v0 StepDecoder path."""
+    engine; ``backend="step"`` keeps the bucketed v0 StepDecoder path.
+    ``weight_quant="int8"`` serves with int8 weight-only quantized matmuls
+    (models/quant.py) — halves weight HBM and fits 8B-class models on a
+    16 GiB chip; weight pushes from the trainer stay bf16 on the wire and
+    are re-quantized on arrival (server.weight_preprocess)."""
     import jax
     import jax.numpy as jnp
 
@@ -49,16 +54,39 @@ def create_server(model: str, manager_endpoint: str | None = None,
     from polyrl_tpu.rollout.engine import RolloutEngine
     from polyrl_tpu.rollout.server import RolloutServer
 
+    if weight_quant not in ("", "int8"):
+        raise ValueError(f"unknown weight_quant {weight_quant!r}")
     if os.path.isdir(model):
-        # a local HF checkpoint dir: pretrained weights + config.json arch
+        # a local HF checkpoint dir: pretrained weights + config.json arch.
+        # With int8, the loader quantizes host-side — the full-precision
+        # tree never exists on device (8B on a 16 GiB chip).
         from polyrl_tpu.models.hf_loader import build_from_hf
 
         cfg, params = build_from_hf(model, dtype=getattr(jnp, dtype),
-                                    overrides=model_overrides)
+                                    overrides=model_overrides,
+                                    quantize=weight_quant)
     else:
         cfg = decoder.get_config(model, dtype=getattr(jnp, dtype),
                                  **(model_overrides or {}))
-        params = jax.jit(lambda: decoder.init_params(jax.random.PRNGKey(seed), cfg))()
+        if weight_quant == "int8":
+            from polyrl_tpu.models.quant import init_quantized_params
+
+            # leaf-by-leaf device init in quantized form (same draws as
+            # init_params; the bf16 tree never materializes)
+            params = init_quantized_params(jax.random.PRNGKey(seed), cfg)
+        else:
+            params = jax.jit(
+                lambda: decoder.init_params(jax.random.PRNGKey(seed), cfg))()
+    weight_template = None
+    weight_preprocess = None
+    if weight_quant == "int8":
+        from polyrl_tpu.models.quant import quantize_params
+
+        # the transfer fabric's layout/unflatten contract stays the
+        # full-precision tree the TRAINER packs; quantize on arrival
+        weight_template = jax.eval_shape(
+            lambda: decoder.init_params(jax.random.PRNGKey(seed), cfg))
+        weight_preprocess = quantize_params
     if backend == "cb":
         engine = CBEngine(
             cfg, params, pad_token_id=0, kv_cache_dtype=getattr(jnp, dtype),
@@ -75,7 +103,10 @@ def create_server(model: str, manager_endpoint: str | None = None,
         engine = RolloutEngine(cfg, params, pad_token_id=0,
                                kv_cache_dtype=getattr(jnp, dtype), **kwargs)
     server = RolloutServer(engine, host=host, port=port,
-                           advertise_host=advertise_host).start()
+                           advertise_host=advertise_host)
+    server.weight_template = weight_template
+    server.weight_preprocess = weight_preprocess
+    server.start()
 
     if manager_endpoint:
         register_with_manager(server, manager_endpoint, is_local=is_local,
@@ -99,7 +130,10 @@ def register_with_manager(server, manager_endpoint: str,
     out = client.register_rollout_instance(server.endpoint)
     sender_ep = out.get("weight_sender_endpoint") or ""
     if sender_ep:
-        layout = build_layout(server.engine.params)
+        # quantized engines keep the TRAINER's bf16 tree as the wire layout
+        layout = build_layout(server.weight_template
+                              if server.weight_template is not None
+                              else server.engine.params)
         advertise = server.endpoint.rsplit(":", 1)[0]
         server.receiver = ReceiverAgent(
             layout, server.endpoint, sender_ep,
@@ -127,6 +161,8 @@ def main() -> None:
     p.add_argument("--max-seq-len", type=int, default=16384)
     p.add_argument("--steps-per-dispatch", type=int, default=8,
                    help="fused decode steps per device dispatch")
+    p.add_argument("--weight-quant", default="", choices=("", "int8"),
+                   help="int8 = weight-only quantized serving")
     args = p.parse_args()
 
     logging.basicConfig(level=logging.INFO)
@@ -137,7 +173,8 @@ def main() -> None:
                            backend=args.backend, max_slots=args.max_slots,
                            page_size=args.page_size,
                            max_seq_len=args.max_seq_len,
-                           steps_per_dispatch=args.steps_per_dispatch)
+                           steps_per_dispatch=args.steps_per_dispatch,
+                           weight_quant=args.weight_quant)
     log.info("rollout server on %s", server.endpoint)
     try:
         while True:

@@ -77,6 +77,12 @@ class RolloutServer:
         self._stop = threading.Event()
         self._paused = threading.Event()  # release_memory_occupation
         self.receiver = None  # ReceiverAgent, attached by serve.py
+        # quantized serving (models/quant.py): the wire format stays the
+        # trainer's bf16 tree — weight_template carries that tree's
+        # structure for layout/unflatten, weight_preprocess re-quantizes
+        # each arriving push before the device swap
+        self.weight_template = None
+        self.weight_preprocess = None
         self._weight_lock = threading.Lock()
         self._loop_thread: threading.Thread | None = None
 
@@ -352,7 +358,11 @@ class RolloutServer:
 
             self.receiver.wait_for_version(version, timeout=600.0)
             named = unpack_params(self.receiver.buffer, self.receiver.layout)
-            new_params = unflatten_like(self.engine.params, named)
+            template = (self.weight_template if self.weight_template
+                        is not None else self.engine.params)
+            new_params = unflatten_like(template, named)
+            if self.weight_preprocess is not None:
+                new_params = self.weight_preprocess(new_params)
             with self._weight_lock:  # not mid-batch
                 old = self.engine.params
                 self.engine.params = jax.tree_util.tree_map(

@@ -1,0 +1,264 @@
+"""Int8 weight-only quantized serving (models/quant.py).
+
+The reference gets quantized serving from SGLang's --quantization flag
+(external engine); here the engine is first-party so the quantization path
+is tested first-party: error bounds, pytree mechanics through jit/scan/
+tree_map, decode-engine integration, and the bf16-wire/int8-engine
+hot-swap contract.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from polyrl_tpu.models import decoder
+from polyrl_tpu.models.quant import (
+    QuantWeight,
+    init_quantized_params,
+    mm,
+    quant_param_specs,
+    quantize_params,
+    quantize_tensor,
+)
+
+
+def test_quantize_tensor_error_bound_numpy_and_jax():
+    rng = np.random.default_rng(0)
+    w = (rng.standard_normal((32, 48)) * 0.02).astype(np.float32)
+    for qw in (quantize_tensor(w, contract_axis=0),
+               quantize_tensor(jnp.asarray(w), contract_axis=0)):
+        deq = np.asarray(qw.q, dtype=np.float32) * np.asarray(qw.scale)[None, :]
+        scale = np.asarray(qw.scale)
+        # symmetric rounding: |w - q*s| <= s/2 per element
+        assert np.all(np.abs(w - deq) <= scale[None, :] * 0.5 + 1e-7)
+        assert np.asarray(qw.q).dtype == np.int8
+        assert np.max(np.abs(np.asarray(qw.q))) <= 127
+
+
+def test_quantize_stacked_per_layer_scale():
+    rng = np.random.default_rng(1)
+    w = (rng.standard_normal((3, 16, 8)) * 0.02).astype(np.float32)
+    qw = quantize_tensor(w, contract_axis=-2)
+    assert qw.scale.shape == (3, 8)
+    deq = np.asarray(qw.q, np.float32) * np.asarray(qw.scale)[:, None, :]
+    assert np.all(np.abs(w - deq) <= np.asarray(qw.scale)[:, None, :] * 0.5 + 1e-7)
+
+
+def test_mm_dispatch_matches_dequant():
+    rng = np.random.default_rng(2)
+    x = jnp.asarray((rng.standard_normal((4, 16)) * 0.5).astype(np.float32))
+    w = jnp.asarray((rng.standard_normal((16, 8)) * 0.02).astype(np.float32))
+    qw = quantize_tensor(w, contract_axis=0)
+    got = mm(x, qw)
+    want = x @ (qw.q.astype(jnp.float32) * qw.scale[None, :])
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_quantweight_pytree_treemap_and_jit():
+    """The engine's layer slicing (tree_map a[l]) and jit must see QuantWeight
+    as a transparent pytree node."""
+    w = jnp.arange(2 * 4 * 6, dtype=jnp.float32).reshape(2, 4, 6) * 0.01
+    qw = quantize_tensor(w, contract_axis=-2)
+    layers = {"wq": qw, "norm": jnp.ones((2, 4))}
+    lp = jax.tree_util.tree_map(lambda a: a[0], layers)
+    assert isinstance(lp["wq"], QuantWeight)
+    assert lp["wq"].q.shape == (4, 6)
+    assert lp["wq"].scale.shape == (6,)
+
+    @jax.jit
+    def f(tree, x):
+        # per-layer slice inside jit, as the decoder's decode loop does
+        lp0 = jax.tree_util.tree_map(lambda a: a[1], tree)
+        return mm(x, lp0["wq"])
+
+    out = f(layers, jnp.ones((3, 4)))
+    assert out.shape == (3, 6)
+
+    # lax.scan over the stacked tree (the training path's layer scan)
+    def body(x, lp):
+        return x, mm(x, lp["wq"])
+
+    _, ys = jax.lax.scan(body, jnp.ones((5, 4)), layers)
+    assert ys.shape == (2, 5, 6)
+
+
+@pytest.fixture(scope="module")
+def tiny_and_quant():
+    cfg = decoder.get_config("tiny")
+    params = decoder.init_params(jax.random.PRNGKey(0), cfg)
+    return cfg, params, quantize_params(params)
+
+
+def test_quantized_forward_logits_close(tiny_and_quant):
+    """End-to-end decoder forward: int8 logits within a small normalized RMS
+    error of bf16 logits (weight-only quant, ~0.5% expected)."""
+    cfg, params, qparams = tiny_and_quant
+    ids = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0, cfg.vocab_size)
+    pos = jnp.broadcast_to(jnp.arange(16), (2, 16))
+    mask = jnp.ones((2, 16))
+    ref, _ = decoder.forward(params, cfg, ids, pos, mask)
+    got, _ = decoder.forward(qparams, cfg, ids, pos, mask)
+    ref = np.asarray(ref, np.float32)
+    got = np.asarray(got, np.float32)
+    nrmse = np.sqrt(np.mean((ref - got) ** 2)) / (np.std(ref) + 1e-9)
+    assert nrmse < 0.05, f"quantized logits NRMSE {nrmse:.4f}"
+
+
+def test_quantized_decode_cache_path(tiny_and_quant):
+    """The unrolled KV-cache decode path traces with QuantWeight params."""
+    cfg, _, qparams = tiny_and_quant
+    cache = decoder.make_cache(cfg, 1, 32)
+    ids = jnp.array([[5, 7, 9]])
+    pos = jnp.arange(3)[None]
+    mask = (jnp.arange(32) < 3).astype(jnp.float32)[None]
+    logits, new_cache = decoder.forward(qparams, cfg, ids, pos, mask,
+                                        cache=cache, write_idx=0)
+    assert logits.shape == (1, 3, cfg.vocab_size)
+    assert new_cache[0].shape == cache[0].shape
+
+
+def test_cb_engine_quantized_generate(tiny_and_quant):
+    """CBEngine serves with a quantized param tree; hot-swap keeps working."""
+    from polyrl_tpu.rollout.cb_engine import CBEngine
+    from polyrl_tpu.rollout.sampling import SamplingParams
+
+    cfg, _, qparams = tiny_and_quant
+    engine = CBEngine(cfg, qparams, pad_token_id=0, max_slots=4, page_size=8,
+                      max_seq_len=64, prompt_buckets=(8,), num_pages=64)
+    try:
+        sp = SamplingParams(temperature=0.0, max_new_tokens=6,
+                            stop_token_ids=())
+        outs = engine.generate([[1, 2, 3, 4]], sp, timeout=120.0)
+        assert len(outs) == 1
+        assert len(outs[0]["token_ids"]) == 6
+        # atomic swap with a re-quantized tree (same structure, no retrace)
+        engine.update_weights(qparams, version=2)
+        outs = engine.generate([[4, 3, 2, 1]], sp, timeout=120.0)
+        assert len(outs[0]["token_ids"]) == 6
+    finally:
+        engine.stop()
+
+
+def test_init_quantized_params_structure_matches():
+    """init_quantized_params (device-side 8B bench path) produces exactly the
+    structure quantize_params(init_params) produces."""
+    cfg = decoder.get_config("tiny")
+    a = quantize_params(decoder.init_params(jax.random.PRNGKey(0), cfg))
+    b = init_quantized_params(jax.random.PRNGKey(0), cfg)
+    ta = jax.tree_util.tree_structure(a)
+    tb = jax.tree_util.tree_structure(b)
+    assert ta == tb
+    for la, lb in zip(jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)):
+        assert la.shape == lb.shape, (la.shape, lb.shape)
+        assert la.dtype == lb.dtype, (la.dtype, lb.dtype)
+
+
+def test_quant_param_specs_structure():
+    cfg = decoder.get_config("llama3-8b")  # untied head → lm_head present
+    specs = quant_param_specs(decoder.param_specs(cfg))
+    qparams_shape = jax.eval_shape(
+        lambda: quantize_params(decoder.init_params(jax.random.PRNGKey(0),
+                                                    cfg)))
+    assert (jax.tree_util.tree_structure(specs)
+            == jax.tree_util.tree_structure(qparams_shape))
+    assert isinstance(specs["layers"]["wq"], QuantWeight)
+    assert isinstance(specs["lm_head"], QuantWeight)
+
+
+def test_server_hot_swap_requantizes_bf16_wire(tiny_and_quant):
+    """The wire stays bf16 (trainer layout); the server re-quantizes each
+    push before the device swap (serve.py weight_template/weight_preprocess
+    contract)."""
+    from polyrl_tpu.rollout.cb_engine import CBEngine
+    from polyrl_tpu.rollout.sampling import SamplingParams
+    from polyrl_tpu.rollout.server import RolloutServer
+    from polyrl_tpu.transfer.layout import (
+        alloc_buffer, build_layout, pack_params,
+    )
+
+    cfg, params, qparams = tiny_and_quant
+    engine = CBEngine(cfg, qparams, pad_token_id=0, max_slots=4, page_size=8,
+                      max_seq_len=64, prompt_buckets=(8,), num_pages=64)
+    server = RolloutServer(engine, host="127.0.0.1", port=0)
+    server.weight_template = jax.eval_shape(lambda p: p, params)
+    server.weight_preprocess = quantize_params
+
+    # fake receiver: the bf16 tree packed into a layout buffer, as the
+    # trainer-side sender would have produced it
+    new_bf16 = jax.tree_util.tree_map(lambda a: a * 2.0, params)
+    layout = build_layout(params)
+    buf = alloc_buffer(layout)
+    pack_params(new_bf16, layout, buf)
+
+    class FakeRx:
+        def __init__(self):
+            self.buffer, self.layout = buf, layout
+
+        def wait_for_version(self, v, timeout=0.0):
+            return None
+
+        def stop(self):
+            pass
+
+    server.receiver = FakeRx()
+    try:
+        server.start()
+        ok, err = server.update_weights_from_agent(3)
+        assert ok, err
+        assert engine.weight_version == 3
+        got = engine.params["layers"]["wq"]
+        assert isinstance(got, QuantWeight)
+        want = quantize_tensor(np.asarray(jax.device_get(new_bf16["layers"]["wq"]),
+                                          dtype=np.float32), contract_axis=-2)
+        np.testing.assert_array_equal(np.asarray(got.q), np.asarray(want.q))
+        sp = SamplingParams(temperature=0.0, max_new_tokens=4, stop_token_ids=())
+        outs = engine.generate([[1, 2, 3, 4]], sp, timeout=120.0)
+        assert len(outs[0]["token_ids"]) == 4
+    finally:
+        server.stop()
+
+
+def test_update_weights_structure_guard(tiny_and_quant):
+    """A bf16 tree pushed into a quantized engine must fail loudly — the
+    silent alternative retraces every compiled step against unquantized
+    weights (double HBM; OOM at 8B scale)."""
+    from polyrl_tpu.rollout.cb_engine import CBEngine
+
+    cfg, params, qparams = tiny_and_quant
+    engine = CBEngine(cfg, qparams, pad_token_id=0, max_slots=4, page_size=8,
+                      max_seq_len=64, prompt_buckets=(8,), num_pages=64)
+    try:
+        with pytest.raises(ValueError, match="structure mismatch"):
+            engine.update_weights(params, version=9)
+        assert engine.weight_version != 9  # swap rejected atomically
+    finally:
+        engine.stop()
+
+
+def test_hf_load_quantized(tmp_path):
+    """quantize='int8' loads an HF checkpoint with host-side quantization:
+    QuantWeight leaves on device, logits close to the full-precision load."""
+    transformers = pytest.importorskip("transformers")
+    torch = pytest.importorskip("torch")
+    del torch, transformers
+    from tests.test_hf_loader import _save_tiny_hf
+
+    from polyrl_tpu.models.hf_loader import config_from_hf, load_hf_params
+
+    _, ckpt = _save_tiny_hf(tmp_path, "llama")
+    cfg = config_from_hf(ckpt, dtype=jnp.float32)
+    ref = load_hf_params(ckpt, cfg)
+    qp = load_hf_params(ckpt, cfg, quantize="int8")
+    assert isinstance(qp["layers"]["wq"], QuantWeight)
+    assert isinstance(qp["lm_head"], QuantWeight)
+    assert qp["layers"]["wq"].q.dtype == jnp.int8
+    ids = jnp.arange(12)[None] % cfg.vocab_size
+    pos = jnp.arange(12)[None]
+    mask = jnp.ones((1, 12))
+    a, _ = decoder.forward(ref, cfg, ids, pos, mask)
+    b, _ = decoder.forward(qp, cfg, ids, pos, mask)
+    a, b = np.asarray(a, np.float32), np.asarray(b, np.float32)
+    nrmse = np.sqrt(np.mean((a - b) ** 2)) / (np.std(a) + 1e-9)
+    assert nrmse < 0.05, nrmse
