@@ -129,19 +129,19 @@ def bench_cb(cfg, params, batch, prompt_len, new_tokens, max_slots=64,
     sp = SamplingParams(temperature=1.0, max_new_tokens=new_tokens,
                         stop_token_ids=())
 
-    # compile warmup: every admission-wave size bucket (1, 2, 4, 8), the
-    # suffix (prefix-hit) prefill, and the decode step — serving arrivals
-    # trickle, so mid-phase wave sizes vary and an uncompiled bucket would
-    # eat ~15 s of the timed window. Warmup uses its OWN prompts and the
-    # prefix cache is flushed afterwards so no phase hits another's pages.
+    # deterministic precompile of every admission bucket + decode variant
+    # (engine.warmup drives each compiled fn against the sink row — the
+    # generate-based warmup fragmented into prefix-cache suffix hits and
+    # left batch buckets uncompiled, putting ~15 s XLA compiles in the
+    # timed window), then one tiny generate for the suffix path +
+    # end-to-end sanity.
+    engine.warmup()
     warm_prompts = [rng.integers(1, cfg.vocab_size, prompt_len).tolist()
-                    for _ in range(8)]
+                    for _ in range(2)]
     warm_sp = SamplingParams(temperature=1.0, max_new_tokens=8,
                              stop_token_ids=())
-    for w in (1, 2, 4, 8):
-        engine.generate(warm_prompts[:w], warm_sp, timeout=600.0)
+    engine.generate(warm_prompts, warm_sp, timeout=600.0)
     engine.generate([warm_prompts[0]], warm_sp, timeout=600.0)  # suffix path
-    engine.generate(warm_prompts[:8], sp, timeout=600.0)
     engine.flush_prefix_cache()
 
     # direct (no HTTP): device + scheduler, no dispatch layer
@@ -294,12 +294,12 @@ def bench_8b_int8(cfg, batch=16, prompt_len=128, new_tokens=128):
                    for _ in range(batch)]
         sp = SamplingParams(temperature=1.0, max_new_tokens=new_tokens,
                             stop_token_ids=())
+        engine.warmup(filter_variants=(False,))  # temp-only sampling below
         warm = [rng.integers(1, cfg.vocab_size, prompt_len).tolist()
-                for _ in range(8)]
+                for _ in range(2)]
         warm_sp = SamplingParams(temperature=1.0, max_new_tokens=8,
                                  stop_token_ids=())
-        for w in (1, 2, 4, 8):
-            engine.generate(warm[:w], warm_sp, timeout=1200.0)
+        engine.generate(warm, warm_sp, timeout=1200.0)
         engine.flush_prefix_cache()
         t0 = time.monotonic()
         outs = engine.generate(prompts, sp, timeout=2400.0)
@@ -345,6 +345,7 @@ def bench_8b(preset: str):
                                f"{hbm_gb:.1f} GiB HBM (8B_FEASIBILITY.md)")
         return out
     engine = params = None
+    oom_note = None
     try:
         params = jax.jit(lambda: decoder.init_params(jax.random.PRNGKey(0),
                                                      cfg))()
@@ -369,22 +370,29 @@ def bench_8b(preset: str):
                 "batch": batch, "hbm_gb": round(hbm_gb, 1)}
     except Exception as exc:  # noqa: BLE001 — device OOM IS the measurement
         msg = str(exc)
-        if "memory" not in msg.lower():
+        # TPU OOM surfaces as RESOURCE_EXHAUSTED (allocation-time) or an
+        # "Out of memory"/hbm message (compile-time); both mean bf16 no-fit
+        if ("memory" not in msg.lower()
+                and "resource_exhausted" not in msg.lower()
+                and "resourceexhausted" not in msg.lower()):
             raise
-        # memory_stats() is unavailable through the TPU tunnel (hbm_gb=0
-        # skips the pre-gate), so the compile-time OOM is the bf16 fit
-        # result — fall back to the int8 quantized engine for a real number
         import re
 
         m = re.search(r"Used ([0-9.]+)G of ([0-9.]+)G hbm", msg)
         used, limit = (m.group(1), m.group(2)) if m else ("?", "?")
-        # free the ~16 GiB bf16 attempt before the int8 engine allocates
-        engine = params = None  # noqa: F841 — drop device buffer refs
-        gc.collect()
-        out = bench_8b_int8(cfg)
-        out["bf16_skipped"] = (f"bf16 decode OOM: needs {used} GiB, chip "
-                               f"HBM {limit} GiB (8B_FEASIBILITY.md)")
-        return out
+        oom_note = (f"bf16 decode OOM: needs {used} GiB, chip "
+                    f"HBM {limit} GiB (8B_FEASIBILITY.md)")
+        # the int8 fallback must run OUTSIDE this handler: exc.__traceback__
+        # pins the engine/params frames (≈16 GiB of device buffers) until
+        # the except block exits, and the int8 init needs that HBM back
+    # memory_stats() is unavailable through the TPU tunnel (hbm_gb=0 skips
+    # the pre-gate), so the OOM above is the bf16 fit result — fall back to
+    # the int8 quantized engine for a real number
+    engine = params = None  # noqa: F841 — drop device buffer refs
+    gc.collect()
+    out = bench_8b_int8(cfg)
+    out["bf16_skipped"] = oom_note
+    return out
 
 
 def main() -> None:

@@ -86,7 +86,7 @@ class ParallelSection:
     tp: int = 1
     sp: int = 1
     pp: int = 1                           # config surface only (mesh.py guard)
-    ep: int = 1                           # config surface only
+    ep: int = 1                           # expert parallel (MoE expert axis)
     # sequence-parallel attention flavor when sp > 1 (parallel/sequence.py):
     # ulysses (head all-to-all) | ring (KV ppermute) | dense (GSPMD decides)
     sp_mode: str = "ulysses"

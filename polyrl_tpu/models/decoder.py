@@ -32,7 +32,7 @@ from jax.sharding import PartitionSpec as P
 
 from polyrl_tpu.models.quant import mm, unembed
 from polyrl_tpu.ops.attention import attention, causal_mask
-from polyrl_tpu.parallel.mesh import DP, FSDP, SP, TP
+from polyrl_tpu.parallel.mesh import DP, EP, FSDP, SP, TP
 
 
 @dataclasses.dataclass(frozen=True)
@@ -62,6 +62,21 @@ class ModelConfig:
     use_qk_norm: bool = False  # Qwen3
     attention_bias: bool = False  # Qwen2/2.5 family (qkv projection bias)
     max_position_embeddings: int = 131072
+    # MoE (Qwen3-MoE / Mixtral-class): num_experts > 0 replaces every
+    # layer's dense MLP with a routed mixture (softmax-over-all-experts
+    # top-k routing, HF Qwen3MoeSparseMoeBlock semantics). Dispatch is
+    # GShard-style fixed-capacity einsum (static shapes for the MXU);
+    # moe_capacity_factor sizes the per-expert buffer — tokens routed past
+    # capacity drop that expert contribution (standard GShard behavior).
+    num_experts: int = 0
+    num_experts_per_tok: int = 8
+    moe_intermediate_size: int = 0
+    norm_topk_prob: bool = True
+    moe_capacity_factor: float = 2.0
+    # tokens per routing group (GShard-style): capacity is per-group, so
+    # dispatch/combine memory is O(N·E·k·cf/E·g)= linear in N instead of
+    # O(N²). 0 → min(N, 512).
+    moe_group_size: int = 0
     dtype: Any = jnp.bfloat16
 
     @property
@@ -125,6 +140,20 @@ PRESETS: dict[str, ModelConfig] = {
                                  high_freq_factor=4.0,
                                  original_max_position_embeddings=8192),
     ),
+    # test-size MoE model (Qwen3-MoE architecture)
+    "moe-tiny": ModelConfig(
+        vocab_size=512, hidden_size=64, intermediate_size=128, num_layers=2,
+        num_heads=4, num_kv_heads=2, rope_theta=10000.0,
+        max_position_embeddings=512, use_qk_norm=True,
+        num_experts=4, num_experts_per_tok=2, moe_intermediate_size=96,
+    ),
+    # Qwen3-30B-A3B (HF config: Qwen/Qwen3-30B-A3B — 128 experts, top-8)
+    "qwen3-30b-a3b": ModelConfig(
+        vocab_size=151936, hidden_size=2048, intermediate_size=6144,
+        num_layers=48, num_heads=32, num_kv_heads=4, head_dim=128,
+        rope_theta=1000000.0, use_qk_norm=True,
+        num_experts=128, num_experts_per_tok=8, moe_intermediate_size=768,
+    ),
 }
 
 
@@ -146,6 +175,21 @@ def init_params(rng: jax.Array, cfg: ModelConfig) -> dict:
     def norm(key, *shape):
         return (jax.random.normal(key, shape, dtype=jnp.float32) * std).astype(cfg.dtype)
 
+    if cfg.num_experts:
+        fe = cfg.moe_intermediate_size
+        mlp = {
+            "router": norm(keys[5], L, d, cfg.num_experts),
+            "we_gate": norm(keys[6], L, cfg.num_experts, d, fe),
+            "we_up": norm(jax.random.fold_in(keys[6], 1), L,
+                          cfg.num_experts, d, fe),
+            "we_down": norm(keys[7], L, cfg.num_experts, fe, d),
+        }
+    else:
+        mlp = {
+            "w_gate": norm(keys[5], L, d, f),
+            "w_up": norm(keys[6], L, d, f),
+            "w_down": norm(keys[7], L, f, d),
+        }
     params = {
         "embed": norm(keys[0], cfg.vocab_size, d),
         "final_norm": jnp.ones((d,), dtype=cfg.dtype),
@@ -156,9 +200,7 @@ def init_params(rng: jax.Array, cfg: ModelConfig) -> dict:
             "wk": norm(keys[2], L, d, hkv * hd),
             "wv": norm(keys[3], L, d, hkv * hd),
             "wo": norm(keys[4], L, hq * hd, d),
-            "w_gate": norm(keys[5], L, d, f),
-            "w_up": norm(keys[6], L, d, f),
-            "w_down": norm(keys[7], L, f, d),
+            **mlp,
         },
     }
     if cfg.use_qk_norm:
@@ -182,10 +224,24 @@ def param_specs(cfg: ModelConfig) -> dict:
         "wk": P(None, FSDP, TP),
         "wv": P(None, FSDP, TP),
         "wo": P(None, TP, FSDP),
-        "w_gate": P(None, FSDP, TP),
-        "w_up": P(None, FSDP, TP),
-        "w_down": P(None, TP, FSDP),
     }
+    if cfg.num_experts:
+        # experts shard over ep (the REAL expert axis — beyond the
+        # reference's stubbed EP config, SURVEY.md §2.3); within each
+        # expert the FFN shards like the dense MLP (fsdp × tp). GSPMD
+        # derives the token dispatch/combine all-to-alls from these specs.
+        layer.update({
+            "router": P(None, FSDP, None),
+            "we_gate": P(None, EP, FSDP, TP),
+            "we_up": P(None, EP, FSDP, TP),
+            "we_down": P(None, EP, TP, FSDP),
+        })
+    else:
+        layer.update({
+            "w_gate": P(None, FSDP, TP),
+            "w_up": P(None, FSDP, TP),
+            "w_down": P(None, TP, FSDP),
+        })
     if cfg.use_qk_norm:
         layer["q_norm"] = P(None, None)
         layer["k_norm"] = P(None, None)
@@ -258,10 +314,103 @@ def apply_rope(x: jnp.ndarray, cos: jnp.ndarray, sin: jnp.ndarray) -> jnp.ndarra
     return jnp.concatenate([out1, out2], axis=-1).astype(x.dtype)
 
 
+# -- MoE MLP ----------------------------------------------------------------
+
+
+def _moe_mlp(cfg: ModelConfig, x: jnp.ndarray, lp: dict,
+             valid: jnp.ndarray | None = None) -> jnp.ndarray:
+    """Routed mixture MLP on flattened tokens ``x`` [N, d] → [N, d].
+
+    Routing follows HF Qwen3MoeSparseMoeBlock: softmax over ALL experts,
+    top-k, optional renormalization of the k probabilities. Dispatch is
+    GShard-style fixed capacity with TOKEN GROUPS: tokens are split into
+    groups of ``moe_group_size`` and every expert processes
+    ``C = ceil(k·g·capacity_factor / E)`` slots PER GROUP (static shapes —
+    the TPU requirement). Grouping keeps dispatch/combine memory linear in
+    N (the ungrouped [N, E, ceil(k·N·cf/E)] tensor is quadratic — a 4k-long
+    MoE prefill would OOM), exactly GShard's motivation. Everything is
+    batched einsums over the stacked expert weights [E, d, f] so the MXU
+    sees large batched matmuls, not E small ones.
+
+    ``valid`` [N] masks tokens out of routing entirely (bucket padding,
+    inactive decode slots): without it, pad tokens — which all embed
+    identically and therefore all route to the SAME experts — fill those
+    experts' capacity ahead of later real tokens. Tokens routed to a full
+    expert lose that expert's contribution (standard GShard dropping;
+    capacity_factor ≥ E/k disables dropping exactly, which the HF-parity
+    test uses)."""
+    n, d = x.shape
+    e, k = cfg.num_experts, cfg.num_experts_per_tok
+    g = min(cfg.moe_group_size or 512, n)
+    n_pad = -(-n // g) * g
+    ng = n_pad // g
+
+    if valid is None:
+        valid = jnp.ones((n,), jnp.float32)
+    else:
+        valid = valid.astype(jnp.float32)
+    x_p = jnp.pad(x, ((0, n_pad - n), (0, 0))) if n_pad != n else x
+    valid = (jnp.pad(valid, (0, n_pad - n)) if n_pad != n else valid)
+
+    router_logits = mm(x_p, lp["router"]).astype(jnp.float32)     # [Np, E]
+    probs = jax.nn.softmax(router_logits, axis=-1)
+    top_p, top_i = jax.lax.top_k(probs, k)                        # [Np, k]
+    if cfg.norm_topk_prob:
+        top_p = top_p / jnp.sum(top_p, axis=-1, keepdims=True)
+
+    cap = int(np.ceil(k * g * cfg.moe_capacity_factor / e))
+    cap = max(1, min(cap, g))
+
+    # slot assignment per group, token-major order (earlier tokens win
+    # capacity; within a token its higher-probability choice wins — top_k
+    # returns descending, so flattening [g, k] row-major preserves both)
+    flat_e = top_i.reshape(ng, g * k)                             # [G, g·k]
+    vk = jnp.repeat(valid.reshape(ng, g), k, axis=1)              # [G, g·k]
+    e_onehot = jax.nn.one_hot(flat_e, e, dtype=jnp.float32) * vk[:, :, None]
+    pos_in_e = jnp.cumsum(e_onehot, axis=1) - e_onehot            # [G, g·k, E]
+    pos = jnp.take_along_axis(pos_in_e, flat_e[:, :, None], axis=2)[:, :, 0]
+    keep = (pos < cap) * vk                                       # [G, g·k]
+    cap_oh = jax.nn.one_hot(pos.astype(jnp.int32), cap,
+                            dtype=jnp.float32) * keep[:, :, None]
+
+    # dispatch/combine [G, g, E, cap]: contract the k choices inside the
+    # einsum — the [G, g, k, E, cap] product never materializes
+    eo = e_onehot.reshape(ng, g, k, e)
+    co = cap_oh.reshape(ng, g, k, cap)
+    dispatch = jnp.einsum("gtke,gtkc->gtec", eo, co).astype(x.dtype)
+    combine = jnp.einsum("gtke,gtkc,gtk->gtec", eo, co,
+                         top_p.reshape(ng, g, k)).astype(jnp.float32)
+
+    xg = x_p.reshape(ng, g, d)
+    xe = jnp.einsum("gtd,gtec->gecd", xg, dispatch)               # [G, E, cap, d]
+    gate = jax.nn.silu(jnp.einsum("gecd,edf->gecf", xe, lp["we_gate"]
+                                  ).astype(jnp.float32)).astype(x.dtype)
+    up = jnp.einsum("gecd,edf->gecf", xe, lp["we_up"])
+    ye = jnp.einsum("gecf,efd->gecd", gate * up, lp["we_down"])   # [G, E, cap, d]
+    out = jnp.einsum("gecd,gtec->gtd", ye.astype(jnp.float32), combine)
+    return out.reshape(n_pad, d)[:n].astype(x.dtype)
+
+
+def _mlp_block(cfg: ModelConfig, h: jnp.ndarray, lp: dict,
+               valid: jnp.ndarray | None = None) -> jnp.ndarray:
+    """Post-norm MLP: dense SwiGLU, or the routed mixture when the config
+    is MoE. ``h`` is [..., d]; MoE flattens leading dims into one token
+    axis (routing is per-token, layout-independent). ``valid`` matches
+    ``h``'s leading dims and keeps padding/inactive tokens from consuming
+    expert capacity."""
+    if cfg.num_experts:
+        shape = h.shape
+        v = valid.reshape(-1) if valid is not None else None
+        return _moe_mlp(cfg, h.reshape(-1, shape[-1]), lp, v).reshape(shape)
+    gate = jax.nn.silu(mm(h, lp["w_gate"]).astype(jnp.float32)).astype(h.dtype)
+    return mm(gate * mm(h, lp["w_up"]), lp["w_down"])
+
+
 # -- forward ----------------------------------------------------------------
 
 
-def _layer_forward(cfg, x, lp, cos, sin, mask, layer_cache, attn_fn=None):
+def _layer_forward(cfg, x, lp, cos, sin, mask, layer_cache, attn_fn=None,
+                   token_valid=None):
     """One decoder layer. layer_cache: None or (k_cache, v_cache) [B, S, Hkv, D]
     already containing past KV; this layer writes its new KV at write_idx.
     ``attn_fn``: optional sequence-parallel attention (Ulysses/ring,
@@ -299,9 +448,7 @@ def _layer_forward(cfg, x, lp, cos, sin, mask, layer_cache, attn_fn=None):
     x = x + attn_out
 
     h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
-    gate = jax.nn.silu(mm(h, lp["w_gate"]).astype(jnp.float32)).astype(h.dtype)
-    mlp_out = mm(gate * mm(h, lp["w_up"]), lp["w_down"])
-    x = x + mlp_out
+    x = x + _mlp_block(cfg, h, lp, token_valid)
     return x, new_cache
 
 
@@ -352,10 +499,11 @@ def forward(
         layer_attn = None
         if attn_fn is not None:
             layer_attn = lambda q, k, v: attn_fn(q, k, v, attn_mask)  # noqa: E731
+        tok_valid = attn_mask > 0  # [B, T] — MoE routing skips pad tokens
 
         def body(x, lp):
             x, _ = _layer_forward(cfg, x, lp, cos, sin, mask, None,
-                                  attn_fn=layer_attn)
+                                  attn_fn=layer_attn, token_valid=tok_valid)
             return x, None
         if remat:
             body = jax.checkpoint(body)
@@ -374,6 +522,10 @@ def forward(
         b = x.shape[0]
         t_chunk = x.shape[1]
         hq, hkv, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim_
+        # chunk validity from the cache-slot mask (the chunk occupies slots
+        # [write_idx, write_idx+t)): keeps MoE routing off padded tokens
+        chunk_valid = jax.lax.dynamic_slice_in_dim(
+            attn_mask, write_idx, t_chunk, axis=1) > 0
         for l in range(n_layers):
             lp = jax.tree_util.tree_map(lambda a: a[l], layers)
             h = rms_norm(x, lp["attn_norm"], cfg.rms_norm_eps)
@@ -395,8 +547,7 @@ def forward(
             attn_out = attention(q, k_cache[l], v_cache[l], mask=mask)
             x = x + mm(attn_out.reshape(b, t_chunk, hq * hd), lp["wo"])
             h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
-            gate = jax.nn.silu(mm(h, lp["w_gate"]).astype(jnp.float32)).astype(h.dtype)
-            x = x + mm(gate * mm(h, lp["w_up"]), lp["w_down"])
+            x = x + _mlp_block(cfg, h, lp, chunk_valid)
         new_cache = (k_cache, v_cache)
 
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
@@ -533,8 +684,9 @@ def forward_paged_decode(
                            attn_lens)  # [S, Hq, D]
         x = x + mm(attn_out.reshape(s, hq * hd), lp["wo"])
         h = rms_norm(x, lp["mlp_norm"], cfg.rms_norm_eps)
-        gate = jax.nn.silu(mm(h, lp["w_gate"]).astype(jnp.float32)).astype(h.dtype)
-        x = x + mm(gate * mm(h, lp["w_up"]), lp["w_down"])
+        # inactive slots route nowhere (their pad rows would otherwise fill
+        # the experts real slots route to)
+        x = x + _mlp_block(cfg, h, lp, active)
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
     head = params["embed"].T if cfg.tie_word_embeddings else params["lm_head"]
     return unembed(x, head, "sd,dv->sv"), (tuple(k_pools), tuple(v_pools))

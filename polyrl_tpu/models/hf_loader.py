@@ -18,6 +18,9 @@ Mapping (HF name → pytree path):
   (transposed: HF Linear stores [out, in], the decoder matmuls x @ W)
 - model.layers.{i}.mlp.{gate,up,down}_proj  → layers.w_{gate,up,down}[i]
 - model.layers.{i}.self_attn.{q,k}_norm     → layers.{q,k}_norm[i] (Qwen3)
+- model.layers.{i}.mlp.gate.weight          → layers.router[i] (Qwen3-MoE)
+- model.layers.{i}.mlp.experts.{j}.{gate,up,down}_proj
+                                            → layers.we_{gate,up,down}[i, j]
 
 Per-layer tensors are stacked along a leading L axis to match the scan
 layout. Loading streams one safetensors shard at a time (file mmap via
@@ -51,6 +54,12 @@ _LAYER_MAP = {
     "self_attn.v_proj.bias": "bv",
 }
 _TRANSPOSED = {"wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down"}
+# Qwen3-MoE expert tensors: model.layers.{i}.mlp.experts.{j}.<proj>
+_EXPERT_MAP = {
+    "gate_proj.weight": "we_gate",
+    "up_proj.weight": "we_up",
+    "down_proj.weight": "we_down",
+}
 
 
 def _shard_files(ckpt_dir: str) -> list[str]:
@@ -83,11 +92,24 @@ def config_from_hf(ckpt_dir: str, dtype=jnp.bfloat16) -> decoder.ModelConfig:
         # frequencies would be quietly wrong at long context
         raise NotImplementedError(
             f"rope_scaling type {rs_type!r} is not supported (llama3 only)")
+    moe: dict = {}
+    if hf.get("num_experts"):  # Qwen3-MoE family
+        if hf.get("mlp_only_layers") or (hf.get("decoder_sparse_step", 1) != 1):
+            raise NotImplementedError(
+                "mixed dense/MoE layer stacks are not supported (uniform "
+                "MoE keeps the scan-over-layers body a single trace)")
+        moe = dict(
+            num_experts=hf["num_experts"],
+            num_experts_per_tok=hf.get("num_experts_per_tok", 8),
+            moe_intermediate_size=hf["moe_intermediate_size"],
+            norm_topk_prob=bool(hf.get("norm_topk_prob", True)),
+        )
     return decoder.ModelConfig(
         vocab_size=hf["vocab_size"],
         hidden_size=hf["hidden_size"],
         intermediate_size=hf["intermediate_size"],
         num_layers=hf["num_hidden_layers"],
+        **moe,
         num_heads=hf["num_attention_heads"],
         num_kv_heads=hf.get("num_key_value_heads", hf["num_attention_heads"]),
         head_dim=hf.get("head_dim"),
@@ -126,8 +148,10 @@ def load_hf_params(ckpt_dir: str, cfg: decoder.ModelConfig | None = None,
     np_dtype = jnp.dtype(dtype)
     L = cfg.num_layers
 
+    E = cfg.num_experts
     flat: dict[str, np.ndarray] = {}
     layer_parts: dict[str, list] = {}
+    expert_parts: dict[str, list] = {}  # key → [L][E] grid
     for path in _shard_files(ckpt_dir):
         with safe_open(path, framework="np") as f:
             for name in f.keys():
@@ -141,12 +165,24 @@ def load_hf_params(ckpt_dir: str, cfg: decoder.ModelConfig | None = None,
                 elif name.startswith("model.layers."):
                     rest = name.split(".", 2)[2]          # "{i}.suffix"
                     idx_s, suffix = rest.split(".", 1)
-                    key = _LAYER_MAP.get(suffix)
-                    if key is None:
-                        raise KeyError(f"unmapped HF layer tensor {name}")
-                    if key in _TRANSPOSED:
-                        t = t.T                            # [out,in] → [in,out]
-                    layer_parts.setdefault(key, [None] * L)[int(idx_s)] = t
+                    if suffix == "mlp.gate.weight":       # MoE router
+                        layer_parts.setdefault("router", [None] * L)[
+                            int(idx_s)] = t.T             # [E, D] → [D, E]
+                    elif suffix.startswith("mlp.experts."):
+                        j_s, proj = suffix.split(".", 3)[2:]
+                        key = _EXPERT_MAP.get(proj)
+                        if key is None:
+                            raise KeyError(f"unmapped HF expert tensor {name}")
+                        grid = expert_parts.setdefault(
+                            key, [[None] * E for _ in range(L)])
+                        grid[int(idx_s)][int(j_s)] = t.T  # [out,in] → [in,out]
+                    else:
+                        key = _LAYER_MAP.get(suffix)
+                        if key is None:
+                            raise KeyError(f"unmapped HF layer tensor {name}")
+                        if key in _TRANSPOSED:
+                            t = t.T                        # [out,in] → [in,out]
+                        layer_parts.setdefault(key, [None] * L)[int(idx_s)] = t
                 else:
                     raise KeyError(f"unmapped HF tensor {name}")
 
@@ -163,6 +199,17 @@ def load_hf_params(ckpt_dir: str, cfg: decoder.ModelConfig | None = None,
                                       scale=jnp.asarray(qw.scale))
         else:
             layers[key] = jnp.asarray(stacked, np_dtype)
+    for key in list(expert_parts):
+        grid = expert_parts.pop(key)  # [L][E] → [L, E, in, out]
+        missing = [(i, j) for i in range(L) for j in range(E)
+                   if grid[i][j] is None]
+        if missing:
+            raise ValueError(f"expert tensors missing for {key}: "
+                             f"{missing[:8]}")
+        # experts stay unquantized (quantize_params contract: their
+        # batched-einsum path does not route through mm)
+        layers[key] = jnp.asarray(
+            np.stack([np.stack(row) for row in grid]), np_dtype)
 
     params = {
         "embed": jnp.asarray(flat["embed"], np_dtype),

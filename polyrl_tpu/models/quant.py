@@ -115,13 +115,16 @@ def unembed(x, head, eq: str):
 
 def quantize_params(params: dict) -> dict:
     """Quantize a decoder param tree's matmul weights (layer-stacked QKVO +
-    MLP and the untied lm_head); embed/norms/biases stay in model dtype.
-    Accepts device (jax) or host (numpy) trees — each leaf quantizes with
-    its own backend."""
+    dense MLP and the untied lm_head); embed/norms/biases stay in model
+    dtype. MoE expert weights (we_gate/we_up/we_down) are left unquantized
+    — their batched-einsum path does not route through ``mm`` — so MoE
+    models quantize attention + head only. Accepts device (jax) or host
+    (numpy) trees — each leaf quantizes with its own backend."""
     out = dict(params)
     layers = dict(params["layers"])
     for k in QUANTIZED_LAYER_KEYS:
-        layers[k] = quantize_tensor(layers[k], contract_axis=-2)
+        if k in layers:  # dense MLP keys absent on MoE models
+            layers[k] = quantize_tensor(layers[k], contract_axis=-2)
     out["layers"] = layers
     if "lm_head" in params:
         out["lm_head"] = quantize_tensor(params["lm_head"], contract_axis=0)
@@ -152,7 +155,11 @@ def init_quantized_params(rng: jax.Array, cfg) -> dict:
     8B-int8 model can be built on a 16 GiB chip (bench path; real serving
     quantizes loaded checkpoints instead). Peak transient = one bf16 leaf
     (≤3.8 GiB for llama3-8b w_gate) + its int8 copy. Mirrors the structure
-    of ``decoder.init_params``."""
+    of ``decoder.init_params`` (dense models only)."""
+    if getattr(cfg, "num_experts", 0):
+        raise NotImplementedError(
+            "init_quantized_params supports dense models only; quantize a "
+            "loaded MoE tree via quantize_params (experts stay bf16)")
     hd = cfg.head_dim_
     d, f, L = cfg.hidden_size, cfg.intermediate_size, cfg.num_layers
     hq, hkv = cfg.num_heads, cfg.num_kv_heads

@@ -3,17 +3,20 @@
 TPU-native equivalent of the reference's parallelism inventory (SURVEY.md
 §2.3). The reference composes FSDP sharding + rollout dp×infer_tp×infer_pp
 meshes (``stream_fsdp_workers.py:126-135``) + Ulysses SP; here all of it is
-one ``jax.sharding.Mesh`` with four logical axes:
+one ``jax.sharding.Mesh`` with five logical axes:
 
 - ``dp``    data parallel (batch dim)
 - ``fsdp``  ZeRO-style parameter sharding (combines with dp for the batch)
 - ``tp``    tensor/model parallel (MXU-dim sharding, rides ICI)
 - ``sp``    sequence/context parallel (Ulysses all-to-all or ring attention)
+- ``ep``    expert parallel (MoE expert dim; GSPMD inserts the dispatch/
+            combine all-to-alls from the einsum shardings)
 
-Training batches shard over (dp, fsdp); params shard over (fsdp, tp);
-sequence dim over sp. XLA inserts the collectives (GSPMD), so FSDP
-all-gather/reduce-scatter and the TP broadcast of the reference's NCCL world
-disappear into the compiled program.
+Training batches shard over (dp, fsdp); params shard over (fsdp, tp) with
+MoE expert weights additionally over ep; sequence dim over sp. XLA inserts
+the collectives (GSPMD), so FSDP all-gather/reduce-scatter and the TP
+broadcast of the reference's NCCL world disappear into the compiled
+program.
 """
 
 from __future__ import annotations
@@ -25,8 +28,8 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-DP, FSDP, TP, SP = "dp", "fsdp", "tp", "sp"
-AXES = (DP, FSDP, TP, SP)
+DP, FSDP, TP, SP, EP = "dp", "fsdp", "tp", "sp", "ep"
+AXES = (DP, FSDP, TP, SP, EP)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -35,30 +38,27 @@ class MeshConfig:
     fsdp: int = -1  # -1: absorb remaining devices
     tp: int = 1
     sp: int = 1
-    # Pipeline / expert parallelism: config surface only, matching the
-    # reference's depth — it exposes infer_pp / expert-parallel knobs in its
-    # rollout config but never executes them either
-    # (workers/config/rollout.py:132-134,193-202). On TPU both would be
-    # mesh axes (pp: stage-sharded layer stack via shard_map+ppermute
-    # microbatching; ep: expert axis with all_to_all dispatch); neither is
-    # needed for the reference's supported model families, so use sites
-    # raise until an implementation lands.
+    # Pipeline parallelism: config surface only, matching the reference's
+    # depth — it exposes infer_pp in its rollout config but never executes
+    # it either (workers/config/rollout.py:132-134,198-202). On TPU it
+    # would be a mesh axis (stage-sharded layer stack via shard_map +
+    # ppermute microbatching); not needed for the reference's supported
+    # model families, so use sites raise until an implementation lands.
     pp: int = 1
+    # Expert parallelism: a REAL axis (beyond the reference, which stubs
+    # expert knobs at workers/config/rollout.py:193-196) — MoE expert
+    # weights shard over it (models/decoder.py MoE param specs) and GSPMD
+    # derives the dispatch/combine all-to-alls from the einsum shardings.
     ep: int = 1
 
-    def resolve(self, n_devices: int) -> tuple[int, int, int, int]:
+    def resolve(self, n_devices: int) -> tuple[int, int, int, int, int]:
         if self.pp != 1:
             raise NotImplementedError(
                 "pipeline parallelism (pp) is config-surface only — the "
                 "reference exposes but does not execute infer_pp either "
                 "(workers/config/rollout.py:132-134); shard layers over "
                 "fsdp/tp instead")
-        if self.ep != 1:
-            raise NotImplementedError(
-                "expert parallelism (ep) is config-surface only — no MoE "
-                "model family is implemented (reference parity: expert "
-                "knobs stubbed at workers/config/rollout.py:193-202)")
-        dims = [self.dp, self.fsdp, self.tp, self.sp]
+        dims = [self.dp, self.fsdp, self.tp, self.sp, self.ep]
         fixed = 1
         for d in dims:
             if d != -1:
@@ -73,10 +73,10 @@ class MeshConfig:
 
 
 def make_mesh(config: MeshConfig | None = None, devices: Sequence[jax.Device] | None = None) -> Mesh:
-    """Build the 4-axis training/rollout mesh.
+    """Build the 5-axis training/rollout mesh.
 
-    Axis order is (dp, fsdp, tp, sp) outermost→innermost so tp (the
-    latency-critical axis) lands on the innermost, fastest ICI ring.
+    Axis order is (dp, fsdp, tp, sp, ep) outermost→innermost so tp/ep (the
+    latency-critical axes) land on the innermost, fastest ICI rings.
     """
     devices = list(devices if devices is not None else jax.devices())
     config = config or MeshConfig()
@@ -87,7 +87,7 @@ def make_mesh(config: MeshConfig | None = None, devices: Sequence[jax.Device] | 
 
 def single_device_mesh(device: jax.Device | None = None) -> Mesh:
     dev = device if device is not None else jax.devices()[0]
-    return Mesh(np.array([dev]).reshape(1, 1, 1, 1), AXES)
+    return Mesh(np.array([dev]).reshape(1, 1, 1, 1, 1), AXES)
 
 
 # -- canonical partition specs --------------------------------------------

@@ -185,6 +185,23 @@ class CBEngine:
         self.last_gen_throughput = 0.0
         self.total_tokens_served = 0
         self._tok_window: collections.deque = collections.deque(maxlen=64)
+        # POLYRL_CB_TRACE=1: cumulative wall per engine phase (dispatch vs
+        # fetch vs prefill vs host bookkeeping) — the serving-path analogue
+        # of the trainer's marked_timer spans (SURVEY.md §5.1)
+        import os as _os
+
+        self._trace: dict | None = (collections.defaultdict(float)
+                                    if _os.environ.get("POLYRL_CB_TRACE")
+                                    else None)
+
+    def trace_report(self) -> dict:
+        """Cumulative seconds per phase (POLYRL_CB_TRACE=1), else empty."""
+        return dict(self._trace or {})
+
+    def _tmark(self, key: str, t0: float) -> None:
+        if self._trace is not None:
+            self._trace[key] += time.monotonic() - t0
+            self._trace["n_" + key] += 1
 
     # -- compiled pieces ----------------------------------------------------
 
@@ -401,6 +418,74 @@ class CBEngine:
             self._prefill_fns[key] = jax.jit(prefill, donate_argnums=(1, 2))
         return self._prefill_fns[key]
 
+    def _sink_pad_row(self, pb: int) -> np.ndarray:
+        """A packed prefill row targeting the SINK state row (index
+        max_slots): budget 0 → immediately done/inactive, pages all null.
+        Used for wave padding and warmup — a duplicated REAL row would
+        scatter a conflicting sampled token into the real slot's
+        last_tokens/active."""
+        pad_sp = SamplingParams(temperature=1.0, top_p=1.0, top_k=0,
+                                max_new_tokens=0, stop_token_ids=())
+        return self._pack_prefill(
+            np.full((pb,), self.pad_token_id, np.int32),
+            np.zeros((pb // self.page_size,), np.int32),
+            np.zeros((self.pages_per_slot,), np.int32),
+            np.full((MAX_STOP_TOKENS,), -1, np.int32),
+            np.zeros((0,), np.int32),
+            1, 0, self.max_slots, 0, pad_sp)
+
+    def warmup(self, batch_sizes=(2, 4, 8), filter_variants=(False, True),
+               ) -> None:
+        """Precompile every admission + decode dispatch variant
+        deterministically, before serving traffic.
+
+        Generate-based warmup ("run a few requests first") is unreliable:
+        submission trickle and prefix-cache hits fragment admission waves,
+        so the larger batch-prefill buckets may never compile during
+        warmup — and then a multi-second XLA compile lands inside the
+        first real serving burst (observed: ~17 s per bucket for an 8B
+        model). This drives each compiled variant once with dummy rows
+        targeting the SINK state row (slot index max_slots, null pages) —
+        the same mechanism wave padding uses — so pools/state stay valid.
+        """
+        with self._pool_lock:
+            self._ensure_dev_state()
+            for pb in self.prompt_buckets:
+                base = self._sink_pad_row(pb)
+                for uf in filter_variants:
+                    self._warm_call(self._get_prefill(pb, uf),
+                                    jnp.asarray(base))
+                    for nb in batch_sizes:
+                        self._warm_call(
+                            self._get_prefill_batch(pb, nb, uf),
+                            jnp.asarray(np.stack([base] * nb)))
+            for uf in filter_variants:
+                st = self._dev_state
+                fn = self._get_step(uf, self.steps_per_dispatch)
+                t0 = time.monotonic()
+                (kp, vp, self._rng, _t, _l, _d, st["seq_lens"],
+                 st["last_tokens"], st["n_generated"], st["active"]) = fn(
+                    self.params, self._pools[0], self._pools[1], self._rng,
+                    st["page_table"], st["seq_lens"], st["last_tokens"],
+                    st["n_generated"], st["budgets"], st["active"],
+                    st["temps"], st["top_ps"], st["top_ks"],
+                    st["stop_table"])
+                self._pools = (kp, vp)
+                self._tmark("warmup_step", t0)
+            jax.block_until_ready(self._pools[0][0])
+
+    def _warm_call(self, fn, packed_dev) -> None:
+        """One discarded dispatch of a prefill variant against the sink row
+        (pools donated in, updated pools threaded back)."""
+        state_kwargs = {k: self._dev_state[k] for k in self._STATE_KEYS}
+        t0 = time.monotonic()
+        kp, vp, self._rng, _t, _l, _d, new_st = fn(
+            self.params, self._pools[0], self._pools[1], packed_dev,
+            self._rng, **state_kwargs)
+        self._tmark("warmup_prefill", t0)
+        self._pools = (kp, vp)
+        self._dev_state = new_st
+
     # -- submission API (server-facing) -------------------------------------
 
     def submit(self, rid: str, input_ids: list[int], sampling: SamplingParams,
@@ -552,11 +637,13 @@ class CBEngine:
             if not wave:
                 break
             try:
+                t0 = time.monotonic()
                 if len(wave) == 1:
                     req, slot, pages, budget, mp, me = wave[0]
                     self._prefill_request(slot, req, pages, budget, mp, me)
                 else:
                     self._prefill_wave(wave)
+                self._tmark("prefill_dispatch", t0)
             except Exception:
                 for req, _slot, pages, _b, _mp, me in wave:
                     self.allocator.free(pages)
@@ -673,19 +760,7 @@ class CBEngine:
             metas.append((req, slot, pages, budget, row, stops))
         nb = next_bucket(len(wave), (2, 4, 8))
         if len(rows_np) < nb:
-            # padding rows target the SINK state row (index max_slots):
-            # budget 0 → immediately done/inactive, pages all null — a
-            # duplicated REAL row would scatter a conflicting sampled token
-            # into the real slot's last_tokens/active
-            pad_sp = SamplingParams(temperature=1.0, top_p=1.0, top_k=0,
-                                    max_new_tokens=0, stop_token_ids=())
-            pad_row = self._pack_prefill(
-                np.full((pb,), self.pad_token_id, np.int32),
-                np.zeros((pb // self.page_size,), np.int32),
-                np.zeros((self.pages_per_slot,), np.int32),
-                np.full((MAX_STOP_TOKENS,), -1, np.int32),
-                np.zeros((0,), np.int32),
-                1, 0, self.max_slots, 0, pad_sp)
+            pad_row = self._sink_pad_row(pb)
             while len(rows_np) < nb:
                 rows_np.append(pad_row)
         fn = self._get_prefill_batch(pb, nb, use_filters)
@@ -853,7 +928,9 @@ class CBEngine:
         entries = [self._emit_q.popleft() for _ in range(n)]
         # ONE batched transfer for every outstanding output (a device_get
         # per entry would serialize a tunnel round trip each)
+        t0 = time.monotonic()
         fetched = jax.device_get([e[1:4] for e in entries])
+        self._tmark("fetch", t0)
         for (kind, _t, _l, _d, tail), (token, logp, done) in zip(entries, fetched):
             if kind == "step":
                 self._emit_fetched(token, logp, done, tail)
@@ -960,15 +1037,19 @@ class CBEngine:
             return
         use_filters = bool(np.any(
             (self._top_ps[self._active] < 1.0) | (self._top_ks[self._active] > 0)))
+        t0 = time.monotonic()
         self._ensure_dev_state()
+        self._tmark("upload", t0)
         st = self._dev_state
         fn = self._get_step(use_filters, self.steps_per_dispatch)
+        t0 = time.monotonic()
         (kp, vp, self._rng, token, logp, done, st["seq_lens"],
          st["last_tokens"], st["n_generated"], st["active"]) = fn(
             self.params, self._pools[0], self._pools[1], self._rng,
             st["page_table"], st["seq_lens"], st["last_tokens"],
             st["n_generated"], st["budgets"], st["active"], st["temps"],
             st["top_ps"], st["top_ks"], st["stop_table"])
+        self._tmark("step_dispatch", t0)
         self._pools = (kp, vp)
         self._emit_q.append(("step", token, logp, done,
                              [(int(i), int(self._slot_gen[i]))

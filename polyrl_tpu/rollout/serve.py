@@ -37,7 +37,8 @@ def create_server(model: str, manager_endpoint: str | None = None,
                   max_seq_len: int = 16384,
                   num_pages: int | None = None,
                   steps_per_dispatch: int = 8,
-                  weight_quant: str = ""):
+                  weight_quant: str = "",
+                  warmup: bool = False):
     """Build engine + server, register with the manager, attach receiver.
 
     ``backend="cb"`` (default) serves with the paged continuous-batching
@@ -102,6 +103,11 @@ def create_server(model: str, manager_endpoint: str | None = None,
             kwargs["prompt_buckets"] = tuple(prompt_buckets)
         engine = RolloutEngine(cfg, params, pad_token_id=0,
                                kv_cache_dtype=getattr(jnp, dtype), **kwargs)
+    if warmup and backend == "cb":
+        # precompile every admission/decode bucket before the manager's
+        # health check promotes this instance (the reference leans on
+        # SGLang's own server warmup; here it's a first-class engine step)
+        engine.warmup()
     server = RolloutServer(engine, host=host, port=port,
                            advertise_host=advertise_host)
     server.weight_template = weight_template
@@ -163,6 +169,8 @@ def main() -> None:
                    help="fused decode steps per device dispatch")
     p.add_argument("--weight-quant", default="", choices=("", "int8"),
                    help="int8 = weight-only quantized serving")
+    p.add_argument("--warmup", action="store_true",
+                   help="precompile all admission/decode buckets at launch")
     args = p.parse_args()
 
     logging.basicConfig(level=logging.INFO)
@@ -174,7 +182,8 @@ def main() -> None:
                            page_size=args.page_size,
                            max_seq_len=args.max_seq_len,
                            steps_per_dispatch=args.steps_per_dispatch,
-                           weight_quant=args.weight_quant)
+                           weight_quant=args.weight_quant,
+                           warmup=args.warmup)
     log.info("rollout server on %s", server.endpoint)
     try:
         while True:

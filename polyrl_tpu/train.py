@@ -163,7 +163,7 @@ def _build_mesh(cfg: RunConfig):
     from polyrl_tpu.parallel import mesh as meshlib
 
     p = cfg.parallel
-    axes = (p.dp, p.fsdp, p.tp, p.sp)
+    axes = (p.dp, p.fsdp, p.tp, p.sp, p.ep)
     if jax.process_count() == 1 and all(a == 1 for a in axes):
         return None
     fsdp = p.fsdp

@@ -1,0 +1,272 @@
+"""MoE model family + real expert parallelism.
+
+The reference stubs expert-parallel config without executing it
+(reference workers/config/rollout.py:193-196); here MoE is implemented:
+Qwen3-MoE architecture (softmax-over-all top-k routing), GShard-style
+fixed-capacity einsum dispatch (static shapes for the MXU), and a real
+``ep`` mesh axis the expert weights shard over. Correctness anchor: logits
+parity against transformers' Qwen3MoeForCausalLM.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from polyrl_tpu.models import decoder
+from polyrl_tpu.models.decoder import _moe_mlp
+
+
+def _mk(cfg_overrides=None, seed=0):
+    cfg = decoder.get_config("moe-tiny", dtype=jnp.float32,
+                             **(cfg_overrides or {}))
+    params = decoder.init_params(jax.random.PRNGKey(seed), cfg)
+    return cfg, params
+
+
+def test_moe_router_selects_forced_expert():
+    """With a router that sends every token to expert 0 with certainty, the
+    MoE output equals expert 0's SwiGLU alone (gate weight 1 after top-k
+    renorm)."""
+    cfg, params = _mk()
+    lp = jax.tree_util.tree_map(lambda a: a[0], params["layers"])
+    d, e = cfg.hidden_size, cfg.num_experts
+    router = np.zeros((d, e), np.float32)
+    router[:, 0] = 0.0
+    lp = dict(lp)
+    # bias-free router: make expert 0 dominate by a column of large weights
+    # against a constant input
+    router = np.full((d, e), -1.0, np.float32)
+    router[:, 0] = 1.0
+    lp["router"] = jnp.asarray(router)
+    x = jnp.ones((3, d), jnp.float32) * 0.1
+
+    out = _moe_mlp(cfg, x, lp)
+    w_g = lp["we_gate"][0]
+    w_u = lp["we_up"][0]
+    w_d = lp["we_down"][0]
+    gate = jax.nn.silu(x @ w_g)
+    want_e0 = (gate * (x @ w_u)) @ w_d
+    # k=2: second expert also contributes; force k=1 to isolate (capacity
+    # E/k so all-tokens-to-one-expert doesn't drop: cap = N)
+    cfg1 = dataclasses.replace(cfg, num_experts_per_tok=1,
+                               moe_capacity_factor=float(cfg.num_experts))
+    out1 = _moe_mlp(cfg1, x, lp)
+    np.testing.assert_allclose(np.asarray(out1), np.asarray(want_e0),
+                               rtol=1e-5, atol=1e-6)
+    assert out.shape == out1.shape
+
+
+def test_moe_capacity_drops_overflow_tokens():
+    """Tokens routed past an expert's capacity lose that contribution
+    (GShard token dropping); earlier tokens win the slots."""
+    cfg, params = _mk()
+    lp = jax.tree_util.tree_map(lambda a: a[0], params["layers"])
+    d, e = cfg.hidden_size, cfg.num_experts
+    router = np.full((d, e), -1.0, np.float32)
+    router[:, 0] = 1.0  # every token → expert 0 (k=1)
+    lp = dict(lp)
+    lp["router"] = jnp.asarray(router)
+    cfg1 = dataclasses.replace(cfg, num_experts_per_tok=1,
+                               moe_capacity_factor=e / 8.0)  # cap = n/8
+    n = 8
+    x = jnp.ones((n, d), jnp.float32) * 0.1
+    out = _moe_mlp(cfg1, x, lp)
+    # cap = ceil(1·8·(4/8)/4) = 1 → only the first token gets expert 0
+    assert not np.allclose(np.asarray(out[0]), 0.0)
+    np.testing.assert_allclose(np.asarray(out[1:]), 0.0, atol=1e-7)
+
+
+def test_moe_forward_full_and_decode_paths():
+    """Training (scan) and decode (unrolled KV-cache) paths trace and agree
+    on the prefill prefix."""
+    cfg, params = _mk()
+    ids = jax.random.randint(jax.random.PRNGKey(1), (2, 10), 0,
+                             cfg.vocab_size)
+    pos = jnp.broadcast_to(jnp.arange(10), (2, 10))
+    mask = jnp.ones((2, 10))
+    full, _ = decoder.forward(params, cfg, ids, pos, mask)
+    assert full.shape == (2, 10, cfg.vocab_size)
+    assert np.all(np.isfinite(np.asarray(full)))
+
+    cache = decoder.make_cache(cfg, 2, 16)
+    cmask = (jnp.arange(16) < 10).astype(jnp.float32)[None].repeat(2, 0)
+    dec, _ = decoder.forward(params, cfg, ids, pos, cmask, cache=cache,
+                             write_idx=0)
+    np.testing.assert_allclose(np.asarray(dec), np.asarray(full),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_moe_grads_flow_including_router():
+    """Backprop through the remat'd scan path reaches router and expert
+    weights (the training path for RL fine-tuning of MoE)."""
+    cfg, params = _mk()
+    ids = jax.random.randint(jax.random.PRNGKey(2), (2, 8), 0, cfg.vocab_size)
+    pos = jnp.broadcast_to(jnp.arange(8), (2, 8))
+    mask = jnp.ones((2, 8))
+
+    def loss(p):
+        logits, _ = decoder.forward(p, cfg, ids, pos, mask, remat=True)
+        return jnp.mean(jax.nn.log_softmax(logits)[..., 0])
+
+    grads = jax.grad(loss)(params)
+    for key in ("router", "we_gate", "we_up", "we_down"):
+        g = np.asarray(grads["layers"][key])
+        assert np.all(np.isfinite(g))
+        assert np.abs(g).max() > 0.0, f"zero grad for {key}"
+
+
+@pytest.mark.parametrize("quant", [False, True])
+def test_moe_hf_logits_parity(tmp_path, quant):
+    """Logits parity against transformers Qwen3MoeForCausalLM (the MoE
+    correctness anchor). capacity_factor = E/k makes fixed-capacity
+    dispatch exact (no drops), matching HF's dropless loop."""
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+
+    from polyrl_tpu.models.hf_loader import config_from_hf, load_hf_params
+
+    hf_cfg = transformers.Qwen3MoeConfig(
+        vocab_size=128, hidden_size=32, intermediate_size=64,
+        moe_intermediate_size=48, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=2, head_dim=8,
+        num_experts=4, num_experts_per_tok=2, norm_topk_prob=True,
+        max_position_embeddings=256, rms_norm_eps=1e-6, rope_theta=10000.0,
+        tie_word_embeddings=False, decoder_sparse_step=1, mlp_only_layers=[],
+    )
+    torch.manual_seed(0)
+    model = transformers.AutoModelForCausalLM.from_config(hf_cfg).eval()
+    out_dir = tmp_path / "qwen3moe"
+    model.save_pretrained(out_dir, safe_serialization=True)
+
+    cfg = config_from_hf(str(out_dir), dtype=jnp.float32)
+    assert cfg.num_experts == 4 and cfg.num_experts_per_tok == 2
+    assert cfg.moe_intermediate_size == 48 and cfg.use_qk_norm
+    # exact dispatch: cap = ceil(k·N·(E/k)/E) = N
+    cfg = dataclasses.replace(cfg, moe_capacity_factor=cfg.num_experts
+                              / cfg.num_experts_per_tok)
+    params = load_hf_params(str(out_dir), cfg,
+                            quantize="int8" if quant else "")
+
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, cfg.vocab_size, (2, 12)).astype(np.int32)
+    with torch.no_grad():
+        want = model(torch.from_numpy(ids).long()).logits.numpy()
+    pos = np.broadcast_to(np.arange(12, dtype=np.int32), (2, 12))
+    mask = np.ones((2, 12), np.float32)
+    got, _ = decoder.forward(params, cfg, jnp.asarray(ids), jnp.asarray(pos),
+                             jnp.asarray(mask))
+    got = np.asarray(got)
+    if quant:
+        # int8 attention/head (experts stay full precision): statistical
+        # closeness, not elementwise parity
+        nrmse = np.sqrt(np.mean((got - want) ** 2)) / (np.std(want) + 1e-9)
+        assert nrmse < 0.05, nrmse
+    else:
+        np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+
+def test_moe_expert_parallel_mesh(devices8):
+    """The ep axis is REAL: expert weights placed over a dp1·fsdp2·tp1·ep2
+    mesh, forward jitted with GSPMD-inserted dispatch/combine collectives,
+    output matches the single-device forward."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from polyrl_tpu.parallel import mesh as meshlib
+
+    cfg, params = _mk()
+    mesh = meshlib.make_mesh(meshlib.MeshConfig(dp=1, fsdp=2, tp=2, ep=2),
+                             devices8)
+    specs = decoder.param_specs(cfg)
+    assert specs["layers"]["we_gate"] == P(None, meshlib.EP, meshlib.FSDP,
+                                           meshlib.TP)
+    sharded = meshlib.shard_params(mesh, params, specs)
+    we = sharded["layers"]["we_gate"]
+    assert we.sharding.spec == specs["layers"]["we_gate"]
+
+    ids = jax.random.randint(jax.random.PRNGKey(3), (2, 8), 0, cfg.vocab_size)
+    pos = jnp.broadcast_to(jnp.arange(8), (2, 8))
+    mask = jnp.ones((2, 8))
+    ref, _ = decoder.forward(params, cfg, ids, pos, mask)
+
+    @jax.jit
+    def fwd(p, i, po, m):
+        logits, _ = decoder.forward(p, cfg, i, po, m)
+        return logits
+
+    with mesh:
+        got = fwd(sharded,
+                  jax.device_put(ids, NamedSharding(mesh, P())),
+                  jax.device_put(pos, NamedSharding(mesh, P())),
+                  jax.device_put(mask, NamedSharding(mesh, P())))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_moe_cb_engine_decode():
+    """The production CB paged engine serves an MoE model (decode path
+    routes per-token through the experts)."""
+    from polyrl_tpu.rollout.cb_engine import CBEngine
+    from polyrl_tpu.rollout.sampling import SamplingParams
+
+    cfg, params = _mk()
+    engine = CBEngine(cfg, params, pad_token_id=0, max_slots=4, page_size=8,
+                      max_seq_len=64, prompt_buckets=(8,), num_pages=64)
+    try:
+        sp = SamplingParams(temperature=0.0, max_new_tokens=6,
+                            stop_token_ids=())
+        outs = engine.generate([[1, 2, 3, 4], [9, 8, 7]], sp, timeout=120.0)
+        assert all(len(o["token_ids"]) == 6 for o in outs)
+    finally:
+        engine.stop()
+
+
+def test_moe_quantize_params_skips_experts():
+    from polyrl_tpu.models.quant import QuantWeight, quantize_params
+
+    cfg, params = _mk()
+    qp = quantize_params(params)
+    assert isinstance(qp["layers"]["wq"], QuantWeight)
+    assert not isinstance(qp["layers"]["we_gate"], QuantWeight)
+    assert not isinstance(qp["layers"]["router"], QuantWeight)
+
+
+def test_moe_padding_does_not_consume_capacity():
+    """Pad tokens are masked out of routing entirely, so real-token logits
+    cannot depend on pad CONTENT. Without validity masking, pads route by
+    their (identical) embeddings and fill those experts' capacity ahead of
+    later real tokens — then changing pad ids changes which experts fill
+    and which real tokens get dropped."""
+    cfg, params = _mk({"moe_capacity_factor": 1.0})  # tight capacity
+    ids_real = jax.random.randint(jax.random.PRNGKey(5), (2, 6), 1,
+                                  cfg.vocab_size)
+    pad_a = jnp.zeros((2, 10), jnp.int32)
+    pad_b = jax.random.randint(jax.random.PRNGKey(7), (2, 10), 1,
+                               cfg.vocab_size)
+    pos = jnp.broadcast_to(jnp.arange(16), (2, 16))
+    mask = jnp.concatenate([jnp.ones((2, 6)), jnp.zeros((2, 10))], axis=1)
+    a, _ = decoder.forward(params, cfg,
+                           jnp.concatenate([ids_real, pad_a], axis=1),
+                           pos, mask)
+    b, _ = decoder.forward(params, cfg,
+                           jnp.concatenate([ids_real, pad_b], axis=1),
+                           pos, mask)
+    np.testing.assert_allclose(np.asarray(a[:, :6]), np.asarray(b[:, :6]),
+                               rtol=1e-6, atol=1e-7)
+
+
+def test_moe_grouped_matches_ungrouped():
+    """Token grouping (linear-memory dispatch) is numerically identical to
+    one big group when capacity never binds."""
+    cfg_big, params = _mk({"moe_capacity_factor": 2.0, "moe_group_size": 512})
+    cfg_small = dataclasses.replace(cfg_big, moe_group_size=4)
+    ids = jax.random.randint(jax.random.PRNGKey(6), (2, 12), 1,
+                             cfg_big.vocab_size)
+    pos = jnp.broadcast_to(jnp.arange(12), (2, 12))
+    mask = jnp.ones((2, 12))
+    a, _ = decoder.forward(params, cfg_big, ids, pos, mask)
+    b, _ = decoder.forward(params, cfg_small, ids, pos, mask)
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                               rtol=1e-5, atol=1e-6)

@@ -262,3 +262,28 @@ def test_hf_load_quantized(tmp_path):
     a, b = np.asarray(a, np.float32), np.asarray(b, np.float32)
     nrmse = np.sqrt(np.mean((a - b) ** 2)) / (np.std(a) + 1e-9)
     assert nrmse < 0.05, nrmse
+
+
+def test_cb_engine_warmup_precompiles(tiny_and_quant):
+    """warmup() populates every admission-bucket + step variant and leaves
+    the engine fully serviceable (pools/state valid, sink row inactive)."""
+    from polyrl_tpu.rollout.cb_engine import CBEngine
+    from polyrl_tpu.rollout.sampling import SamplingParams
+
+    cfg, _, qparams = tiny_and_quant
+    engine = CBEngine(cfg, qparams, pad_token_id=0, max_slots=4, page_size=8,
+                      max_seq_len=64, prompt_buckets=(8,), num_pages=64)
+    try:
+        engine.warmup()
+        keys = set(engine._prefill_fns)
+        assert (8, False) in keys and (8, True) in keys
+        for nb in (2, 4, 8):
+            assert ("batch", 8, nb, False) in keys, keys
+        assert set(engine._step_fns)  # both filter variants of the step
+        # engine still serves correctly after the discarded warm dispatches
+        sp = SamplingParams(temperature=0.0, max_new_tokens=5,
+                            stop_token_ids=())
+        outs = engine.generate([[1, 2, 3], [7, 6, 5]], sp, timeout=120.0)
+        assert all(len(o["token_ids"]) == 5 for o in outs)
+    finally:
+        engine.stop()
