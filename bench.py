@@ -133,9 +133,9 @@ def bench_cb(cfg, params, batch, prompt_len, new_tokens, max_slots=64,
     # (engine.warmup drives each compiled fn against the sink row — the
     # generate-based warmup fragmented into prefix-cache suffix hits and
     # left batch buckets uncompiled, putting ~15 s XLA compiles in the
-    # timed window), then one tiny generate for the suffix path +
-    # end-to-end sanity.
-    engine.warmup()
+    # timed window), then one tiny generate for end-to-end sanity. This
+    # bench samples temperature-only → only the no-filter variants run.
+    engine.warmup(filter_variants=(False,))
     warm_prompts = [rng.integers(1, cfg.vocab_size, prompt_len).tolist()
                     for _ in range(2)]
     warm_sp = SamplingParams(temperature=1.0, max_new_tokens=8,
@@ -264,7 +264,7 @@ def bench_weight_sync(params):
         sender.stop()
 
 
-def bench_8b_int8(cfg, batch=16, prompt_len=128, new_tokens=128):
+def bench_8b_int8(cfg, batch=64, prompt_len=128, new_tokens=128):
     """8B decode on ONE chip via int8 weight-only quantization
     (models/quant.py): matmul weights int8 + bf16 embed ≈ 8.6 GiB, fits a
     16 GiB chip. Measured on the production CB paged serving engine. The

@@ -3,12 +3,18 @@
 # launch_sglang.sh: weight-transfer agent on, manager registration).
 set -euo pipefail
 
-MODEL=${MODEL:-qwen3-1.7b}
+MODEL=${MODEL:-qwen3-1.7b}          # preset name or local HF checkpoint dir
 MANAGER=${MANAGER:?set MANAGER=<head-host>:<port>}
 PORT=${PORT:-30000}
+# WEIGHT_QUANT=int8 serves weight-only-quantized (8B-class fits a 16 GiB
+# chip; trainer pushes stay bf16 on the wire and re-quantize on arrival).
+# MODEL=qwen3-30b-a3b (or a Qwen3-MoE checkpoint dir) serves the MoE family.
+WEIGHT_QUANT=${WEIGHT_QUANT:-}
 
 python -m polyrl_tpu.rollout.serve \
     --model "$MODEL" \
     --manager-endpoint "$MANAGER" \
     --port "$PORT" \
+    --warmup \
+    ${WEIGHT_QUANT:+--weight-quant "$WEIGHT_QUANT"} \
     "$@"

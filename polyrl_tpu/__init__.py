@@ -2,7 +2,7 @@
 
 A from-scratch, TPU-first implementation of the capability set of
 Terra-Flux/PolyRL (disaggregated streaming PPO/GRPO for LLMs): JAX/pjit
-GSPMD training over a (dp, fsdp, tp, sp) mesh, a JAX inference engine for
+GSPMD training over a (dp, fsdp, tp, sp, ep) mesh, a JAX inference engine for
 rollout with per-token logprobs, an elastic rollout control plane with
 token-level fault-tolerant continuation, and a versioned trainer→rollout
 weight-transfer fabric. See SURVEY.md for the structural map of the

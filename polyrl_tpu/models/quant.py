@@ -140,6 +140,8 @@ def quant_param_specs(specs: dict) -> dict:
     out = dict(specs)
     layer = dict(specs["layers"])
     for k in QUANTIZED_LAYER_KEYS:
+        if k not in layer:  # dense MLP keys absent on MoE models
+            continue
         s = layer[k]  # P(layer, in, out)
         layer[k] = QuantWeight(q=s, scale=P(s[0], s[2]))
     out["layers"] = layer

@@ -418,12 +418,13 @@ class CBEngine:
             self._prefill_fns[key] = jax.jit(prefill, donate_argnums=(1, 2))
         return self._prefill_fns[key]
 
-    def _sink_pad_row(self, pb: int) -> np.ndarray:
+    def _sink_pad_row(self, pb: int, n_pre: int = 0) -> np.ndarray:
         """A packed prefill row targeting the SINK state row (index
         max_slots): budget 0 → immediately done/inactive, pages all null.
         Used for wave padding and warmup — a duplicated REAL row would
         scatter a conflicting sampled token into the real slot's
-        last_tokens/active."""
+        last_tokens/active. ``n_pre`` sizes the (null) prefix-page vector
+        for the suffix-prefill variants."""
         pad_sp = SamplingParams(temperature=1.0, top_p=1.0, top_k=0,
                                 max_new_tokens=0, stop_token_ids=())
         return self._pack_prefill(
@@ -431,11 +432,11 @@ class CBEngine:
             np.zeros((pb // self.page_size,), np.int32),
             np.zeros((self.pages_per_slot,), np.int32),
             np.full((MAX_STOP_TOKENS,), -1, np.int32),
-            np.zeros((0,), np.int32),
+            np.zeros((n_pre,), np.int32),
             1, 0, self.max_slots, 0, pad_sp)
 
     def warmup(self, batch_sizes=(2, 4, 8), filter_variants=(False, True),
-               ) -> None:
+               suffix: bool = True) -> None:
         """Precompile every admission + decode dispatch variant
         deterministically, before serving traffic.
 
@@ -459,6 +460,17 @@ class CBEngine:
                         self._warm_call(
                             self._get_prefill_batch(pb, nb, uf),
                             jnp.asarray(np.stack([base] * nb)))
+                    if suffix:
+                        # prefix-cache-hit variants: power-of-two prefix-
+                        # page buckets up to a full prompt's pages — the
+                        # second request of a shared-system-prompt workload
+                        # hits this path immediately
+                        n_pre = 1
+                        while n_pre <= max(1, pb // self.page_size):
+                            self._warm_call(
+                                self._get_prefill_suffix(pb, n_pre, uf),
+                                jnp.asarray(self._sink_pad_row(pb, n_pre)))
+                            n_pre *= 2
             for uf in filter_variants:
                 st = self._dev_state
                 fn = self._get_step(uf, self.steps_per_dispatch)

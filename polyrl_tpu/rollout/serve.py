@@ -171,6 +171,9 @@ def main() -> None:
                    help="int8 = weight-only quantized serving")
     p.add_argument("--warmup", action="store_true",
                    help="precompile all admission/decode buckets at launch")
+    p.add_argument("--prompt-buckets", type=int, nargs="+", default=None,
+                   help="prompt-length padding buckets (default "
+                        "128 256 512 1024 2048 4096)")
     args = p.parse_args()
 
     logging.basicConfig(level=logging.INFO)
@@ -183,7 +186,8 @@ def main() -> None:
                            max_seq_len=args.max_seq_len,
                            steps_per_dispatch=args.steps_per_dispatch,
                            weight_quant=args.weight_quant,
-                           warmup=args.warmup)
+                           warmup=args.warmup,
+                           prompt_buckets=args.prompt_buckets)
     log.info("rollout server on %s", server.endpoint)
     try:
         while True:

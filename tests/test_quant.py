@@ -287,3 +287,11 @@ def test_cb_engine_warmup_precompiles(tiny_and_quant):
         assert all(len(o["token_ids"]) == 5 for o in outs)
     finally:
         engine.stop()
+
+
+def test_quant_param_specs_moe_skips_dense_keys():
+    from polyrl_tpu.models.quant import quant_param_specs
+
+    cfg = decoder.get_config("moe-tiny")
+    specs = quant_param_specs(decoder.param_specs(cfg))  # must not KeyError
+    assert "we_gate" in specs["layers"] and "w_gate" not in specs["layers"]
