@@ -85,7 +85,8 @@ class ParallelSection:
     fsdp: int = 1                         # -1 absorbs remaining devices
     tp: int = 1
     sp: int = 1
-    pp: int = 1                           # config surface only (mesh.py guard)
+    pp: int = 1                           # pipeline parallel (layer stages)
+    pp_microbatches: int = 0              # GPipe microbatches (0 → 2·pp)
     ep: int = 1                           # expert parallel (MoE expert axis)
     # sequence-parallel attention flavor when sp > 1 (parallel/sequence.py):
     # ulysses (head all-to-all) | ring (KV ppermute) | dense (GSPMD decides)

@@ -463,6 +463,7 @@ def forward(
     remat: bool = False,
     attn_fn=None,                    # SP attention (parallel.sequence), no-cache path only
     logits_for: jnp.ndarray | None = None,  # [B] int32 — unembed only this position
+    layers_fn=None,                  # pipeline-parallel layer stack (parallel.pipeline)
 ) -> tuple[jnp.ndarray, tuple | None]:
     """Returns (logits [B, T, V] float32 — or [B, V] when ``logits_for`` is
     given — and new_cache or None).
@@ -478,8 +479,9 @@ def forward(
     cos, sin = rope_cos_sin(cfg, positions)
 
     if cache is None:
-        if attn_fn is not None:
-            mask = None  # SP attention builds causal+pad masks per block
+        if attn_fn is not None or layers_fn is not None:
+            # SP attention / the pipeline build causal+pad masks internally
+            mask = None
         else:
             # causal within the chunk + padding mask
             cm = causal_mask(t, t)  # [T, T]
@@ -496,19 +498,26 @@ def forward(
     layers = params["layers"]
 
     if cache is None:
-        layer_attn = None
-        if attn_fn is not None:
-            layer_attn = lambda q, k, v: attn_fn(q, k, v, attn_mask)  # noqa: E731
-        tok_valid = attn_mask > 0  # [B, T] — MoE routing skips pad tokens
+        if layers_fn is not None:
+            # pipeline-parallel stack (parallel.pipeline): the pipeline owns
+            # microbatching, masking, and remat for the layer loop
+            x = layers_fn(layers, x, cos, sin, attn_mask)
+            new_cache = None
+        else:
+            layer_attn = None
+            if attn_fn is not None:
+                layer_attn = lambda q, k, v: attn_fn(q, k, v, attn_mask)  # noqa: E731
+            tok_valid = attn_mask > 0  # [B, T] — MoE routing skips pads
 
-        def body(x, lp):
-            x, _ = _layer_forward(cfg, x, lp, cos, sin, mask, None,
-                                  attn_fn=layer_attn, token_valid=tok_valid)
-            return x, None
-        if remat:
-            body = jax.checkpoint(body)
-        x, _ = jax.lax.scan(body, x, layers)
-        new_cache = None
+            def body(x, lp):
+                x, _ = _layer_forward(cfg, x, lp, cos, sin, mask, None,
+                                      attn_fn=layer_attn,
+                                      token_valid=tok_valid)
+                return x, None
+            if remat:
+                body = jax.checkpoint(body)
+            x, _ = jax.lax.scan(body, x, layers)
+            new_cache = None
     else:
         # UNROLLED layer loop with single-token in-place cache writes.
         # A scan would force the cache through xs/ys (fresh stacked

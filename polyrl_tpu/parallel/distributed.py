@@ -69,10 +69,10 @@ def make_hybrid_mesh(dcn_dp: int | None = None,
         return meshlib.make_mesh(config)
     per_slice = jax.device_count() // dcn_dp
     cfg = config or meshlib.MeshConfig()
-    dp, fsdp, tp, sp, ep = cfg.resolve(per_slice)
+    dp, fsdp, tp, sp, ep, pp = cfg.resolve(per_slice)
     devices = mesh_utils.create_hybrid_device_mesh(
-        mesh_shape=(dp, fsdp, tp, sp, ep),
-        dcn_mesh_shape=(dcn_dp, 1, 1, 1, 1),
+        mesh_shape=(dp, fsdp, tp, sp, ep, pp),
+        dcn_mesh_shape=(dcn_dp, 1, 1, 1, 1, 1),
         devices=jax.devices(),
     )
     return jax.sharding.Mesh(devices, meshlib.AXES)

@@ -11,12 +11,14 @@ one ``jax.sharding.Mesh`` with five logical axes:
 - ``sp``    sequence/context parallel (Ulysses all-to-all or ring attention)
 - ``ep``    expert parallel (MoE expert dim; GSPMD inserts the dispatch/
             combine all-to-alls from the einsum shardings)
+- ``pp``    pipeline parallel (layer-stack stages; GPipe microbatch
+            schedule via shard_map + ppermute, parallel/pipeline.py)
 
 Training batches shard over (dp, fsdp); params shard over (fsdp, tp) with
-MoE expert weights additionally over ep; sequence dim over sp. XLA inserts
-the collectives (GSPMD), so FSDP all-gather/reduce-scatter and the TP
-broadcast of the reference's NCCL world disappear into the compiled
-program.
+MoE expert weights additionally over ep and the layer stack over pp;
+sequence dim over sp. XLA inserts the collectives (GSPMD), so FSDP
+all-gather/reduce-scatter and the TP broadcast of the reference's NCCL
+world disappear into the compiled program.
 """
 
 from __future__ import annotations
@@ -28,8 +30,8 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-DP, FSDP, TP, SP, EP = "dp", "fsdp", "tp", "sp", "ep"
-AXES = (DP, FSDP, TP, SP, EP)
+DP, FSDP, TP, SP, EP, PP = "dp", "fsdp", "tp", "sp", "ep", "pp"
+AXES = (DP, FSDP, TP, SP, EP, PP)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -38,12 +40,11 @@ class MeshConfig:
     fsdp: int = -1  # -1: absorb remaining devices
     tp: int = 1
     sp: int = 1
-    # Pipeline parallelism: config surface only, matching the reference's
-    # depth — it exposes infer_pp in its rollout config but never executes
-    # it either (workers/config/rollout.py:132-134,198-202). On TPU it
-    # would be a mesh axis (stage-sharded layer stack via shard_map +
-    # ppermute microbatching); not needed for the reference's supported
-    # model families, so use sites raise until an implementation lands.
+    # Pipeline parallelism: a REAL axis (beyond the reference, which only
+    # stubs infer_pp, workers/config/rollout.py:132-134,198-202) — the
+    # layer stack reshapes to [pp, L/pp, ...] sharded over it and runs the
+    # GPipe microbatch schedule (parallel/pipeline.py: shard_map +
+    # ppermute; autodiff through the permutes gives the backward schedule).
     pp: int = 1
     # Expert parallelism: a REAL axis (beyond the reference, which stubs
     # expert knobs at workers/config/rollout.py:193-196) — MoE expert
@@ -51,14 +52,8 @@ class MeshConfig:
     # derives the dispatch/combine all-to-alls from the einsum shardings.
     ep: int = 1
 
-    def resolve(self, n_devices: int) -> tuple[int, int, int, int, int]:
-        if self.pp != 1:
-            raise NotImplementedError(
-                "pipeline parallelism (pp) is config-surface only — the "
-                "reference exposes but does not execute infer_pp either "
-                "(workers/config/rollout.py:132-134); shard layers over "
-                "fsdp/tp instead")
-        dims = [self.dp, self.fsdp, self.tp, self.sp, self.ep]
+    def resolve(self, n_devices: int) -> tuple[int, int, int, int, int, int]:
+        dims = [self.dp, self.fsdp, self.tp, self.sp, self.ep, self.pp]
         fixed = 1
         for d in dims:
             if d != -1:
@@ -73,10 +68,12 @@ class MeshConfig:
 
 
 def make_mesh(config: MeshConfig | None = None, devices: Sequence[jax.Device] | None = None) -> Mesh:
-    """Build the 5-axis training/rollout mesh.
+    """Build the 6-axis training/rollout mesh.
 
-    Axis order is (dp, fsdp, tp, sp, ep) outermost→innermost so tp/ep (the
-    latency-critical axes) land on the innermost, fastest ICI rings.
+    Axis order is (dp, fsdp, tp, sp, ep, pp) — tp/ep (the latency-critical
+    axes) sit toward the innermost, fastest ICI rings; pipeline stages
+    communicate only once per microbatch step so pp tolerates the
+    outermost placement.
     """
     devices = list(devices if devices is not None else jax.devices())
     config = config or MeshConfig()
@@ -87,7 +84,7 @@ def make_mesh(config: MeshConfig | None = None, devices: Sequence[jax.Device] | 
 
 def single_device_mesh(device: jax.Device | None = None) -> Mesh:
     dev = device if device is not None else jax.devices()[0]
-    return Mesh(np.array([dev]).reshape(1, 1, 1, 1, 1), AXES)
+    return Mesh(np.array([dev]).reshape(1, 1, 1, 1, 1, 1), AXES)
 
 
 # -- canonical partition specs --------------------------------------------

@@ -163,7 +163,7 @@ def _build_mesh(cfg: RunConfig):
     from polyrl_tpu.parallel import mesh as meshlib
 
     p = cfg.parallel
-    axes = (p.dp, p.fsdp, p.tp, p.sp, p.ep)
+    axes = (p.dp, p.fsdp, p.tp, p.sp, p.ep, p.pp)
     if jax.process_count() == 1 and all(a == 1 for a in axes):
         return None
     fsdp = p.fsdp
@@ -224,6 +224,36 @@ def build_trainer(cfg: RunConfig, cleanup: list | None = None):
                 f"by sp ({sp}); use sp_mode=ring or a different sp")
         attn_fn = make_sp_attention(mesh, cfg.parallel.sp_mode)
 
+    layers_fn = None
+    critic_layers_fn = None
+    if mesh is not None and mesh.shape.get("pp", 1) > 1:
+        # pipeline-parallel layer stack (parallel/pipeline.py): validate the
+        # combination up front, same rationale as the SP block above
+        from polyrl_tpu.parallel.pipeline import make_pipeline_layers_fn
+
+        pp = mesh.shape["pp"]
+        if cfg.trainer.use_remove_padding:
+            raise NotImplementedError(
+                "use_remove_padding with parallel.pp > 1 is not supported — "
+                "the packed passes run their own segment-id flash attention, "
+                "which the pipeline stages do not thread through")
+        if attn_fn is not None:
+            raise NotImplementedError(
+                "parallel.sp > 1 with parallel.pp > 1 is not supported: "
+                "pipeline stages compute dense masked attention internally")
+        n_micro = cfg.parallel.pp_microbatches or 2 * pp
+        if cfg.trainer.micro_batch_size % n_micro != 0:
+            # not strictly required (the pipeline pads ragged feeds), but a
+            # micro size that never fills the microbatches wastes the whole
+            # configured pipeline width every step — treat as a config error
+            raise ValueError(
+                f"micro_batch_size {cfg.trainer.micro_batch_size} not "
+                f"divisible by pp_microbatches {n_micro}")
+        layers_fn = make_pipeline_layers_fn(mesh, mcfg, n_micro,
+                                            remat=cfg.actor.remat)
+        critic_layers_fn = make_pipeline_layers_fn(mesh, mcfg, n_micro,
+                                                   remat=cfg.critic.remat)
+
     if multihost.is_main():
         rollout = _build_rollout(cfg, mcfg, params, tokenizer, cleanup)
     else:
@@ -241,14 +271,15 @@ def build_trainer(cfg: RunConfig, cleanup: list | None = None):
     loader = PromptDataLoader(dataset, cfg.trainer.train_batch_size,
                               shuffle=cfg.data.shuffle, seed=cfg.data.seed)
 
-    actor = StreamActor(mcfg, cfg.actor, params, mesh=mesh, attn_fn=attn_fn)
+    actor = StreamActor(mcfg, cfg.actor, params, mesh=mesh, attn_fn=attn_fn,
+                        layers_fn=layers_fn)
     critic = None
     if cfg.trainer.adv_estimator == "gae":
         import jax
 
         critic = StreamCritic(mcfg, cfg.critic, init_critic_params(
             jax.random.PRNGKey(cfg.trainer.seed + 1), mcfg), mesh=mesh,
-            attn_fn=attn_fn)
+            attn_fn=attn_fn, layers_fn=critic_layers_fn)
     # ReferencePolicy stays mesh-FREE deliberately: its params are a local
     # replicated copy and its feeds arrive as host numpy on every process —
     # a mesh-bound shard_map attn_fn would drag the global mesh into a

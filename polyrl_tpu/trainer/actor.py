@@ -86,12 +86,14 @@ def default_train_attention():
 
 def _model_logprobs_entropy(params, model_cfg, input_ids, positions, attn_mask,
                             responses, response_mask, remat, compute_entropy,
-                            attn_fn=None):
+                            attn_fn=None, layers_fn=None):
     """Forward over [B, T_total]; logprobs of response tokens [B, T_resp].
     ``attn_fn``: optional sequence-parallel attention (Ulysses/ring) for
-    long-context training (SURVEY §5.7)."""
+    long-context training (SURVEY §5.7). ``layers_fn``: optional
+    pipeline-parallel layer stack (parallel.pipeline)."""
     logits, _ = decoder.forward(params, model_cfg, input_ids, positions,
-                                attn_mask, remat=remat, attn_fn=attn_fn)
+                                attn_mask, remat=remat, attn_fn=attn_fn,
+                                layers_fn=layers_fn)
     t_resp = responses.shape[1]
     # logits at position i predict token i+1: responses occupy the last
     # t_resp positions of input_ids, so their predictors are shifted one left.
@@ -162,11 +164,13 @@ class StreamActor:
         params: Any,
         mesh=None,
         attn_fn=None,
+        layers_fn=None,
     ):
         self.model_cfg = model_cfg
         self.cfg = cfg
         self.mesh = mesh
         self.attn_fn = attn_fn if attn_fn is not None else default_train_attention()
+        self.layers_fn = layers_fn  # pipeline-parallel layer stack (pp > 1)
         if mesh is not None:
             # GSPMD entry: params shard over (fsdp, tp) per decoder.param_specs
             # and every feed shards over the batch spec (see update_stream);
@@ -237,6 +241,7 @@ class StreamActor:
                 batch["input_ids"], batch["positions"], batch["attention_mask"],
                 batch["responses"], batch["response_mask"],
                 cfg.remat, cfg.entropy_coeff != 0.0, attn_fn=self.attn_fn,
+                layers_fn=self.layers_fn,
             )
         loss_fn = core_algos.get_policy_loss_fn(cfg.policy_loss)
         pg_loss, clipfrac, approx_kl, clipfrac_lower = loss_fn(
@@ -347,7 +352,7 @@ class StreamActor:
             self._logprob_fns[compute_entropy] = jax.jit(
                 partial(_model_logprobs_entropy, remat=False,
                         compute_entropy=compute_entropy,
-                        attn_fn=self.attn_fn),
+                        attn_fn=self.attn_fn, layers_fn=self.layers_fn),
                 static_argnums=(1,),
             )
         return self._logprob_fns[compute_entropy](

@@ -50,7 +50,7 @@ def critic_param_specs(model_cfg: decoder.ModelConfig) -> dict:
 
 
 def forward_values(params, model_cfg, input_ids, positions, attn_mask, responses,
-                   remat, attn_fn=None):
+                   remat, attn_fn=None, layers_fn=None):
     """Token values for the response region [B, T_resp] (f32)."""
     # trunk forward: reuse decoder but skip the LM head by computing
     # hidden states via a value-head projection on the normed trunk output.
@@ -61,7 +61,8 @@ def forward_values(params, model_cfg, input_ids, positions, attn_mask, responses
     value_params["lm_head"] = head
     cfg = dataclasses.replace(model_cfg, tie_word_embeddings=False)
     values, _ = decoder.forward(value_params, cfg, input_ids, positions,
-                                attn_mask, remat=remat, attn_fn=attn_fn)
+                                attn_mask, remat=remat, attn_fn=attn_fn,
+                                layers_fn=layers_fn)
     t_resp = responses.shape[1]
     return values[:, -t_resp - 1 : -1, 0].astype(jnp.float32)
 
@@ -94,13 +95,14 @@ def forward_values_packed(params, model_cfg, input_ids, positions, attn_mask,
 
 class StreamCritic:
     def __init__(self, model_cfg: decoder.ModelConfig, cfg: CriticConfig,
-                 params: Any, mesh=None, attn_fn=None):
+                 params: Any, mesh=None, attn_fn=None, layers_fn=None):
         from polyrl_tpu.trainer.actor import default_train_attention
 
         self.model_cfg = model_cfg
         self.cfg = cfg
         self.mesh = mesh
         self.attn_fn = attn_fn if attn_fn is not None else default_train_attention()
+        self.layers_fn = layers_fn  # pipeline-parallel layer stack (pp > 1)
         if mesh is not None:
             # backbone leaves follow decoder.param_specs; critic-only leaves
             # (the [D, 1] value head) fall back to replicated
@@ -132,7 +134,7 @@ class StreamCritic:
             vpreds = forward_values(
                 params, self.model_cfg, batch["input_ids"], batch["positions"],
                 batch["attention_mask"], batch["responses"], self.cfg.remat,
-                attn_fn=self.attn_fn,
+                attn_fn=self.attn_fn, layers_fn=self.layers_fn,
             )
             mask = batch["response_mask"]
         vf_loss, clipfrac = core_algos.compute_value_loss(
@@ -208,7 +210,7 @@ class StreamCritic:
                 lambda p, b: forward_values(
                     p, self.model_cfg, b["input_ids"], b["positions"],
                     b["attention_mask"], b["responses"], False,
-                    attn_fn=self.attn_fn,
+                    attn_fn=self.attn_fn, layers_fn=self.layers_fn,
                 )
             )
         return self._value_fn(self.params, batch)

@@ -1,0 +1,146 @@
+"""Pipeline parallelism (parallel/pipeline.py): GPipe schedule over the
+``pp`` mesh axis — a REAL execution mode, beyond the reference's stubbed
+``infer_pp`` (workers/config/rollout.py:132-134,198-202).
+
+Correctness anchor: the pipelined layer stack must match the plain
+scan-over-layers forward bit-for-tolerance, and grads must match through
+the transposed ppermute schedule.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from polyrl_tpu.models import decoder
+from polyrl_tpu.parallel import mesh as meshlib
+from polyrl_tpu.parallel.pipeline import make_pipeline_layers_fn
+
+
+@pytest.fixture(scope="module")
+def pp_mesh(devices8):
+    return meshlib.make_mesh(meshlib.MeshConfig(dp=1, fsdp=2, tp=2, pp=2),
+                             devices8)
+
+
+def _setup(dtype=jnp.float32):
+    cfg = decoder.get_config("tiny", dtype=dtype)  # 2 layers → 1 per stage
+    params = decoder.init_params(jax.random.PRNGKey(0), cfg)
+    ids = jax.random.randint(jax.random.PRNGKey(1), (4, 12), 1,
+                             cfg.vocab_size)
+    pos = jnp.broadcast_to(jnp.arange(12), (4, 12))
+    mask = jnp.ones((4, 12))
+    return cfg, params, ids, pos, mask
+
+
+def test_pipeline_forward_matches_scan(pp_mesh):
+    cfg, params, ids, pos, mask = _setup()
+    ref, _ = decoder.forward(params, cfg, ids, pos, mask)
+    layers_fn = make_pipeline_layers_fn(pp_mesh, cfg, num_microbatches=2)
+
+    @jax.jit
+    def fwd(p):
+        logits, _ = decoder.forward(p, cfg, ids, pos, mask,
+                                    layers_fn=layers_fn)
+        return logits
+
+    with pp_mesh:
+        got = fwd(params)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_pipeline_respects_padding_mask(pp_mesh):
+    """Right-padded batch: real-position logits match the scan path (the
+    pipeline rebuilds causal+pad masks per microbatch)."""
+    cfg, params, ids, pos, _ = _setup()
+    mask = jnp.concatenate([jnp.ones((4, 8)), jnp.zeros((4, 4))], axis=1)
+    ref, _ = decoder.forward(params, cfg, ids, pos, mask)
+    layers_fn = make_pipeline_layers_fn(pp_mesh, cfg, num_microbatches=2)
+    with pp_mesh:
+        got, _ = jax.jit(lambda p: decoder.forward(
+            p, cfg, ids, pos, mask, layers_fn=layers_fn))(params)
+    np.testing.assert_allclose(np.asarray(got[:, :8]), np.asarray(ref[:, :8]),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_pipeline_grads_match_scan(pp_mesh):
+    """Backward through the rotating ppermute schedule: grads equal the
+    plain scan's grads (autodiff transposes the pipeline)."""
+    cfg, params, ids, pos, mask = _setup()
+
+    def loss_scan(p):
+        logits, _ = decoder.forward(p, cfg, ids, pos, mask)
+        return jnp.mean(jax.nn.log_softmax(logits)[..., 3])
+
+    layers_fn = make_pipeline_layers_fn(pp_mesh, cfg, num_microbatches=2,
+                                        remat=True)
+
+    def loss_pipe(p):
+        logits, _ = decoder.forward(p, cfg, ids, pos, mask,
+                                    layers_fn=layers_fn)
+        return jnp.mean(jax.nn.log_softmax(logits)[..., 3])
+
+    g_ref = jax.grad(loss_scan)(params)
+    with pp_mesh:
+        g_pipe = jax.jit(jax.grad(loss_pipe))(params)
+    flat_ref = jax.tree_util.tree_leaves_with_path(g_ref)
+    flat_pipe = {jax.tree_util.keystr(p): l for p, l in
+                 jax.tree_util.tree_leaves_with_path(g_pipe)}
+    for path, leaf in flat_ref:
+        key = jax.tree_util.keystr(path)
+        np.testing.assert_allclose(
+            np.asarray(flat_pipe[key]), np.asarray(leaf),
+            rtol=5e-4, atol=5e-5, err_msg=key)
+
+
+def test_pipeline_shape_validation(pp_mesh):
+    with pytest.raises(ValueError, match="not divisible"):
+        make_pipeline_layers_fn(pp_mesh, decoder.get_config(
+            "tiny", num_layers=3), num_microbatches=2)
+
+
+def test_pipeline_ragged_batch_pads_and_matches(pp_mesh):
+    """Feeds whose batch is NOT a microbatch multiple (ibatch-sized logprob
+    passes, ragged tail micros) pad internally with fully-masked rows and
+    still match the scan path on the real rows."""
+    cfg, params, ids, pos, mask = _setup()
+    ids3, pos3, mask3 = ids[:3], pos[:3], mask[:3]
+    ref, _ = decoder.forward(params, cfg, ids3, pos3, mask3)
+    layers_fn = make_pipeline_layers_fn(pp_mesh, cfg, num_microbatches=2)
+    with pp_mesh:
+        got, _ = jax.jit(lambda p: decoder.forward(
+            p, cfg, ids3, pos3, mask3, layers_fn=layers_fn))(params)
+    assert got.shape == ref.shape
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_pipeline_train_step_e2e(pp_mesh):
+    """One full GRPO-style train step (fwd+bwd+adamw) with the pipelined
+    stack under jit on the pp mesh — finite loss, params move."""
+    import optax
+
+    cfg, params, ids, pos, mask = _setup()
+    layers_fn = make_pipeline_layers_fn(pp_mesh, cfg, num_microbatches=2,
+                                        remat=True)
+    opt = optax.adamw(1e-3)
+    opt_state = opt.init(params)
+
+    def loss_fn(p):
+        logits, _ = decoder.forward(p, cfg, ids, pos, mask,
+                                    layers_fn=layers_fn)
+        return jnp.mean(jax.nn.log_softmax(logits)[..., 0])
+
+    @jax.jit
+    def step(p, s):
+        loss, g = jax.value_and_grad(loss_fn)(p)
+        upd, s = opt.update(g, s, p)
+        return optax.apply_updates(p, upd), s, loss
+
+    with pp_mesh:
+        new_params, opt_state, loss = step(params, opt_state)
+    assert np.isfinite(float(loss))
+    moved = np.abs(np.asarray(new_params["layers"]["wq"])
+                   - np.asarray(params["layers"]["wq"])).sum()
+    assert moved > 0.0

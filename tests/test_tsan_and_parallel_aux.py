@@ -150,22 +150,19 @@ def test_hybrid_mesh_falls_back_single_slice():
     from polyrl_tpu.parallel import distributed
 
     mesh = distributed.make_hybrid_mesh(dcn_dp=1)
-    assert set(mesh.axis_names) == {"dp", "fsdp", "tp", "sp", "ep"}
+    assert set(mesh.axis_names) == {"dp", "fsdp", "tp", "sp", "ep", "pp"}
 
 
-def test_pp_config_surface_guarded_ep_real():
-    """PP exists as a mesh-config knob (reference parity: config surface
-    only, workers/config/rollout.py:132-134,198-202) and raises a clear
-    NotImplementedError at resolution, not a shape error deep in jit. EP is
-    a REAL axis (beyond the reference): it resolves into the mesh."""
-    import pytest as _pytest
-
+def test_pp_ep_are_real_axes():
+    """PP and EP both resolve into the mesh as real axes — beyond the
+    reference, which only stubs infer_pp / expert knobs
+    (workers/config/rollout.py:132-134,193-202)."""
     from polyrl_tpu.parallel import mesh as meshlib
 
-    cfg = meshlib.MeshConfig(pp=2)
-    with _pytest.raises(NotImplementedError, match="pipeline"):
-        cfg.resolve(8)
-    assert meshlib.MeshConfig(dp=2, fsdp=2, ep=2).resolve(8) == (2, 2, 1, 1, 2)
+    assert (meshlib.MeshConfig(dp=2, fsdp=2, pp=2).resolve(8)
+            == (2, 2, 1, 1, 1, 2))
+    assert (meshlib.MeshConfig(dp=2, fsdp=2, ep=2).resolve(8)
+            == (2, 2, 1, 1, 2, 1))
     # defaults stay executable
     assert (meshlib.MeshConfig(dp=2, fsdp=2, tp=2, sp=1).resolve(8)
-            == (2, 2, 2, 1, 1))
+            == (2, 2, 2, 1, 1, 1))
