@@ -30,7 +30,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P
 
-from polyrl_tpu.models.quant import mm, unembed
+from polyrl_tpu.models.quant import mm, moe_mm, unembed
 from polyrl_tpu.ops.attention import attention, causal_mask
 from polyrl_tpu.parallel.mesh import DP, EP, FSDP, SP, TP
 
@@ -153,6 +153,14 @@ PRESETS: dict[str, ModelConfig] = {
         num_layers=48, num_heads=32, num_kv_heads=4, head_dim=128,
         rope_theta=1000000.0, use_qk_norm=True,
         num_experts=128, num_experts_per_tok=8, moe_intermediate_size=768,
+    ),
+    # Mixtral-8x7B (HF config: mistralai/Mixtral-8x7B-v0.1 — 8 experts,
+    # top-2; Mixtral routing == softmax-all→top-k→renorm, see hf_loader)
+    "mixtral-8x7b": ModelConfig(
+        vocab_size=32000, hidden_size=4096, intermediate_size=14336,
+        num_layers=32, num_heads=32, num_kv_heads=8, rope_theta=1000000.0,
+        rms_norm_eps=1e-5, max_position_embeddings=32768,
+        num_experts=8, num_experts_per_tok=2, moe_intermediate_size=14336,
     ),
 }
 
@@ -383,10 +391,10 @@ def _moe_mlp(cfg: ModelConfig, x: jnp.ndarray, lp: dict,
 
     xg = x_p.reshape(ng, g, d)
     xe = jnp.einsum("gtd,gtec->gecd", xg, dispatch)               # [G, E, cap, d]
-    gate = jax.nn.silu(jnp.einsum("gecd,edf->gecf", xe, lp["we_gate"]
-                                  ).astype(jnp.float32)).astype(x.dtype)
-    up = jnp.einsum("gecd,edf->gecf", xe, lp["we_up"])
-    ye = jnp.einsum("gecf,efd->gecd", gate * up, lp["we_down"])   # [G, E, cap, d]
+    gate = jax.nn.silu(moe_mm("gecd,edf->gecf", xe, lp["we_gate"]
+                              ).astype(jnp.float32)).astype(x.dtype)
+    up = moe_mm("gecd,edf->gecf", xe, lp["we_up"])
+    ye = moe_mm("gecf,efd->gecd", gate * up, lp["we_down"])       # [G, E, cap, d]
     out = jnp.einsum("gecd,gtec->gtd", ye.astype(jnp.float32), combine)
     return out.reshape(n_pad, d)[:n].astype(x.dtype)
 

@@ -54,11 +54,15 @@ _LAYER_MAP = {
     "self_attn.v_proj.bias": "bv",
 }
 _TRANSPOSED = {"wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down"}
-# Qwen3-MoE expert tensors: model.layers.{i}.mlp.experts.{j}.<proj>
+# MoE expert tensors: Qwen3-MoE model.layers.{i}.mlp.experts.{j}.<proj>;
+# Mixtral model.layers.{i}.block_sparse_moe.experts.{j}.{w1,w3,w2}
 _EXPERT_MAP = {
     "gate_proj.weight": "we_gate",
     "up_proj.weight": "we_up",
     "down_proj.weight": "we_down",
+    "w1.weight": "we_gate",
+    "w3.weight": "we_up",
+    "w2.weight": "we_down",
 }
 
 
@@ -103,6 +107,17 @@ def config_from_hf(ckpt_dir: str, dtype=jnp.bfloat16) -> decoder.ModelConfig:
             num_experts_per_tok=hf.get("num_experts_per_tok", 8),
             moe_intermediate_size=hf["moe_intermediate_size"],
             norm_topk_prob=bool(hf.get("norm_topk_prob", True)),
+        )
+    elif hf.get("num_local_experts"):  # Mixtral family
+        # Mixtral routes softmax(top_k(logits)) — numerically identical to
+        # softmax-all → top-k → renormalize (top-k is monotone under
+        # softmax and restricting a softmax IS the renormalization), i.e.
+        # norm_topk_prob=True; experts use the dense intermediate size
+        moe = dict(
+            num_experts=hf["num_local_experts"],
+            num_experts_per_tok=hf.get("num_experts_per_tok", 2),
+            moe_intermediate_size=hf["intermediate_size"],
+            norm_topk_prob=True,
         )
     return decoder.ModelConfig(
         vocab_size=hf["vocab_size"],
@@ -165,10 +180,12 @@ def load_hf_params(ckpt_dir: str, cfg: decoder.ModelConfig | None = None,
                 elif name.startswith("model.layers."):
                     rest = name.split(".", 2)[2]          # "{i}.suffix"
                     idx_s, suffix = rest.split(".", 1)
-                    if suffix == "mlp.gate.weight":       # MoE router
+                    if suffix in ("mlp.gate.weight",
+                                  "block_sparse_moe.gate.weight"):  # router
                         layer_parts.setdefault("router", [None] * L)[
                             int(idx_s)] = t.T             # [E, D] → [D, E]
-                    elif suffix.startswith("mlp.experts."):
+                    elif (suffix.startswith("mlp.experts.")
+                          or suffix.startswith("block_sparse_moe.experts.")):
                         j_s, proj = suffix.split(".", 3)[2:]
                         key = _EXPERT_MAP.get(proj)
                         if key is None:
@@ -206,10 +223,21 @@ def load_hf_params(ckpt_dir: str, cfg: decoder.ModelConfig | None = None,
         if missing:
             raise ValueError(f"expert tensors missing for {key}: "
                              f"{missing[:8]}")
-        # experts stay unquantized (quantize_params contract: their
-        # batched-einsum path does not route through mm)
-        layers[key] = jnp.asarray(
-            np.stack([np.stack(row) for row in grid]), np_dtype)
+        if quantize == "int8":  # experts are the bulk of MoE params
+            # quantize PER LAYER before stacking: the f32 transient inside
+            # quantize_tensor stays one layer's experts, not the whole
+            # [L, E, in, out] stack (which would be ~2× checkpoint size on
+            # exactly the large MoE models int8 targets)
+            qs, ss = [], []
+            for row in grid:
+                qw = quantize_tensor(np.stack(row), contract_axis=-2)
+                qs.append(qw.q)
+                ss.append(qw.scale)
+            layers[key] = QuantWeight(q=jnp.asarray(np.stack(qs)),
+                                      scale=jnp.asarray(np.stack(ss)))
+        else:
+            layers[key] = jnp.asarray(
+                np.stack([np.stack(row) for row in grid]), np_dtype)
 
     params = {
         "embed": jnp.asarray(flat["embed"], np_dtype),

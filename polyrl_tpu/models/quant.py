@@ -39,6 +39,10 @@ import numpy as np
 # layer-stacked matmul weights that get quantized ([L, in, out]);
 # embed stays bf16 (it is a gather, not a matmul), norms/biases are tiny
 QUANTIZED_LAYER_KEYS = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")
+# MoE expert weights ([L, E, in, out]) — the bulk of a MoE model's params;
+# the tiny router ([L, d, E]) stays full precision (routing decisions are
+# precision-sensitive and it is negligible HBM)
+QUANTIZED_EXPERT_KEYS = ("we_gate", "we_up", "we_down")
 
 
 @jax.tree_util.register_pytree_node_class
@@ -102,6 +106,17 @@ def mm(x, w):
     return x @ w
 
 
+def moe_mm(eq: str, x, w):
+    """Expert-batched einsum (``..., out`` result, experts on result axis 1)
+    with QuantWeight dispatch — the MoE expert projections' analogue of
+    ``mm``. ``w.scale`` is [E, out] (contraction axis reduced away)."""
+    if isinstance(w, QuantWeight):
+        y = jnp.einsum(eq, x, w.q.astype(x.dtype))
+        return (y.astype(jnp.float32)
+                * w.scale[None, :, None, :]).astype(x.dtype)
+    return jnp.einsum(eq, x, w)
+
+
 def unembed(x, head, eq: str):
     """Logits head matmul (``jnp.einsum(eq, x, head)`` in f32) with
     QuantWeight dispatch; the per-vocab-channel scale multiplies the f32
@@ -114,16 +129,14 @@ def unembed(x, head, eq: str):
 
 
 def quantize_params(params: dict) -> dict:
-    """Quantize a decoder param tree's matmul weights (layer-stacked QKVO +
-    dense MLP and the untied lm_head); embed/norms/biases stay in model
-    dtype. MoE expert weights (we_gate/we_up/we_down) are left unquantized
-    — their batched-einsum path does not route through ``mm`` — so MoE
-    models quantize attention + head only. Accepts device (jax) or host
-    (numpy) trees — each leaf quantizes with its own backend."""
+    """Quantize a decoder param tree's matmul weights: layer-stacked QKVO,
+    dense MLP or MoE expert projections, and the untied lm_head;
+    embed/norms/biases/router stay in model dtype. Accepts device (jax) or
+    host (numpy) trees — each leaf quantizes with its own backend."""
     out = dict(params)
     layers = dict(params["layers"])
-    for k in QUANTIZED_LAYER_KEYS:
-        if k in layers:  # dense MLP keys absent on MoE models
+    for k in QUANTIZED_LAYER_KEYS + QUANTIZED_EXPERT_KEYS:
+        if k in layers:  # dense vs MoE trees carry different MLP keys
             layers[k] = quantize_tensor(layers[k], contract_axis=-2)
     out["layers"] = layers
     if "lm_head" in params:
@@ -144,6 +157,11 @@ def quant_param_specs(specs: dict) -> dict:
             continue
         s = layer[k]  # P(layer, in, out)
         layer[k] = QuantWeight(q=s, scale=P(s[0], s[2]))
+    for k in QUANTIZED_EXPERT_KEYS:
+        if k not in layer:
+            continue
+        s = layer[k]  # P(layer, expert, in, out)
+        layer[k] = QuantWeight(q=s, scale=P(s[0], s[1], s[3]))
     out["layers"] = layer
     if "lm_head" in specs:
         s = specs["lm_head"]  # P(in, out)
@@ -160,8 +178,9 @@ def init_quantized_params(rng: jax.Array, cfg) -> dict:
     of ``decoder.init_params`` (dense models only)."""
     if getattr(cfg, "num_experts", 0):
         raise NotImplementedError(
-            "init_quantized_params supports dense models only; quantize a "
-            "loaded MoE tree via quantize_params (experts stay bf16)")
+            "init_quantized_params supports dense models only; load a MoE "
+            "checkpoint with quantize='int8' or quantize_params a loaded "
+            "tree (experts quantize per-output-channel like the dense MLP)")
     hd = cfg.head_dim_
     d, f, L = cfg.hidden_size, cfg.intermediate_size, cfg.num_layers
     hq, hkv = cfg.num_heads, cfg.num_kv_heads

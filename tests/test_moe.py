@@ -151,8 +151,8 @@ def test_moe_hf_logits_parity(tmp_path, quant):
                              jnp.asarray(mask))
     got = np.asarray(got)
     if quant:
-        # int8 attention/head (experts stay full precision): statistical
-        # closeness, not elementwise parity
+        # int8 attention/head/experts: statistical closeness, not
+        # elementwise parity
         nrmse = np.sqrt(np.mean((got - want) ** 2)) / (np.std(want) + 1e-9)
         assert nrmse < 0.05, nrmse
     else:
@@ -214,14 +214,29 @@ def test_moe_cb_engine_decode():
         engine.stop()
 
 
-def test_moe_quantize_params_skips_experts():
+def test_moe_quantize_params_covers_experts_not_router():
+    """Experts (the bulk of MoE params) quantize; the tiny routing matrix
+    stays full precision (routing decisions are precision-sensitive)."""
     from polyrl_tpu.models.quant import QuantWeight, quantize_params
 
     cfg, params = _mk()
     qp = quantize_params(params)
     assert isinstance(qp["layers"]["wq"], QuantWeight)
-    assert not isinstance(qp["layers"]["we_gate"], QuantWeight)
+    assert isinstance(qp["layers"]["we_gate"], QuantWeight)
+    assert qp["layers"]["we_gate"].q.dtype == jnp.int8
+    assert qp["layers"]["we_gate"].scale.shape == (
+        cfg.num_layers, cfg.num_experts, cfg.moe_intermediate_size)
     assert not isinstance(qp["layers"]["router"], QuantWeight)
+    # quantized MoE forward tracks full precision
+    ids = jax.random.randint(jax.random.PRNGKey(9), (2, 10), 1,
+                             cfg.vocab_size)
+    pos = jnp.broadcast_to(jnp.arange(10), (2, 10))
+    mask = jnp.ones((2, 10))
+    ref, _ = decoder.forward(params, cfg, ids, pos, mask)
+    got, _ = decoder.forward(qp, cfg, ids, pos, mask)
+    ref, got = np.asarray(ref, np.float32), np.asarray(got, np.float32)
+    nrmse = np.sqrt(np.mean((ref - got) ** 2)) / (np.std(ref) + 1e-9)
+    assert nrmse < 0.05, nrmse
 
 
 def test_moe_padding_does_not_consume_capacity():
@@ -301,3 +316,41 @@ def test_moe_grpo_e2e_fit_step():
         a0 = params0["layers"][key]
         a1 = np.asarray(actor.params["layers"][key])
         assert np.abs(a1 - a0).sum() > 0.0, f"{key} unchanged"
+
+
+def test_mixtral_hf_logits_parity(tmp_path):
+    """Mixtral family parity: block_sparse_moe tensor naming and the
+    softmax-after-top-k routing (== softmax-all → top-k → renorm)."""
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+
+    from polyrl_tpu.models.hf_loader import config_from_hf, load_hf_params
+
+    hf_cfg = transformers.MixtralConfig(
+        vocab_size=128, hidden_size=32, intermediate_size=48,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        num_local_experts=4, num_experts_per_tok=2,
+        max_position_embeddings=256, rms_norm_eps=1e-6, rope_theta=10000.0,
+        tie_word_embeddings=False,
+    )
+    torch.manual_seed(0)
+    model = transformers.AutoModelForCausalLM.from_config(hf_cfg).eval()
+    out_dir = tmp_path / "mixtral"
+    model.save_pretrained(out_dir, safe_serialization=True)
+
+    cfg = config_from_hf(str(out_dir), dtype=jnp.float32)
+    assert cfg.num_experts == 4 and cfg.num_experts_per_tok == 2
+    assert cfg.moe_intermediate_size == 48 and not cfg.use_qk_norm
+    cfg = dataclasses.replace(cfg, moe_capacity_factor=cfg.num_experts
+                              / cfg.num_experts_per_tok)  # dropless
+    params = load_hf_params(str(out_dir), cfg)
+
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, cfg.vocab_size, (2, 12)).astype(np.int32)
+    with torch.no_grad():
+        want = model(torch.from_numpy(ids).long()).logits.numpy()
+    pos = np.broadcast_to(np.arange(12, dtype=np.int32), (2, 12))
+    mask = np.ones((2, 12), np.float32)
+    got, _ = decoder.forward(params, cfg, jnp.asarray(ids), jnp.asarray(pos),
+                             jnp.asarray(mask))
+    np.testing.assert_allclose(np.asarray(got), want, rtol=2e-4, atol=2e-4)
