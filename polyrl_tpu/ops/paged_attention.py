@@ -203,6 +203,27 @@ def paged_attention_lib(q, k_pool, v_pool, page_table, seq_lens, scale=None):
         pages_per_compute_block=ppcb)
 
 
+def make_tp_paged_attention(mesh):
+    """Tensor-parallel wrapper: paged attention sharded over the tp axis on
+    the HEAD dim (q [S, Hq, D] and both pools [Hkv, N, ps, D] split by tp;
+    GQA query groups stay aligned with their shared KV head because both
+    counts divide by tp). Needed because the Pallas kernel is a custom
+    call — GSPMD cannot partition it, so without the shard_map a tp-sharded
+    pool would be all-gathered per layer per step."""
+    from jax.sharding import PartitionSpec as P
+
+    from polyrl_tpu.parallel.mesh import TP
+
+    def inner(q, k_pool, v_pool, page_table, seq_lens):
+        return paged_attention(q, k_pool, v_pool, page_table, seq_lens)
+
+    return jax.shard_map(
+        inner, mesh=mesh,
+        in_specs=(P(None, TP, None), P(TP, None, None, None),
+                  P(TP, None, None, None), P(), P()),
+        out_specs=P(None, TP, None), check_vma=False)
+
+
 def paged_attention(q, k_pool, v_pool, page_table, seq_lens, scale=None):
     """Dispatch: the tuned library Pallas kernel on TPU, gather oracle
     elsewhere (interpret-mode for our custom kernel is exercised in tests;

@@ -106,15 +106,20 @@ def shard_params(mesh: Mesh, params, specs):
     """device_put a param pytree with per-leaf specs from a matching (or
     partially matching) spec tree: leaves without a spec (e.g. a critic's
     value head absent from ``decoder.param_specs``) fall back to replicated.
-    The single shared implementation for actor/critic GSPMD placement."""
+    The single shared implementation for actor/critic GSPMD placement.
+
+    Spec lookup is by FLATTENED key path (not dict indexing), so spec trees
+    containing pytree nodes without ``__getitem__`` — e.g. quant.QuantWeight
+    wrapping (q_spec, scale_spec) — resolve correctly instead of silently
+    falling back to replicated."""
+    by_path = {
+        jax.tree_util.keystr(p): s
+        for p, s in jax.tree_util.tree_flatten_with_path(
+            specs, is_leaf=lambda x: isinstance(x, P))[0]
+    }
 
     def put(path, x):
-        node = specs
-        try:
-            for k in path:
-                node = node[k.key]
-        except (KeyError, TypeError):
-            node = P()
+        node = by_path.get(jax.tree_util.keystr(path), P())
         if not isinstance(node, P):
             node = P()
         return jax.device_put(x, NamedSharding(mesh, node))

@@ -112,10 +112,26 @@ class CBEngine:
         seed: int = 0,
         enable_prefix_cache: bool = True,
         steps_per_dispatch: int = 8,
+        mesh=None,
     ):
         assert all(b % page_size == 0 for b in prompt_buckets), \
             "prompt buckets must be page-aligned"
         self.cfg = cfg
+        self.mesh = mesh
+        if mesh is not None:
+            # tensor-parallel serving (the reference's SGLang --tp-size
+            # role, launch_sglang.sh:13): params shard over (fsdp, tp) per
+            # decoder.param_specs, KV pools over tp on the head dim, and
+            # GSPMD inserts the attention/matmul collectives inside the
+            # existing compiled step — no engine-logic changes. Quantized
+            # trees shard via quant_param_specs.
+            tp = mesh.shape.get("tp", 1)
+            if cfg.num_heads % tp or cfg.num_kv_heads % tp:
+                raise ValueError(
+                    f"tp={tp} must divide num_heads ({cfg.num_heads}) and "
+                    f"num_kv_heads ({cfg.num_kv_heads}) — the KV pools and "
+                    "paged attention shard on the head dim")
+            params = self._shard_params_for_mesh(params)
         self.params = params
         self.max_slots = max_slots
         self.page_size = page_size
@@ -149,8 +165,7 @@ class CBEngine:
         self.allocator = PageAllocator(self.num_pages)
         self.prefix_cache = (PrefixCache(page_size, self.allocator.free)
                              if enable_prefix_cache else None)
-        self._pools = decoder.make_paged_pools(
-            cfg, self.num_pages, page_size, dtype=kv_cache_dtype)
+        self._pools = self._make_pools()
         self._rng = jax.random.PRNGKey(seed)
 
         self._queue: "queue.Queue[_Request]" = queue.Queue()
@@ -198,6 +213,34 @@ class CBEngine:
         """Cumulative seconds per phase (POLYRL_CB_TRACE=1), else empty."""
         return dict(self._trace or {})
 
+    def _shard_params_for_mesh(self, params):
+        from polyrl_tpu.models.quant import QuantWeight, quant_param_specs
+        from polyrl_tpu.parallel import mesh as meshlib
+
+        specs = decoder.param_specs(self.cfg)
+        if any(isinstance(leaf, QuantWeight) for leaf in
+               jax.tree_util.tree_leaves(
+                   params, is_leaf=lambda x: isinstance(x, QuantWeight))):
+            specs = quant_param_specs(specs)
+        return meshlib.shard_params(self.mesh, params, specs)
+
+    def _make_pools(self):
+        """Paged KV pools; under a mesh, each layer's [Hkv, N, ps, D] pool
+        shards its head dim over tp (matching the attention einsums the
+        params induce, decoder.cache_specs rationale)."""
+        pools = decoder.make_paged_pools(
+            self.cfg, self.num_pages, self.page_size,
+            dtype=self.kv_cache_dtype)
+        if self.mesh is None:
+            return pools
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from polyrl_tpu.parallel.mesh import TP
+
+        sh = NamedSharding(self.mesh, P(TP, None, None, None))
+        return tuple(tuple(jax.device_put(a, sh) for a in side)
+                     for side in pools)
+
     def _tmark(self, key: str, t0: float) -> None:
         if self._trace is not None:
             self._trace[key] += time.monotonic() - t0
@@ -223,6 +266,7 @@ class CBEngine:
         key = (use_filters, k)
         if key not in self._step_fns:
             cfg, pad = self.cfg, self.pad_token_id
+            paged_attn = self._tp_paged_attn()
 
             def step(params, kp, vp, rng, page_table, seq_lens, last_tokens,
                      n_generated, budgets, active, temps, top_ps, top_ks,
@@ -231,7 +275,8 @@ class CBEngine:
                     kp, vp, rng, seq_lens, last_tokens, n_generated, active = carry
                     logits, (kp, vp) = decoder.forward_paged_decode(
                         params, cfg, last_tokens, seq_lens, (kp, vp),
-                        page_table, seq_lens, active=active)
+                        page_table, seq_lens, active=active,
+                        attn_fn=paged_attn)
                     rng, sub = jax.random.split(rng)
                     token, logp = sample_token_vec(
                         logits, sub, temps, top_ps, top_ks,
@@ -258,6 +303,16 @@ class CBEngine:
             self._step_fns[key] = jax.jit(
                 step, donate_argnums=(1, 2, 5, 6, 7, 9), static_argnames=())
         return self._step_fns[key]
+
+    def _tp_paged_attn(self):
+        """Under a tp>1 mesh the Pallas paged-attention custom call must be
+        shard_mapped over the head dim (GSPMD cannot partition custom
+        calls); None otherwise → forward_paged_decode's default."""
+        if self.mesh is None or self.mesh.shape.get("tp", 1) <= 1:
+            return None
+        from polyrl_tpu.ops.paged_attention import make_tp_paged_attention
+
+        return make_tp_paged_attention(self.mesh)
 
     def _insert_slot_state(self, st: dict, slot, prompt_len, token, done,
                            budget, temp, top_p, top_k, stop_row, row):
@@ -542,6 +597,12 @@ class CBEngine:
             raise ValueError(
                 "update_weights tree structure mismatch (quantized engines "
                 "need the push re-quantized first — models/quant.py)")
+        if self.mesh is not None:
+            # keep the compiled step's layout: an in-process push from a
+            # colocated trainer arrives host-side/replicated — without the
+            # re-shard every weight swap would retrace the decode step (or
+            # force the full unsharded tree through one chip's HBM)
+            params = self._shard_params_for_mesh(params)
         self.params = params
         self.weight_version = self.weight_version + 1 if version is None else version
         if self.prefix_cache is not None:
@@ -579,9 +640,7 @@ class CBEngine:
     def resume_memory(self) -> None:
         with self._pool_lock:
             if self._pools is None:
-                self._pools = decoder.make_paged_pools(
-                    self.cfg, self.num_pages, self.page_size,
-                    dtype=self.kv_cache_dtype)
+                self._pools = self._make_pools()
         self._paused.clear()
 
     # -- engine loop ---------------------------------------------------------
@@ -629,9 +688,7 @@ class CBEngine:
         with self._pool_lock:
             if self.prefix_cache is not None:
                 self.prefix_cache.flush()
-            self._pools = decoder.make_paged_pools(
-                self.cfg, self.num_pages, self.page_size,
-                dtype=self.kv_cache_dtype)
+            self._pools = self._make_pools()
 
     def _drain_queue(self) -> None:
         while True:

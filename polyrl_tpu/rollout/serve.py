@@ -38,7 +38,8 @@ def create_server(model: str, manager_endpoint: str | None = None,
                   num_pages: int | None = None,
                   steps_per_dispatch: int = 8,
                   weight_quant: str = "",
-                  warmup: bool = False):
+                  warmup: bool = False,
+                  tp: int = 1):
     """Build engine + server, register with the manager, attach receiver.
 
     ``backend="cb"`` (default) serves with the paged continuous-batching
@@ -88,13 +89,26 @@ def create_server(model: str, manager_endpoint: str | None = None,
         weight_template = jax.eval_shape(
             lambda: decoder.init_params(jax.random.PRNGKey(seed), cfg))
         weight_preprocess = quantize_params
+    mesh = None
+    if tp > 1:
+        # tensor-parallel serving (the reference's --tp-size role,
+        # launch_sglang.sh:13): params/KV shard over tp chips of this host
+        if backend != "cb":
+            raise NotImplementedError("tp > 1 requires backend='cb'")
+        from polyrl_tpu.parallel import mesh as meshlib
+
+        devs = jax.devices()
+        if len(devs) % tp != 0:
+            raise ValueError(f"tp={tp} does not divide {len(devs)} devices")
+        mesh = meshlib.make_mesh(meshlib.MeshConfig(fsdp=1, tp=tp),
+                                 devs[:tp])
     if backend == "cb":
         engine = CBEngine(
             cfg, params, pad_token_id=0, kv_cache_dtype=getattr(jnp, dtype),
             max_slots=max_slots, page_size=page_size, max_seq_len=max_seq_len,
             num_pages=num_pages, steps_per_dispatch=steps_per_dispatch,
             prompt_buckets=tuple(prompt_buckets) if prompt_buckets
-            else (128, 256, 512, 1024, 2048, 4096), seed=seed)
+            else (128, 256, 512, 1024, 2048, 4096), seed=seed, mesh=mesh)
     else:
         kwargs = {}
         if batch_buckets:
@@ -174,6 +188,8 @@ def main() -> None:
     p.add_argument("--prompt-buckets", type=int, nargs="+", default=None,
                    help="prompt-length padding buckets (default "
                         "128 256 512 1024 2048 4096)")
+    p.add_argument("--tp", type=int, default=1,
+                   help="tensor-parallel serving over this many chips")
     args = p.parse_args()
 
     logging.basicConfig(level=logging.INFO)
@@ -187,7 +203,8 @@ def main() -> None:
                            steps_per_dispatch=args.steps_per_dispatch,
                            weight_quant=args.weight_quant,
                            warmup=args.warmup,
-                           prompt_buckets=args.prompt_buckets)
+                           prompt_buckets=args.prompt_buckets,
+                           tp=args.tp)
     log.info("rollout server on %s", server.endpoint)
     try:
         while True:

@@ -276,3 +276,86 @@ def test_multi_step_decode_stop_and_budget_mid_scan():
             assert a["token_ids"] == b["token_ids"]
     eng.stop()
     k1.stop()
+
+
+def test_cb_engine_tensor_parallel_matches_single_device():
+    """TP serving (the reference's SGLang --tp-size role): the CB engine on
+    a tp=2 mesh — params over (fsdp, tp), KV pools head-sharded — produces
+    EXACTLY the single-device greedy output."""
+    import jax
+
+    from polyrl_tpu.models import decoder
+    from polyrl_tpu.parallel import mesh as meshlib
+    from polyrl_tpu.rollout.cb_engine import CBEngine
+    from polyrl_tpu.rollout.sampling import SamplingParams
+
+    cfg = decoder.get_config("tiny", dtype=jnp.float32)
+    params = decoder.init_params(jax.random.PRNGKey(0), cfg)
+    kw = dict(pad_token_id=0, kv_cache_dtype=jnp.float32, max_slots=4,
+              page_size=8, max_seq_len=64, prompt_buckets=(8,), num_pages=64)
+    sp = SamplingParams(temperature=0.0, max_new_tokens=8, stop_token_ids=())
+    prompts = [[1, 2, 3, 4, 5], [9, 8, 7]]
+
+    ref_engine = CBEngine(cfg, params, **kw)
+    try:
+        ref = [o["token_ids"] for o in
+               ref_engine.generate(prompts, sp, timeout=120.0)]
+    finally:
+        ref_engine.stop()
+
+    mesh = meshlib.make_mesh(meshlib.MeshConfig(fsdp=1, tp=2),
+                             jax.devices()[:2])
+    tp_engine = CBEngine(cfg, params, mesh=mesh, **kw)
+    try:
+        assert tp_engine.params["layers"]["wq"].sharding.spec[-1] == "tp"
+        assert tp_engine._pools[0][0].sharding.spec[0] == "tp"
+        got = [o["token_ids"] for o in
+               tp_engine.generate(prompts, sp, timeout=120.0)]
+    finally:
+        tp_engine.stop()
+    assert got == ref, (got, ref)
+
+
+def test_cb_engine_tp_quantized_actually_shards():
+    """Regression: a QuantWeight tree must tp-shard (the path-walk spec
+    lookup used to silently fall back to replicated on QuantWeight nodes),
+    update_weights must preserve the sharded layout, and tp must divide
+    the head counts."""
+    import jax
+    import pytest as _pytest
+
+    from polyrl_tpu.models import decoder
+    from polyrl_tpu.models.quant import quantize_params
+    from polyrl_tpu.parallel import mesh as meshlib
+    from polyrl_tpu.rollout.cb_engine import CBEngine
+    from polyrl_tpu.rollout.sampling import SamplingParams
+
+    cfg = decoder.get_config("tiny", dtype=jnp.float32)
+    qparams = quantize_params(decoder.init_params(jax.random.PRNGKey(0), cfg))
+    mesh = meshlib.make_mesh(meshlib.MeshConfig(fsdp=1, tp=2),
+                             jax.devices()[:2])
+    kw = dict(pad_token_id=0, kv_cache_dtype=jnp.float32, max_slots=4,
+              page_size=8, max_seq_len=64, prompt_buckets=(8,), num_pages=64)
+    engine = CBEngine(cfg, qparams, mesh=mesh, **kw)
+    try:
+        wq = engine.params["layers"]["wq"]
+        assert wq.q.sharding.spec[-1] == "tp", wq.q.sharding
+        assert wq.scale.sharding.spec[-1] == "tp", wq.scale.sharding
+        sp = SamplingParams(temperature=0.0, max_new_tokens=5,
+                            stop_token_ids=())
+        out = engine.generate([[1, 2, 3]], sp, timeout=120.0)
+        assert len(out[0]["token_ids"]) == 5
+        # an in-process push of a host-side tree is re-sharded, not taken raw
+        engine.update_weights(jax.device_get(engine.params), version=7)
+        assert engine.params["layers"]["wq"].q.sharding.spec[-1] == "tp"
+    finally:
+        engine.stop()
+
+    with _pytest.raises(ValueError, match="num_kv_heads"):
+        CBEngine(decoder.get_config("tiny", num_kv_heads=1, num_heads=4,
+                                    dtype=jnp.float32),
+                 decoder.init_params(
+                     jax.random.PRNGKey(0),
+                     decoder.get_config("tiny", num_kv_heads=1, num_heads=4,
+                                        dtype=jnp.float32)),
+                 mesh=mesh, **kw)
