@@ -141,7 +141,8 @@ def config_from_hf(ckpt_dir: str, dtype=jnp.bfloat16) -> decoder.ModelConfig:
 
 
 def load_hf_params(ckpt_dir: str, cfg: decoder.ModelConfig | None = None,
-                   dtype=None, quantize: str = "") -> dict:
+                   dtype=None, quantize: str = "",
+                   to_device: bool = True) -> dict:
     """Load a safetensors checkpoint into the decoder pytree. ``cfg``
     defaults to ``config_from_hf(ckpt_dir)``; ``dtype`` defaults to
     ``cfg.dtype``.
@@ -149,7 +150,11 @@ def load_hf_params(ckpt_dir: str, cfg: decoder.ModelConfig | None = None,
     ``quantize="int8"``: matmul weights are quantized ON HOST (numpy) and
     only the int8 tensors + scales are transferred — the full-precision
     tree never exists on device, so an 8B checkpoint loads onto a 16 GiB
-    chip (models/quant.py; 8B_FEASIBILITY.md)."""
+    chip (models/quant.py; 8B_FEASIBILITY.md).
+
+    ``to_device=False`` keeps every leaf host-side (numpy): callers that
+    shard over a mesh device_put leaf-by-leaf straight into the sharded
+    layout, so the unsharded tree never stages through one chip's HBM."""
     from safetensors import safe_open
 
     from polyrl_tpu.models.quant import (
@@ -161,6 +166,14 @@ def load_hf_params(ckpt_dir: str, cfg: decoder.ModelConfig | None = None,
     cfg = cfg or config_from_hf(ckpt_dir)
     dtype = dtype or cfg.dtype
     np_dtype = jnp.dtype(dtype)
+
+    def _dev(x, dt=None):
+        if to_device:
+            return jnp.asarray(x, dt) if dt is not None else jnp.asarray(x)
+        x = np.asarray(x)
+        if dt is not None:
+            x = x.astype(jnp.dtype(dt))  # ml_dtypes covers bf16 numpy
+        return np.ascontiguousarray(x)
     L = cfg.num_layers
 
     E = cfg.num_experts
@@ -212,10 +225,9 @@ def load_hf_params(ckpt_dir: str, cfg: decoder.ModelConfig | None = None,
         stacked = np.stack(parts)
         if quantize == "int8" and key in QUANTIZED_LAYER_KEYS:
             qw = quantize_tensor(stacked, contract_axis=-2)  # host-side
-            layers[key] = QuantWeight(q=jnp.asarray(qw.q),
-                                      scale=jnp.asarray(qw.scale))
+            layers[key] = QuantWeight(q=_dev(qw.q), scale=_dev(qw.scale))
         else:
-            layers[key] = jnp.asarray(stacked, np_dtype)
+            layers[key] = _dev(stacked, np_dtype)
     for key in list(expert_parts):
         grid = expert_parts.pop(key)  # [L][E] → [L, E, in, out]
         missing = [(i, j) for i in range(L) for j in range(E)
@@ -233,15 +245,15 @@ def load_hf_params(ckpt_dir: str, cfg: decoder.ModelConfig | None = None,
                 qw = quantize_tensor(np.stack(row), contract_axis=-2)
                 qs.append(qw.q)
                 ss.append(qw.scale)
-            layers[key] = QuantWeight(q=jnp.asarray(np.stack(qs)),
-                                      scale=jnp.asarray(np.stack(ss)))
+            layers[key] = QuantWeight(q=_dev(np.stack(qs)),
+                                      scale=_dev(np.stack(ss)))
         else:
-            layers[key] = jnp.asarray(
+            layers[key] = _dev(
                 np.stack([np.stack(row) for row in grid]), np_dtype)
 
     params = {
-        "embed": jnp.asarray(flat["embed"], np_dtype),
-        "final_norm": jnp.asarray(flat["final_norm"], np_dtype),
+        "embed": _dev(flat["embed"], np_dtype),
+        "final_norm": _dev(flat["final_norm"], np_dtype),
         "layers": layers,
     }
     if not cfg.tie_word_embeddings:
@@ -251,10 +263,10 @@ def load_hf_params(ckpt_dir: str, cfg: decoder.ModelConfig | None = None,
         if quantize == "int8":
             qw = quantize_tensor(np.ascontiguousarray(flat["lm_head"]),
                                  contract_axis=0)
-            params["lm_head"] = QuantWeight(q=jnp.asarray(qw.q),
-                                            scale=jnp.asarray(qw.scale))
+            params["lm_head"] = QuantWeight(q=_dev(qw.q),
+                                            scale=_dev(qw.scale))
         else:
-            params["lm_head"] = jnp.asarray(flat["lm_head"], np_dtype)
+            params["lm_head"] = _dev(flat["lm_head"], np_dtype)
     # structural + shape validation against the config: catches both
     # preset/checkpoint mixups and structurally mismatched checkpoints (a
     # missing q_norm would otherwise surface as an opaque KeyError in jit;

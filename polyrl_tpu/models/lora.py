@@ -1,0 +1,134 @@
+"""LoRA adapters for RL post-training.
+
+The reference exposes LoRA through verl's actor config but marks it
+untested (reference stream_fsdp_workers.py:224 FIXME); here it is a
+first-class, tested path. Design: the adapter is a weight WRAPPER
+(`quant.LoraWeight`), not a model rewrite — ``decoder`` code is untouched
+because ``mm`` dispatches on the wrapper, exactly like int8 QuantWeight.
+Wrapping a quantized base gives QLoRA (frozen int8 base + trainable bf16
+adapters) with no extra code.
+
+Training: only a/b leaves receive optimizer updates (``lora_mask`` +
+``optax.masked``; ``mm`` stops gradients at the base so frozen-weight
+grads are structurally zero). Serving: pushes merge the adapters into a
+plain tree (``merge_lora``) so the transfer fabric and rollout engines
+see the ordinary full-precision layout.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from polyrl_tpu.models.quant import LoraWeight, QuantWeight
+
+# default adapter targets: attention + dense MLP projections (MoE expert
+# stacks are not wrapped — their einsum path bypasses mm; attention-only
+# LoRA is the standard recipe for MoE fine-tuning anyway)
+DEFAULT_TARGETS = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")
+
+
+def wrap_lora(params: dict, rng: jax.Array, rank: int, alpha: float = 16.0,
+              targets=DEFAULT_TARGETS, dtype=None) -> dict:
+    """Wrap each target layer weight [L, in, out] in a LoraWeight with
+    a ~ N(0, 1/r) [L, in, r] and b = 0 [L, r, out] (standard init: the
+    adapter starts as an exact no-op)."""
+    out = dict(params)
+    layers = dict(params["layers"])
+    keys = jax.random.split(rng, len(targets))
+    for key, k in zip(keys, targets):
+        if k not in layers:
+            continue
+        w = layers[k]
+        base_shape = w.shape  # works for plain arrays and QuantWeight
+        L, d_in, d_out = base_shape
+        dt = dtype or (w.q.dtype if isinstance(w, QuantWeight) else w.dtype)
+        if dt == jnp.int8:
+            dt = jnp.bfloat16
+        a = (jax.random.normal(key, (L, d_in, rank), jnp.float32)
+             * (rank ** -0.5)).astype(dt)
+        b = jnp.zeros((L, rank, d_out), dt)
+        layers[k] = LoraWeight(base=w, a=a, b=b, alpha=float(alpha))
+    out["layers"] = layers
+    return out
+
+
+def merge_lora(params: dict) -> dict:
+    """Fold adapters into plain full-precision weights: ``base +
+    (alpha/r)·a@b``. Quantized bases dequantize to the adapter dtype —
+    the push wire and the rollout engines expect the ordinary layout."""
+
+    def merge(w):
+        if not isinstance(w, LoraWeight):
+            return w
+        base = w.base
+        if isinstance(base, QuantWeight):
+            base = (base.q.astype(jnp.float32)
+                    * base.scale[..., None, :]).astype(w.a.dtype)
+        rank = w.a.shape[-1]
+        delta = jnp.einsum("lir,lro->lio", w.a.astype(jnp.float32),
+                           w.b.astype(jnp.float32)) * (w.alpha / rank)
+        return (base.astype(jnp.float32) + delta).astype(w.a.dtype)
+
+    out = dict(params)
+    out["layers"] = {k: merge(v) for k, v in params["layers"].items()}
+    return out
+
+
+def lora_labels(params: dict) -> dict:
+    """'train'/'freeze' label pytree for ``optax.multi_transform``: only
+    adapter a/b leaves train; everything else maps to ``set_to_zero`` (NB:
+    ``optax.masked`` is NOT suitable — it passes masked-out updates through
+    UNCHANGED, i.e. raw gradients would still be applied to the frozen
+    embed/norm leaves)."""
+
+    def label(x):
+        if isinstance(x, LoraWeight):
+            base_lbl = jax.tree_util.tree_map(lambda _: "freeze", x.base)
+            return LoraWeight(base=base_lbl, a="train", b="train",
+                              alpha=x.alpha)
+        return jax.tree_util.tree_map(lambda _: "freeze", x)
+
+    return jax.tree_util.tree_map(
+        label, params, is_leaf=lambda x: isinstance(x, LoraWeight))
+
+
+def lora_optimizer(inner, params: dict):
+    """Wrap an optimizer so only adapter leaves update (frozen leaves get
+    ``set_to_zero`` — no state, no movement)."""
+    import optax
+
+    return optax.multi_transform(
+        {"train": inner, "freeze": optax.set_to_zero()},
+        param_labels=lora_labels(params))
+
+
+def lora_param_specs(specs: dict, targets=DEFAULT_TARGETS) -> dict:
+    """PartitionSpec tree matching ``wrap_lora`` output: the base keeps its
+    spec; a shards like the input dim (fsdp), b like the output dim (tp)."""
+    from jax.sharding import PartitionSpec as P
+
+    out = dict(specs)
+    layer = dict(specs["layers"])
+    for k in targets:
+        if k not in layer:
+            continue
+        s = layer[k]
+        if isinstance(s, QuantWeight):  # quantized base spec (QLoRA)
+            in_ax, out_ax = s.q[1], s.q[2]
+        else:
+            in_ax, out_ax = s[1], s[2]
+        layer[k] = LoraWeight(base=s, a=P(None, in_ax, None),
+                              b=P(None, None, out_ax), alpha=0.0)
+    out["layers"] = layer
+    return out
+
+
+def num_trainable(params: dict) -> int:
+    """Adapter parameter count (what the optimizer actually updates)."""
+    n = 0
+    for leaf in jax.tree_util.tree_leaves(
+            params, is_leaf=lambda x: isinstance(x, LoraWeight)):
+        if isinstance(leaf, LoraWeight):
+            n += leaf.a.size + leaf.b.size
+    return n

@@ -95,11 +95,42 @@ def quantize_tensor(w, contract_axis: int = -2) -> QuantWeight:
     return QuantWeight(q=q, scale=scale)
 
 
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class LoraWeight:
+    """Low-rank adapter around a frozen base weight: ``w ≈ base +
+    (alpha/r)·a@b`` (models/lora.py builds/merges these). ``base`` may
+    itself be a QuantWeight — that composition IS QLoRA (int8 frozen base,
+    trainable bf16 adapters). ``mm`` stops gradients at the base, so only
+    a/b train; ``alpha`` rides the pytree aux data (static)."""
+
+    base: Any
+    a: Any  # [..., in, r]
+    b: Any  # [..., r, out]
+    alpha: float = 16.0
+
+    def tree_flatten(self):
+        return (self.base, self.a, self.b), self.alpha
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(children[0], children[1], children[2], aux)
+
+    @property
+    def shape(self):
+        return self.base.shape
+
+
 def mm(x, w):
-    """``x @ w`` with transparent QuantWeight dispatch (trace-time only —
-    the isinstance check costs nothing at runtime). The dequant epilogue
-    runs in f32 and casts back to the activation dtype; XLA fuses it into
-    the matmul."""
+    """``x @ w`` with transparent QuantWeight/LoraWeight dispatch
+    (trace-time only — the isinstance checks cost nothing at runtime). The
+    dequant epilogue runs in f32 and casts back to the activation dtype;
+    XLA fuses it into the matmul."""
+    if isinstance(w, LoraWeight):
+        rank = w.a.shape[-1]
+        base = jax.lax.stop_gradient(w.base)  # LoRA contract: base frozen
+        delta = (x @ w.a.astype(x.dtype)) @ w.b.astype(x.dtype)
+        return mm(x, base) + delta * (w.alpha / rank)
     if isinstance(w, QuantWeight):
         y = x @ w.q.astype(x.dtype)
         return (y.astype(jnp.float32) * w.scale).astype(x.dtype)

@@ -58,15 +58,34 @@ def create_server(model: str, manager_endpoint: str | None = None,
 
     if weight_quant not in ("", "int8"):
         raise ValueError(f"unknown weight_quant {weight_quant!r}")
+    mesh = None
+    if tp > 1:
+        # tensor-parallel serving (the reference's --tp-size role,
+        # launch_sglang.sh:13): params/KV shard over tp chips of this host.
+        # Built BEFORE param materialization so weights never stage
+        # unsharded through one chip's HBM (the models tp exists for don't
+        # fit one chip).
+        if backend != "cb":
+            raise NotImplementedError("tp > 1 requires backend='cb'")
+        from polyrl_tpu.parallel import mesh as meshlib
+
+        devs = jax.devices()
+        if len(devs) % tp != 0:
+            raise ValueError(f"tp={tp} does not divide {len(devs)} devices")
+        mesh = meshlib.make_mesh(meshlib.MeshConfig(fsdp=1, tp=tp),
+                                 devs[:tp])
     if os.path.isdir(model):
         # a local HF checkpoint dir: pretrained weights + config.json arch.
         # With int8, the loader quantizes host-side — the full-precision
-        # tree never exists on device (8B on a 16 GiB chip).
+        # tree never exists on device (8B on a 16 GiB chip). Under tp the
+        # leaves stay host-side and the engine device_puts each one
+        # straight into its sharded layout.
         from polyrl_tpu.models.hf_loader import build_from_hf
 
         cfg, params = build_from_hf(model, dtype=getattr(jnp, dtype),
                                     overrides=model_overrides,
-                                    quantize=weight_quant)
+                                    quantize=weight_quant,
+                                    to_device=mesh is None)
     else:
         cfg = decoder.get_config(model, dtype=getattr(jnp, dtype),
                                  **(model_overrides or {}))
@@ -76,6 +95,18 @@ def create_server(model: str, manager_endpoint: str | None = None,
             # leaf-by-leaf device init in quantized form (same draws as
             # init_params; the bf16 tree never materializes)
             params = init_quantized_params(jax.random.PRNGKey(seed), cfg)
+        elif mesh is not None:
+            # born sharded: out_shardings places each leaf across tp at
+            # init, no single-chip staging of the full tree
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            specs = decoder.param_specs(cfg)
+            shardings = jax.tree_util.tree_map(
+                lambda s: NamedSharding(mesh, s), specs,
+                is_leaf=lambda x: isinstance(x, P))
+            params = jax.jit(
+                lambda: decoder.init_params(jax.random.PRNGKey(seed), cfg),
+                out_shardings=shardings)()
         else:
             params = jax.jit(
                 lambda: decoder.init_params(jax.random.PRNGKey(seed), cfg))()
@@ -89,19 +120,6 @@ def create_server(model: str, manager_endpoint: str | None = None,
         weight_template = jax.eval_shape(
             lambda: decoder.init_params(jax.random.PRNGKey(seed), cfg))
         weight_preprocess = quantize_params
-    mesh = None
-    if tp > 1:
-        # tensor-parallel serving (the reference's --tp-size role,
-        # launch_sglang.sh:13): params/KV shard over tp chips of this host
-        if backend != "cb":
-            raise NotImplementedError("tp > 1 requires backend='cb'")
-        from polyrl_tpu.parallel import mesh as meshlib
-
-        devs = jax.devices()
-        if len(devs) % tp != 0:
-            raise ValueError(f"tp={tp} does not divide {len(devs)} devices")
-        mesh = meshlib.make_mesh(meshlib.MeshConfig(fsdp=1, tp=tp),
-                                 devs[:tp])
     if backend == "cb":
         engine = CBEngine(
             cfg, params, pad_token_id=0, kv_cache_dtype=getattr(jnp, dtype),

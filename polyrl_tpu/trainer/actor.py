@@ -48,6 +48,12 @@ class ActorConfig:
     # phase in colocated time-slicing (the reference's FSDP optimizer CPU
     # offload, stream_fsdp_workers.py:308-316,386-389)
     offload_optimizer: bool = False
+    # LoRA fine-tuning (models/lora.py; the reference exposes this through
+    # verl's config but marks it untested, stream_fsdp_workers.py:224):
+    # rank > 0 wraps attention + dense-MLP weights in adapters, freezes the
+    # base, and the optimizer updates only a/b. Weight pushes merge.
+    lora_rank: int = 0
+    lora_alpha: float = 16.0
     # Skip (don't apply) optimizer updates containing non-finite values: a
     # single poisoned minibatch (corrupt rollout data, overflowed loss) must
     # degrade one step, not NaN the params and cascade NaN logits into every
@@ -171,6 +177,13 @@ class StreamActor:
         self.mesh = mesh
         self.attn_fn = attn_fn if attn_fn is not None else default_train_attention()
         self.layers_fn = layers_fn  # pipeline-parallel layer stack (pp > 1)
+        self._lora = cfg.lora_rank > 0
+        if self._lora:
+            from polyrl_tpu.models import lora as lora_mod
+
+            params = lora_mod.wrap_lora(
+                params, jax.random.PRNGKey(7919 + cfg.lora_rank),
+                cfg.lora_rank, cfg.lora_alpha)
         if mesh is not None:
             # GSPMD entry: params shard over (fsdp, tp) per decoder.param_specs
             # and every feed shards over the batch spec (see update_stream);
@@ -179,12 +192,28 @@ class StreamActor:
             # multi-host (the mesh just spans more processes).
             from polyrl_tpu.parallel import mesh as meshlib
 
-            params = meshlib.shard_params(mesh, params,
-                                          decoder.param_specs(model_cfg))
+            specs = decoder.param_specs(model_cfg)
+            if self._lora:
+                from polyrl_tpu.models import lora as lora_mod
+
+                specs = lora_mod.lora_param_specs(specs)
+            params = meshlib.shard_params(mesh, params, specs)
         self.params = params
         self.optimizer = make_optimizer(cfg)
+        if self._lora:
+            # adapters are the ONLY trainable leaves: frozen leaves get
+            # set_to_zero updates and no optimizer state
+            from polyrl_tpu.models import lora as lora_mod
+
+            self.optimizer = lora_mod.lora_optimizer(self.optimizer, params)
         self.opt_state = self.optimizer.init(params)
-        self.accum_grads = jax.tree_util.tree_map(jnp.zeros_like, params)
+        if self._lora:
+            from polyrl_tpu.models import lora as lora_mod
+
+            self._labels = lora_mod.lora_labels(params)
+        else:
+            self._labels = None
+        self.accum_grads = self._zero_accum(params)
         # sum of loss_scales accumulated since the last opt step: a tail
         # flush renormalizes by it so a partial minibatch sees the same
         # effective gradient scale as a full one (mean over actual micros,
@@ -194,6 +223,18 @@ class StreamActor:
         self._logprob_fns: dict = {}
         self._opt_offloaded = False
         self._opt_shardings = None
+
+    def export_params(self):
+        """Params in the plain full-precision layout the rollout plane and
+        transfer fabric expect: LoRA adapters merged into their bases; a
+        plain tree passes through unchanged."""
+        if not self._lora:
+            return self.params
+        from polyrl_tpu.models import lora as lora_mod
+
+        if not hasattr(self, "_merge_fn"):
+            self._merge_fn = jax.jit(lora_mod.merge_lora)
+        return self._merge_fn(self.params)
 
     # -- optimizer host offload (reference FSDP opt CPU offload,
     # stream_fsdp_workers.py:308-316: load lazily, offload after step) ----
@@ -272,14 +313,34 @@ class StreamActor:
             metrics["actor/kl_loss"] = kl_loss
         return loss * loss_scale, metrics
 
+    def _zero_accum(self, tree):
+        """Gradient-accumulation buffers: full zeros_like normally; under
+        LoRA the frozen leaves collapse to scalar placeholders — a second
+        full model copy in HBM (plus full-size accumulate adds every
+        micro) would give up most of LoRA's training-memory win."""
+        if self._labels is None:
+            return jax.tree_util.tree_map(jnp.zeros_like, tree)
+        return jax.tree_util.tree_map(
+            lambda x, l: (jnp.zeros((), x.dtype) if l == "freeze"
+                          else jnp.zeros_like(x)), tree, self._labels)
+
     def _build_update(self, is_opt_step: bool):
         optimizer = self.optimizer
+        labels = self._labels
 
         def update(params, opt_state, accum_grads, batch, loss_scale):
             (loss, metrics), grads = jax.value_and_grad(self._loss_fn, has_aux=True)(
                 params, batch, loss_scale
             )
-            accum_grads = jax.tree_util.tree_map(jnp.add, accum_grads, grads)
+            if labels is None:
+                accum_grads = jax.tree_util.tree_map(jnp.add, accum_grads,
+                                                     grads)
+            else:
+                # frozen leaves keep their scalar placeholder (their grads
+                # are structurally zero via mm's stop_gradient anyway)
+                accum_grads = jax.tree_util.tree_map(
+                    lambda a, g, l: a if l == "freeze" else a + g,
+                    accum_grads, grads, labels)
             if is_opt_step:
                 updates, opt_state = optimizer.update(accum_grads, opt_state, params)
                 params = optax.apply_updates(params, updates)

@@ -365,7 +365,11 @@ class StreamRLTrainer:
         GATHERING cross-host-sharded params is collective — every host
         allgathers to host numpy first, or pack_params on process 0 would
         raise on non-addressable shards."""
-        params = self.actor.params
+        # export: LoRA actors merge adapters into the plain layout here —
+        # the wire format and the rollout engines never see wrapper nodes
+        params = (self.actor.export_params()
+                  if hasattr(self.actor, "export_params")
+                  else self.actor.params)
         if self._multi:
             from jax.experimental import multihost_utils as mhu
 

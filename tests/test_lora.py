@@ -1,0 +1,167 @@
+"""LoRA adapters (models/lora.py + quant.LoraWeight).
+
+The reference exposes LoRA via verl's config but marks it untested
+(stream_fsdp_workers.py:224 FIXME); here it is first-class: wrapper-based
+(no decoder changes), frozen base via stop_gradient + masked optimizer,
+merge-on-push for the rollout plane, and QLoRA by wrapping an int8 base.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from polyrl_tpu.models import decoder
+from polyrl_tpu.models.lora import (
+    lora_optimizer,
+    merge_lora,
+    num_trainable,
+    wrap_lora,
+)
+from polyrl_tpu.models.quant import LoraWeight, quantize_params
+
+
+def _setup():
+    cfg = decoder.get_config("tiny", dtype=jnp.float32)
+    params = decoder.init_params(jax.random.PRNGKey(0), cfg)
+    return cfg, params
+
+
+def test_wrap_is_exact_noop_at_init():
+    """b = 0 ⇒ the wrapped model computes exactly the base model."""
+    cfg, params = _setup()
+    wrapped = wrap_lora(params, jax.random.PRNGKey(1), rank=4)
+    assert isinstance(wrapped["layers"]["wq"], LoraWeight)
+    ids = jax.random.randint(jax.random.PRNGKey(2), (2, 10), 1, cfg.vocab_size)
+    pos = jnp.broadcast_to(jnp.arange(10), (2, 10))
+    mask = jnp.ones((2, 10))
+    ref, _ = decoder.forward(params, cfg, ids, pos, mask)
+    got, _ = decoder.forward(wrapped, cfg, ids, pos, mask)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=1e-6, atol=1e-6)
+    n = num_trainable(wrapped)
+    total = sum(x.size for x in jax.tree_util.tree_leaves(params))
+    assert 0 < n < total * 0.2
+
+
+def test_base_frozen_adapters_train():
+    """Gradients stop at the base; only a/b leaves receive updates through
+    the masked optimizer."""
+    import optax
+
+    cfg, params = _setup()
+    wrapped = wrap_lora(params, jax.random.PRNGKey(1), rank=4)
+    opt = lora_optimizer(optax.adam(1e-2), wrapped)
+    opt_state = opt.init(wrapped)
+    ids = jax.random.randint(jax.random.PRNGKey(3), (2, 8), 1, cfg.vocab_size)
+    pos = jnp.broadcast_to(jnp.arange(8), (2, 8))
+    mask = jnp.ones((2, 8))
+
+    def loss(p):
+        logits, _ = decoder.forward(p, cfg, ids, pos, mask)
+        return jnp.mean(jax.nn.log_softmax(logits)[..., 1])
+
+    p = wrapped
+    for _ in range(2):  # step 1 moves b; step 2 moves a (b started at 0)
+        g = jax.grad(loss)(p)
+        upd, opt_state = opt.update(g, opt_state, p)
+        p = optax.apply_updates(p, upd)
+    wq0, wq1 = wrapped["layers"]["wq"], p["layers"]["wq"]
+    np.testing.assert_array_equal(np.asarray(wq1.base), np.asarray(wq0.base))
+    assert np.abs(np.asarray(wq1.b)).max() > 0.0
+    assert not np.allclose(np.asarray(wq1.a), np.asarray(wq0.a))
+    # embed is untargeted and unmasked=frozen too
+    np.testing.assert_array_equal(np.asarray(p["embed"]),
+                                  np.asarray(wrapped["embed"]))
+
+
+def test_merge_matches_wrapped_forward():
+    """After training-style perturbation, merge_lora's plain tree computes
+    the same logits as the wrapped tree."""
+    cfg, params = _setup()
+    wrapped = wrap_lora(params, jax.random.PRNGKey(1), rank=4)
+    # perturb b so the adapter is non-trivial
+    wrapped["layers"]["wq"] = LoraWeight(
+        base=wrapped["layers"]["wq"].base,
+        a=wrapped["layers"]["wq"].a,
+        b=jnp.ones_like(wrapped["layers"]["wq"].b) * 0.01,
+        alpha=wrapped["layers"]["wq"].alpha)
+    merged = merge_lora(wrapped)
+    assert not isinstance(merged["layers"]["wq"], LoraWeight)
+    assert (jax.tree_util.tree_structure(merged)
+            == jax.tree_util.tree_structure(params))
+    ids = jax.random.randint(jax.random.PRNGKey(4), (2, 10), 1, cfg.vocab_size)
+    pos = jnp.broadcast_to(jnp.arange(10), (2, 10))
+    mask = jnp.ones((2, 10))
+    a, _ = decoder.forward(wrapped, cfg, ids, pos, mask)
+    b, _ = decoder.forward(merged, cfg, ids, pos, mask)
+    np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_qlora_int8_base():
+    """Wrapping a quantized tree = QLoRA: frozen int8 base + trainable bf16
+    adapters; forward runs and merge dequantizes to a plain tree."""
+    cfg, params = _setup()
+    qwrapped = wrap_lora(quantize_params(params), jax.random.PRNGKey(1),
+                         rank=4)
+    assert qwrapped["layers"]["wq"].base.q.dtype == jnp.int8
+    ids = jax.random.randint(jax.random.PRNGKey(5), (1, 8), 1, cfg.vocab_size)
+    pos = jnp.arange(8)[None]
+    mask = jnp.ones((1, 8))
+    logits, _ = decoder.forward(qwrapped, cfg, ids, pos, mask)
+    assert np.all(np.isfinite(np.asarray(logits)))
+    merged = merge_lora(qwrapped)
+    assert not isinstance(merged["layers"]["wq"], LoraWeight)
+    assert merged["layers"]["wq"].shape == params["layers"]["wq"].shape
+
+
+def test_lora_grpo_e2e_fit_and_push():
+    """StreamActor with lora_rank: one GRPO fit step trains adapters only,
+    and the weight push delivers a MERGED plain tree to the engine."""
+    from polyrl_tpu.data.dataset import PromptDataLoader, make_arithmetic_dataset
+    from polyrl_tpu.rewards.manager import load_reward_manager
+    from polyrl_tpu.rollout.engine import RolloutEngine
+    from polyrl_tpu.trainer.actor import (
+        ActorConfig, ReferencePolicy, StreamActor,
+    )
+    from polyrl_tpu.trainer.stream_trainer import StreamRLTrainer, TrainerConfig
+    from polyrl_tpu.utils.tokenizer import ByteTokenizer
+
+    cfg = decoder.get_config("tiny", dtype=jnp.float32, vocab_size=512,
+                             max_position_embeddings=128)
+    params = decoder.init_params(jax.random.PRNGKey(0), cfg)
+    tok = ByteTokenizer()
+    engine = RolloutEngine(cfg, params, pad_token_id=tok.pad_token_id,
+                           batch_buckets=(16,), prompt_buckets=(16,),
+                           kv_cache_dtype=jnp.float32)
+    tcfg = TrainerConfig(
+        train_batch_size=4, rollout_n=2, ppo_mini_batch_size=8,
+        micro_batch_size=4, min_stream_batch_size=4,
+        max_prompt_length=16, max_response_length=8,
+        adv_estimator="grpo", total_steps=1, temperature=1.0,
+    )
+    # use_kl_loss guarantees nonzero grads even when every group's rewards
+    # tie (all-equal → zero GRPO advantage → zero pg grads, by design)
+    actor = StreamActor(cfg, ActorConfig(lr=1e-2, remat=False, lora_rank=4,
+                                         use_kl_loss=True, entropy_coeff=0.01),
+                        params)
+    base0 = np.asarray(actor.params["layers"]["wq"].base).copy()
+    trainer = StreamRLTrainer(
+        tcfg, actor, engine, tok,
+        load_reward_manager("naive", tok, num_workers=1),
+        PromptDataLoader(make_arithmetic_dataset(32), tcfg.train_batch_size),
+        ref_policy=ReferencePolicy(cfg, params))
+    hist = trainer.fit()
+    assert len(hist) == 1 and np.isfinite(hist[0]["actor/pg_loss"])
+    wq = actor.params["layers"]["wq"]
+    assert isinstance(wq, LoraWeight)
+    np.testing.assert_array_equal(np.asarray(wq.base), base0)
+    assert np.abs(np.asarray(wq.b)).max() > 0.0  # adapters moved
+    # the engine received a MERGED plain tree via export_params
+    assert engine.weight_version >= 2
+    assert not isinstance(engine.params["layers"]["wq"], LoraWeight)
+    assert (jax.tree_util.tree_structure(engine.params)
+            == jax.tree_util.tree_structure(params))
+    engine_wq = np.asarray(engine.params["layers"]["wq"])
+    merged_wq = np.asarray(merge_lora(actor.params)["layers"]["wq"])
+    np.testing.assert_allclose(engine_wq, merged_wq, rtol=1e-5, atol=1e-6)
