@@ -415,23 +415,38 @@ def main() -> None:
     n_chips = max(len(jax.devices()), 1)
 
     extra: dict = {"hbm_gb": round(_hbm_limit_gb(), 1)}
+    # per-phase isolation: a later phase crashing (or a flaky TPU tunnel)
+    # must not discard earlier phases' measurements — the driver records
+    # whatever JSON line this process prints
     if "bucketed" in phases:
-        extra["bucketed"] = bench_bucketed(cfg, params, batch, prompt_len,
-                                           new_tokens)
+        try:
+            extra["bucketed"] = bench_bucketed(cfg, params, batch, prompt_len,
+                                               new_tokens)
+        except Exception as exc:  # noqa: BLE001
+            extra["bucketed"] = {"error": str(exc)[:300]}
         _note("bucketed", extra["bucketed"])
     if "cb" in phases:
-        extra["cb"] = bench_cb(
-            cfg, params, batch, prompt_len, new_tokens,
-            max_slots=int(os.environ.get("POLYRL_BENCH_SLOTS", "128")),
-            steps_per_dispatch=int(os.environ.get("POLYRL_BENCH_K", "8")))
+        try:
+            extra["cb"] = bench_cb(
+                cfg, params, batch, prompt_len, new_tokens,
+                max_slots=int(os.environ.get("POLYRL_BENCH_SLOTS", "128")),
+                steps_per_dispatch=int(os.environ.get("POLYRL_BENCH_K", "8")))
+        except Exception as exc:  # noqa: BLE001
+            extra["cb"] = {"error": str(exc)[:300]}
         _note("cb", extra["cb"])
     if "weight_sync" in phases:
-        extra["weight_sync"] = bench_weight_sync(params)
+        try:
+            extra["weight_sync"] = bench_weight_sync(params)
+        except Exception as exc:  # noqa: BLE001
+            extra["weight_sync"] = {"error": str(exc)[:300]}
         _note("weight_sync", extra["weight_sync"])
     if "8b" in phases:
         del params
         gc.collect()
-        extra["llama3_8b"] = bench_8b(preset_8b)
+        try:
+            extra["llama3_8b"] = bench_8b(preset_8b)
+        except Exception as exc:  # noqa: BLE001
+            extra["llama3_8b"] = {"error": str(exc)[:300]}
         _note("llama3_8b", extra["llama3_8b"])
 
     cb_serve = (extra.get("cb") or {}).get("serve_tok_s")
@@ -451,4 +466,15 @@ def main() -> None:
 
 
 if __name__ == "__main__":
-    main()
+    try:
+        main()
+    except Exception as exc:  # noqa: BLE001 — always emit the JSON line:
+        # a dead TPU tunnel at bench time should record WHAT failed, not
+        # leave the round without a bench artifact
+        import traceback
+
+        traceback.print_exc()
+        print(json.dumps({
+            "metric": "bench_failed", "value": 0.0, "unit": "tok/s/chip",
+            "vs_baseline": 0.0, "extra": {"error": str(exc)[:500]},
+        }))

@@ -113,9 +113,12 @@ class CBEngine:
         enable_prefix_cache: bool = True,
         steps_per_dispatch: int = 8,
         mesh=None,
+        prefill_chunk: int = 0,
     ):
         assert all(b % page_size == 0 for b in prompt_buckets), \
             "prompt buckets must be page-aligned"
+        assert prefill_chunk % page_size == 0, \
+            "prefill_chunk must be page-aligned"
         self.cfg = cfg
         self.mesh = mesh
         if mesh is not None:
@@ -192,6 +195,13 @@ class CBEngine:
         # device iterations per finished slot and up to k steps of
         # abort/admission latency
         self.steps_per_dispatch = max(1, int(steps_per_dispatch))
+        # chunked prefill (the vLLM/SGLang feature, static-shape style):
+        # prompts longer than this prefill one chunk per loop iteration,
+        # interleaved with decode steps, so a 4k-token admission cannot
+        # stall every running stream for a whole long prefill dispatch.
+        # 0 disables (prompts prefill in one dispatch as before).
+        self.prefill_chunk = int(prefill_chunk)
+        self._chunk_jobs: collections.deque = collections.deque()
 
         # serving telemetry (server_info contract)
         self.weight_version = 0
@@ -443,6 +453,110 @@ class CBEngine:
             self._prefill_fns[key] = jax.jit(prefill, donate_argnums=(1, 2))
         return self._prefill_fns[key]
 
+    def _get_prefill_extend(self, pb: int, n_prefix_pg: int):
+        """Chunked prefill's mid-chunk: fill the chunk's KV attending over
+        the already-filled prefix pages — no sampling, no slot insertion
+        (the FINAL chunk goes through the suffix path, which samples and
+        activates the slot)."""
+        key = ("ext", pb, n_prefix_pg)
+        if key not in self._prefill_fns:
+            cfg = self.cfg
+            n_pg, pps = pb // self.page_size, self.pages_per_slot
+
+            def extend(params, kp, vp, packed, rng):
+                (ids, page_ids, _row, _stop, prefix_ids, suffix_len,
+                 prefix_len, *_rest) = self._unpack_prefill(
+                    packed, pb, n_pg, pps, n_prefix_pg)
+                (kp, vp), _ = decoder.prefill_suffix_into_pages(
+                    params, cfg, ids, suffix_len, prefix_len, (kp, vp),
+                    prefix_ids, page_ids)
+                return kp, vp, rng
+
+            self._prefill_fns[key] = jax.jit(extend, donate_argnums=(1, 2))
+        return self._prefill_fns[key]
+
+    def _pack_suffix(self, tokens, suffix_len: int, prefix_len: int,
+                     prefix_pages: list[int], sfx_pages: list[int],
+                     row, stops, slot: int, budget: int, sp):
+        """Shared packing for the suffix-attending prefill variants (cache
+        hit, chunk extend, chunk final): returns (packed, pb, n_pre_b)."""
+        pb = next_bucket(suffix_len, self.prompt_buckets)
+        n_sfx_pages = -(-suffix_len // self.page_size)
+        page_ids = np.zeros((pb // self.page_size,), np.int32)
+        page_ids[:n_sfx_pages] = sfx_pages[:n_sfx_pages]
+        n_pre_b = 1
+        while n_pre_b < len(prefix_pages):
+            n_pre_b *= 2
+        prefix_ids = np.zeros((n_pre_b,), np.int32)
+        prefix_ids[:len(prefix_pages)] = prefix_pages
+        ids = np.full((pb,), self.pad_token_id, np.int32)
+        ids[:suffix_len] = tokens
+        packed = self._pack_prefill(ids, page_ids, row, stops, prefix_ids,
+                                    suffix_len, prefix_len, slot, budget, sp)
+        return packed, pb, n_pre_b
+
+    def _advance_chunk_job(self) -> None:
+        """One chunk of the head chunked-prefill job — one dispatch per loop
+        iteration, so decode steps interleave with long-prompt admission."""
+        job = self._chunk_jobs[0]
+        req = job["req"]
+        if req.abort is not None and req.abort.is_set():
+            self._chunk_jobs.popleft()
+            self._emit_abort(req)
+            self._finalize(job["slot"])
+            return
+        if self.weight_version != job["version"]:
+            # a weight swap landed mid-job: the filled chunks' KV belongs
+            # to the OLD weights — finishing (and publishing) would mix
+            # weight versions into the freshly flushed prefix cache. Abort;
+            # the manager's continuation layer re-dispatches.
+            self._chunk_jobs.popleft()
+            self._emit_abort(req)
+            self._finalize(job["slot"])
+            return
+        n_prompt = len(req.input_ids)
+        remaining = n_prompt - job["pos"]
+        if remaining <= self.prefill_chunk:
+            # final chunk: standard suffix admission (samples the first
+            # token, activates the slot, publishes the whole prompt)
+            self._chunk_jobs.popleft()
+            self._slots[job["slot"]] = None  # _prefill_request re-creates
+            try:
+                self._prefill_request(
+                    job["slot"], req, job["pages"], job["budget"],
+                    matched_pages=job["matched_pages"],
+                    matched_entries=job["matched_entries"],
+                    own_prefix_pages=job["own_filled"])
+            except Exception:
+                # mirror _admit's failure contract: the job left the deque
+                # and the slot placeholder, so no other path can clean it
+                self.allocator.free(job["pages"])
+                if self.prefix_cache is not None:
+                    self.prefix_cache.release(job["matched_entries"])
+                self._emit_error(req, "prefill failed")
+                raise  # pools may be donation-poisoned: _recover resets
+            return
+        chunk = self.prefill_chunk
+        pos = job["pos"]
+        prefix_pages = (job["matched_pages"]
+                        + job["pages"][:job["own_filled"]])
+        n_chunk_pg = chunk // self.page_size
+        chunk_pages = job["pages"][job["own_filled"]:
+                                   job["own_filled"] + n_chunk_pg]
+        packed, pb, n_pre_b = self._pack_suffix(
+            req.input_ids[pos:pos + chunk], chunk, pos, prefix_pages,
+            chunk_pages, np.zeros((self.pages_per_slot,), np.int32),
+            np.full((MAX_STOP_TOKENS,), -1, np.int32), job["slot"], 0,
+            req.sampling)
+        fn = self._get_prefill_extend(pb, n_pre_b)
+        # on failure the job still heads the deque: _recover's
+        # _abort_chunk_jobs frees pages/entries and emits the terminal line
+        kp, vp, self._rng = fn(self.params, self._pools[0], self._pools[1],
+                               jnp.asarray(packed), self._rng)
+        self._pools = (kp, vp)
+        job["pos"] = pos + chunk
+        job["own_filled"] += n_chunk_pg
+
     def _get_prefill_suffix(self, pb: int, n_prefix_pg: int, use_filters: bool):
         """Prefix-cache-hit fused prefill: compute only the suffix, attend
         over cached prefix pages. Compile key = (suffix bucket, prefix-page
@@ -577,6 +691,10 @@ class CBEngine:
         # every in-flight and queued request must still see a terminal line +
         # STREAM_END or its HTTP handler thread blocks forever
         self._fail_all("engine shutdown")
+        while self._chunk_jobs:
+            job = self._chunk_jobs.popleft()
+            self._emit_error(job["req"], "engine shutdown")
+            self._finalize(job["slot"])
         self._drain_queue()
         while self._pending:
             self._emit_error(self._pending.popleft(), "engine shutdown")
@@ -633,6 +751,10 @@ class CBEngine:
         if self._idle.wait(timeout=30.0):
             with self._pool_lock:
                 if not self._active.any():
+                    # mid-chunk prefill jobs lose their filled KV with the
+                    # pool — abort them (the manager's continuation layer
+                    # re-dispatches aborted requests)
+                    self._abort_chunk_jobs()
                     if self.prefix_cache is not None:
                         self.prefix_cache.flush()
                     self._pools = None
@@ -661,7 +783,8 @@ class CBEngine:
             time.sleep(0.02)
             return
         self._drain_queue()
-        if not self._pending and not self._active.any():
+        if (not self._pending and not self._active.any()
+                and not self._chunk_jobs):
             self._drain_emit_q()  # drain only ever deactivates slots
             self._idle.set()
             try:
@@ -674,10 +797,23 @@ class CBEngine:
             if self._paused.is_set():  # raced with release_memory
                 return
             self._admit()
+            if self._chunk_jobs:
+                # one chunk per iteration: long-prompt admission interleaves
+                # with the decode step below instead of monopolizing the
+                # device for the whole prefill
+                t0 = time.monotonic()
+                self._advance_chunk_job()
+                self._tmark("chunk_prefill", t0)
             if self._active.any():
                 self._step_once()
-            elif self._pending:
+            elif self._pending and not self._chunk_jobs:
                 time.sleep(0.005)  # pending but blocked on pages/slots
+
+    def _abort_chunk_jobs(self) -> None:
+        while self._chunk_jobs:
+            job = self._chunk_jobs.popleft()
+            self._emit_abort(job["req"])
+            self._finalize(job["slot"])
 
     def _recover(self) -> None:
         """After any jit failure the pools may have been donated to the dead
@@ -686,6 +822,7 @@ class CBEngine:
         self._invalidate_dev_state()
         self._fail_all("engine error")
         with self._pool_lock:
+            self._abort_chunk_jobs()
             if self.prefix_cache is not None:
                 self.prefix_cache.flush()
             self._pools = self._make_pools()
@@ -775,28 +912,56 @@ class CBEngine:
                     break
                 if first_key is not None:
                     wave_page_keys.add(first_key)
-            need = n_pages - len(matched_pages)
-            pages = self.allocator.alloc(need)
-            if pages is None and self._emit_q:
-                # drain: finished slots return their pages
-                self._drain_emit_q()
-                pages = self.allocator.alloc(need)
-            if pages is None and self.prefix_cache is not None:
-                # pool pressure: evict unreferenced cached pages and retry
-                if self.prefix_cache.evict(need - self.allocator.free_count):
-                    pages = self.allocator.alloc(need)
-            if pages is None:
+            prefix_cached = len(matched_pages) * self.page_size
+            chunked = (self.prefill_chunk
+                       and n_prompt - prefix_cached > self.prefill_chunk)
+            if chunked and wave:
+                # flush the formed wave first; chunk-admit next round
                 if self.prefix_cache is not None:
                     self.prefix_cache.release(matched_entries)
+                break
+            need = n_pages - len(matched_pages)
+            pages = self._try_alloc(need, matched_entries)
+            if pages is None:
                 break  # head-of-line waits for pages to free
             self._pending.popleft()
             slot = free[0]
             assigned.add(slot)
+            if chunked:
+                # reserve the slot (placeholder keeps it out of the free
+                # scan; active stays False until the final chunk inserts)
+                self._slots[slot] = _SlotInfo(
+                    req, list(pages), set(req.sampling.stop_token_ids),
+                    cache_entries=list(matched_entries))
+                self._chunk_jobs.append({
+                    "req": req, "slot": slot, "pages": list(pages),
+                    "matched_pages": list(matched_pages),
+                    "matched_entries": list(matched_entries),
+                    "budget": budget, "pos": prefix_cached,
+                    "own_filled": 0, "version": self.weight_version,
+                })
+                continue
             wave.append((req, slot, pages, budget, matched_pages,
                          matched_entries))
             if matched_pages:
                 break  # prefix hits admit as singletons
         return wave
+
+    def _try_alloc(self, need: int, matched_entries: list):
+        """Page allocation with the drain + cache-evict fallbacks; releases
+        the caller's matched cache entries on failure."""
+        pages = self.allocator.alloc(need)
+        if pages is None and self._emit_q:
+            # drain: finished slots return their pages
+            self._drain_emit_q()
+            pages = self.allocator.alloc(need)
+        if pages is None and self.prefix_cache is not None:
+            # pool pressure: evict unreferenced cached pages and retry
+            if self.prefix_cache.evict(need - self.allocator.free_count):
+                pages = self.allocator.alloc(need)
+        if pages is None and self.prefix_cache is not None:
+            self.prefix_cache.release(matched_entries)
+        return pages
 
     def _prefill_wave(self, wave: list) -> None:
         """Batched fused admission: ONE dispatch prefills every request in
@@ -869,14 +1034,20 @@ class CBEngine:
 
     def _prefill_request(self, slot: int, req: _Request, pages: list[int],
                          budget: int, matched_pages: list[int] | None = None,
-                         matched_entries: list | None = None) -> None:
+                         matched_entries: list | None = None,
+                         own_prefix_pages: int = 0) -> None:
         """Fused async admission: the compiled prefill also inserts the slot
         into the device control state, and the first token's emission is
-        deferred to the emit queue — no host round trip per request."""
+        deferred to the emit queue — no host round trip per request.
+        ``own_prefix_pages``: leading entries of ``pages`` whose KV is
+        ALREADY filled (chunked prefill's earlier chunks) — they join the
+        attended prefix but, unlike cache-matched pages, belong to this
+        request and get published as fresh pages."""
         matched_pages = matched_pages or []
         matched_entries = list(matched_entries or [])
         n_prompt = len(req.input_ids)
-        prefix_len = len(matched_pages) * self.page_size
+        prefix_pages_all = matched_pages + pages[:own_prefix_pages]
+        prefix_len = len(prefix_pages_all) * self.page_size
         sp = req.sampling
 
         all_pages = matched_pages + pages
@@ -889,22 +1060,14 @@ class CBEngine:
         self._ensure_dev_state()
         state_kwargs = {k: self._dev_state[k] for k in self._STATE_KEYS}
         use_filters = bool(sp.top_p < 1.0 or sp.top_k > 0)
-        if matched_pages:
-            # prefix-cache hit: prefill only the suffix
+        if prefix_pages_all:
+            # prefix-cache hit and/or chunk-filled prefix: prefill only the
+            # remaining suffix, attending over the filled pages
             suffix_len = n_prompt - prefix_len
-            pb = next_bucket(suffix_len, self.prompt_buckets)
-            n_sfx_pages = -(-suffix_len // self.page_size)
-            page_ids = np.zeros((pb // self.page_size,), np.int32)
-            page_ids[:n_sfx_pages] = pages[:n_sfx_pages]
-            n_pre_b = 1
-            while n_pre_b < len(matched_pages):
-                n_pre_b *= 2
-            prefix_ids = np.zeros((n_pre_b,), np.int32)
-            prefix_ids[:len(matched_pages)] = matched_pages
-            ids = np.full((pb,), self.pad_token_id, np.int32)
-            ids[:suffix_len] = req.input_ids[prefix_len:]
-            packed = self._pack_prefill(ids, page_ids, row, stops, prefix_ids,
-                                        suffix_len, prefix_len, slot, budget, sp)
+            packed, pb, n_pre_b = self._pack_suffix(
+                req.input_ids[prefix_len:], suffix_len, prefix_len,
+                prefix_pages_all, pages[own_prefix_pages:], row, stops,
+                slot, budget, sp)
             fn = self._get_prefill_suffix(pb, n_pre_b, use_filters)
         else:
             pb = next_bucket(n_prompt, self.prompt_buckets)

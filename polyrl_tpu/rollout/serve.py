@@ -39,7 +39,8 @@ def create_server(model: str, manager_endpoint: str | None = None,
                   steps_per_dispatch: int = 8,
                   weight_quant: str = "",
                   warmup: bool = False,
-                  tp: int = 1):
+                  tp: int = 1,
+                  prefill_chunk: int = 0):
     """Build engine + server, register with the manager, attach receiver.
 
     ``backend="cb"`` (default) serves with the paged continuous-batching
@@ -126,7 +127,8 @@ def create_server(model: str, manager_endpoint: str | None = None,
             max_slots=max_slots, page_size=page_size, max_seq_len=max_seq_len,
             num_pages=num_pages, steps_per_dispatch=steps_per_dispatch,
             prompt_buckets=tuple(prompt_buckets) if prompt_buckets
-            else (128, 256, 512, 1024, 2048, 4096), seed=seed, mesh=mesh)
+            else (128, 256, 512, 1024, 2048, 4096), seed=seed, mesh=mesh,
+            prefill_chunk=prefill_chunk)
     else:
         kwargs = {}
         if batch_buckets:
@@ -208,6 +210,10 @@ def main() -> None:
                         "128 256 512 1024 2048 4096)")
     p.add_argument("--tp", type=int, default=1,
                    help="tensor-parallel serving over this many chips")
+    p.add_argument("--prefill-chunk", type=int, default=0,
+                   help="chunked prefill: prompts longer than this prefill "
+                        "one page-aligned chunk per engine iteration, "
+                        "interleaved with decode (0 = off)")
     args = p.parse_args()
 
     logging.basicConfig(level=logging.INFO)
@@ -222,7 +228,8 @@ def main() -> None:
                            weight_quant=args.weight_quant,
                            warmup=args.warmup,
                            prompt_buckets=args.prompt_buckets,
-                           tp=args.tp)
+                           tp=args.tp,
+                           prefill_chunk=args.prefill_chunk)
     log.info("rollout server on %s", server.endpoint)
     try:
         while True:

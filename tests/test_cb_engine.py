@@ -359,3 +359,185 @@ def test_cb_engine_tp_quantized_actually_shards():
                      decoder.get_config("tiny", num_kv_heads=1, num_heads=4,
                                         dtype=jnp.float32)),
                  mesh=mesh, **kw)
+
+
+def _mk_engines_for_chunking(prefill_chunk):
+    import jax
+
+    from polyrl_tpu.models import decoder
+    from polyrl_tpu.rollout.cb_engine import CBEngine
+
+    cfg = decoder.get_config("tiny", dtype=jnp.float32)
+    params = decoder.init_params(jax.random.PRNGKey(0), cfg)
+    kw = dict(pad_token_id=0, kv_cache_dtype=jnp.float32, max_slots=4,
+              page_size=8, max_seq_len=96, prompt_buckets=(8, 16, 64),
+              num_pages=96)
+    return cfg, CBEngine(cfg, params, prefill_chunk=prefill_chunk, **kw), kw, params
+
+
+def test_chunked_prefill_matches_unchunked():
+    """A long prompt admitted chunk-by-chunk (extend dispatches + final
+    suffix admission) produces EXACTLY the single-dispatch greedy output."""
+    import jax
+
+    from polyrl_tpu.models import decoder
+    from polyrl_tpu.rollout.cb_engine import CBEngine
+    from polyrl_tpu.rollout.sampling import SamplingParams
+
+    rng = np.random.default_rng(11)
+    cfg, chunked, kw, params = _mk_engines_for_chunking(prefill_chunk=8)
+    prompts = [rng.integers(1, cfg.vocab_size, n).tolist()
+               for n in (24, 40, 5)]  # 2 chunked (3/5 chunks), 1 direct
+    sp = SamplingParams(temperature=0.0, max_new_tokens=8, stop_token_ids=())
+    try:
+        got = [o["token_ids"] for o in chunked.generate(prompts, sp,
+                                                        timeout=180.0)]
+    finally:
+        chunked.stop()
+    plain = CBEngine(cfg, params, **kw)
+    try:
+        ref = [o["token_ids"] for o in plain.generate(prompts, sp,
+                                                      timeout=180.0)]
+    finally:
+        plain.stop()
+    assert got == ref, (got, ref)
+
+
+def test_chunked_prefill_interleaves_with_decode():
+    """While a long prompt chunks in, an already-running stream keeps
+    emitting tokens — the trace must show chunk dispatches AND decode steps
+    interleaved (neither starves)."""
+    import os
+    import time as _time
+
+    os.environ["POLYRL_CB_TRACE"] = "1"
+    try:
+        cfg, engine, kw, params = _mk_engines_for_chunking(prefill_chunk=8)
+        from polyrl_tpu.rollout.sampling import SamplingParams
+
+        rng = np.random.default_rng(12)
+        engine.start()
+        sp_long = SamplingParams(temperature=0.0, max_new_tokens=24,
+                                 stop_token_ids=())
+        # request 1: short prompt, long generation → decoding while...
+        q1 = engine.submit("r1", rng.integers(1, cfg.vocab_size, 5).tolist(),
+                           sp_long)
+        _time.sleep(0.3)  # let it admit and start decoding
+        # ...request 2's 40-token prompt chunks in (5 chunks of 8)
+        q2 = engine.submit("r2", rng.integers(1, cfg.vocab_size, 40).tolist(),
+                           sp_long)
+        from polyrl_tpu.rollout.cb_engine import STREAM_END
+
+        done = 0
+        t0 = _time.monotonic()
+        toks = {"r1": 0, "r2": 0}
+        while done < 2 and _time.monotonic() - t0 < 180:
+            for name, q in (("r1", q1), ("r2", q2)):
+                try:
+                    item = q.get(timeout=0.05)
+                except Exception:  # noqa: BLE001 — queue.Empty
+                    continue
+                if item is STREAM_END:
+                    done += 1
+                elif isinstance(item, dict):
+                    toks[name] += len(item.get("token_ids", []))
+        rep = engine.trace_report()
+        engine.stop()
+        assert toks["r1"] == 24 and toks["r2"] == 24, toks
+        assert rep.get("n_chunk_prefill", 0) >= 5, rep
+        assert rep.get("n_step_dispatch", 0) >= 3, rep
+    finally:
+        os.environ.pop("POLYRL_CB_TRACE", None)
+
+
+def test_chunked_prefill_abort_frees_pages():
+    """Abort fires MID-JOB (after ≥1 chunk dispatched) so the chunk-job
+    abort branch — not _collect_wave's pre-admission check — must free the
+    slot, pages, and cache refs."""
+    import os
+    import threading
+    import time as _time
+
+    from polyrl_tpu.rollout.sampling import SamplingParams
+
+    os.environ["POLYRL_CB_TRACE"] = "1"
+    try:
+        cfg, engine, kw, params = _mk_engines_for_chunking(prefill_chunk=8)
+    finally:
+        os.environ.pop("POLYRL_CB_TRACE", None)
+    engine.start()
+    rng = np.random.default_rng(13)
+    free0 = engine.allocator.free_count
+    abort = threading.Event()
+    q = engine.submit("rA", rng.integers(1, cfg.vocab_size, 40).tolist(),
+                      SamplingParams(temperature=0.0, max_new_tokens=8,
+                                     stop_token_ids=()), abort=abort)
+    t0 = _time.monotonic()
+    while (engine.trace_report().get("n_chunk_prefill", 0) < 1
+           and _time.monotonic() - t0 < 120):
+        _time.sleep(0.01)
+    assert engine.trace_report().get("n_chunk_prefill", 0) >= 1
+    abort.set()
+    from polyrl_tpu.rollout.cb_engine import STREAM_END
+
+    items = []
+    while True:
+        item = q.get(timeout=60)
+        if item is STREAM_END:
+            break
+        items.append(item)
+    assert any(i.get("finish_reason") == "abort" for i in items), items
+    deadline = 10.0
+    import time as _time
+
+    t0 = _time.monotonic()
+    while (engine.allocator.free_count != free0
+           and _time.monotonic() - t0 < deadline):
+        _time.sleep(0.05)
+    engine.stop()
+    assert engine.allocator.free_count == free0
+
+
+def test_chunked_prefill_aborts_on_weight_swap():
+    """A weight update mid-chunk-job must abort the job (its filled KV
+    belongs to the old weights; finishing would publish mixed-version KV
+    into the freshly flushed prefix cache)."""
+    import os
+    import time as _time
+
+    from polyrl_tpu.rollout.cb_engine import STREAM_END
+    from polyrl_tpu.rollout.sampling import SamplingParams
+
+    os.environ["POLYRL_CB_TRACE"] = "1"
+    try:
+        cfg, engine, kw, params = _mk_engines_for_chunking(prefill_chunk=8)
+    finally:
+        os.environ.pop("POLYRL_CB_TRACE", None)
+    engine.start()
+    rng = np.random.default_rng(14)
+    free0 = engine.allocator.free_count
+    q = engine.submit("rW", rng.integers(1, cfg.vocab_size, 40).tolist(),
+                      SamplingParams(temperature=0.0, max_new_tokens=8,
+                                     stop_token_ids=()))
+    t0 = _time.monotonic()
+    while (engine.trace_report().get("n_chunk_prefill", 0) < 1
+           and _time.monotonic() - t0 < 120):
+        _time.sleep(0.01)
+    engine.update_weights(engine.params, version=99)
+    items = []
+    while True:
+        item = q.get(timeout=60)
+        if item is STREAM_END:
+            break
+        items.append(item)
+    reasons = {i.get("finish_reason") for i in items}
+    # either the job aborted (swap landed mid-job) or it already finished
+    # cleanly before the swap (tiny-model race) — but never an error, and
+    # pages always return
+    assert "error" not in reasons, items
+    t0 = _time.monotonic()
+    while (engine.allocator.free_count != free0
+           and _time.monotonic() - t0 < 10):
+        _time.sleep(0.05)
+    engine.stop()
+    assert engine.allocator.free_count == free0
