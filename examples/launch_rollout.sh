@@ -9,12 +9,15 @@ PORT=${PORT:-30000}
 # WEIGHT_QUANT=int8 serves weight-only-quantized (8B-class fits a 16 GiB
 # chip; trainer pushes stay bf16 on the wire and re-quantize on arrival).
 # MODEL=qwen3-30b-a3b (or a Qwen3-MoE checkpoint dir) serves the MoE family.
+# PREFILL_CHUNK=512 interleaves long-prompt admission with decode.
 WEIGHT_QUANT=${WEIGHT_QUANT:-}
+PREFILL_CHUNK=${PREFILL_CHUNK:-512}
 
 python -m polyrl_tpu.rollout.serve \
     --model "$MODEL" \
     --manager-endpoint "$MANAGER" \
     --port "$PORT" \
     --warmup \
+    --prefill-chunk "$PREFILL_CHUNK" \
     ${WEIGHT_QUANT:+--weight-quant "$WEIGHT_QUANT"} \
     "$@"

@@ -61,6 +61,10 @@ class RolloutSection:
     page_size: int = 64
     max_seq_len: int = 16384
     kv_cache_dtype: str = ""              # "" → model dtype
+    # chunked prefill (cb backend): prompts longer than this prefill one
+    # page-aligned chunk per engine iteration, interleaved with decode.
+    # 0 = off (whole-prompt dispatches).
+    prefill_chunk: int = 0
     # disaggregated plumbing (reference rollout_manager.{port,endpoint},
     # workers/config/rollout.py:95-101)
     manager_endpoint: str = ""            # "" → spawn the C++ manager locally
@@ -184,6 +188,9 @@ def _coerce(text: str, current: Any) -> Any:
     if isinstance(current, float):
         return float(text)
     if isinstance(current, tuple):
+        text = text.strip()
+        if text[:1] == "[" and text[-1:] == "]":  # accept [8,16] list syntax
+            text = text[1:-1]
         if not text:
             return ()
         items = [t.strip() for t in text.split(",") if t.strip()]

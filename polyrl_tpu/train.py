@@ -95,7 +95,8 @@ def _build_rollout(cfg: RunConfig, mcfg, params, tokenizer, cleanup: list):
             return CBEngine(
                 mcfg, params, pad_token_id=pad, kv_cache_dtype=kv_dtype,
                 max_slots=cfg.rollout.max_slots, page_size=cfg.rollout.page_size,
-                max_seq_len=cfg.rollout.max_seq_len, **kwargs)
+                max_seq_len=cfg.rollout.max_seq_len,
+                prefill_chunk=cfg.rollout.prefill_chunk, **kwargs)
         from polyrl_tpu.rollout.engine import RolloutEngine
 
         kwargs = {}
@@ -142,6 +143,7 @@ def _build_rollout(cfg: RunConfig, mcfg, params, tokenizer, cleanup: list):
             mcfg, params, pad_token_id=pad, kv_cache_dtype=kv_dtype,
             max_slots=cfg.rollout.max_slots, page_size=cfg.rollout.page_size,
             max_seq_len=cfg.rollout.max_seq_len,
+            prefill_chunk=cfg.rollout.prefill_chunk,
             **({"prompt_buckets": tuple(cfg.rollout.prompt_buckets)}
                if cfg.rollout.prompt_buckets else {}))
         local_server = RolloutServer(eng, host="127.0.0.1", port=0).start()
