@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from polyrl_tpu.models.quant import LoraWeight, QuantWeight
 
@@ -121,6 +122,75 @@ def lora_param_specs(specs: dict, targets=DEFAULT_TARGETS) -> dict:
         layer[k] = LoraWeight(base=s, a=P(None, in_ax, None),
                               b=P(None, None, out_ax), alpha=0.0)
     out["layers"] = layer
+    return out
+
+
+def extract_adapters(params: dict) -> dict:
+    """The adapter subtree alone: {"layers": {k: {"a": ..., "b": ...}},
+    "alpha": scalar} — what a delta weight push puts on the wire
+    (~rank/hidden of the full tree, e.g. ~0.5% at rank 16 on an 8B model).
+    ``alpha`` rides the wire so a trainer/worker scaling mismatch fails
+    loudly at apply time instead of silently serving a different policy."""
+    out: dict = {}
+    alpha = None
+    for k, v in params["layers"].items():
+        if isinstance(v, LoraWeight):
+            out[k] = {"a": v.a, "b": v.b}
+            alpha = v.alpha
+    return {"layers": out, "alpha": jnp.float32(alpha or 0.0)}
+
+
+def adapter_template(model_cfg, rank: int, targets=DEFAULT_TARGETS,
+                     dtype=None) -> dict:
+    """ShapeDtypeStruct tree matching ``extract_adapters`` of a wrapped
+    model — built from the config alone, so the transfer layout can be
+    agreed on by trainer and rollout workers before either holds real
+    adapters."""
+    from polyrl_tpu.models import decoder
+
+    dt = dtype or model_cfg.dtype
+    shapes = jax.eval_shape(
+        lambda: decoder.init_params(jax.random.PRNGKey(0), model_cfg))
+    out: dict = {}
+    for k in targets:
+        if k not in shapes["layers"]:
+            continue
+        L, d_in, d_out = shapes["layers"][k].shape
+        out[k] = {
+            "a": jax.ShapeDtypeStruct((L, d_in, rank), dt),
+            "b": jax.ShapeDtypeStruct((L, rank, d_out), dt),
+        }
+    return {"layers": out, "alpha": jax.ShapeDtypeStruct((), jnp.float32)}
+
+
+def apply_adapters(wrapped: dict, adapters: dict) -> dict:
+    """New wrapped tree with the received a/b installed (device_put
+    preserving each old leaf's sharding); bases untouched — the rollout
+    worker's per-push work is O(adapter bytes), not O(model bytes)."""
+
+    def put(old_leaf, new_host):
+        arr = jnp.asarray(np.asarray(new_host), old_leaf.dtype)
+        sharding = getattr(old_leaf, "sharding", None)
+        return (jax.device_put(arr, sharding) if sharding is not None
+                else arr)
+
+    out = dict(wrapped)
+    layers = dict(wrapped["layers"])
+    recv_alpha = float(np.asarray(adapters.get("alpha", 0.0)))
+    for k, ab in adapters["layers"].items():
+        w = layers[k]
+        if not isinstance(w, LoraWeight):
+            raise ValueError(f"adapter push for unwrapped weight {k!r}")
+        if recv_alpha and abs(recv_alpha - w.alpha) > 1e-6:
+            # alpha scales every delta: a mismatch would silently serve a
+            # DIFFERENT policy than the one being trained
+            raise ValueError(
+                f"lora_alpha mismatch: trainer pushed {recv_alpha}, this "
+                f"worker serves {w.alpha} — launch with --lora-alpha "
+                f"{recv_alpha}")
+        layers[k] = LoraWeight(base=w.base, a=put(w.a, ab["a"]),
+                               b=put(w.b, ab["b"]), alpha=w.alpha)
+    out["layers"] = layers
     return out
 
 

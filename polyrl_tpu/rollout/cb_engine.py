@@ -224,14 +224,28 @@ class CBEngine:
         return dict(self._trace or {})
 
     def _shard_params_for_mesh(self, params):
-        from polyrl_tpu.models.quant import QuantWeight, quant_param_specs
+        from polyrl_tpu.models.quant import (
+            LoraWeight, QuantWeight, quant_param_specs,
+        )
         from polyrl_tpu.parallel import mesh as meshlib
 
+        wrappers = (QuantWeight, LoraWeight)
+        leaves = jax.tree_util.tree_leaves(
+            params, is_leaf=lambda x: isinstance(x, wrappers))
+        has_quant = any(
+            isinstance(x, QuantWeight)
+            or (isinstance(x, LoraWeight) and isinstance(x.base, QuantWeight))
+            for x in leaves)
+        has_lora = any(isinstance(x, LoraWeight) for x in leaves)
         specs = decoder.param_specs(self.cfg)
-        if any(isinstance(leaf, QuantWeight) for leaf in
-               jax.tree_util.tree_leaves(
-                   params, is_leaf=lambda x: isinstance(x, QuantWeight))):
+        if has_quant:
             specs = quant_param_specs(specs)
+        if has_lora:
+            # wrapper specs must mirror the wrapper tree or the path-keyed
+            # lookup misses every wrapped leaf → silent full replication
+            from polyrl_tpu.models.lora import lora_param_specs
+
+            specs = lora_param_specs(specs)
         return meshlib.shard_params(self.mesh, params, specs)
 
     def _make_pools(self):

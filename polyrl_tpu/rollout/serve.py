@@ -40,7 +40,9 @@ def create_server(model: str, manager_endpoint: str | None = None,
                   weight_quant: str = "",
                   warmup: bool = False,
                   tp: int = 1,
-                  prefill_chunk: int = 0):
+                  prefill_chunk: int = 0,
+                  lora_rank: int = 0,
+                  lora_alpha: float = 16.0):
     """Build engine + server, register with the manager, attach receiver.
 
     ``backend="cb"`` (default) serves with the paged continuous-batching
@@ -113,7 +115,8 @@ def create_server(model: str, manager_endpoint: str | None = None,
                 lambda: decoder.init_params(jax.random.PRNGKey(seed), cfg))()
     weight_template = None
     weight_preprocess = None
-    if weight_quant == "int8":
+    weight_apply = None
+    if weight_quant == "int8" and lora_rank == 0:
         from polyrl_tpu.models.quant import quantize_params
 
         # the transfer fabric's layout/unflatten contract stays the
@@ -121,6 +124,20 @@ def create_server(model: str, manager_endpoint: str | None = None,
         weight_template = jax.eval_shape(
             lambda: decoder.init_params(jax.random.PRNGKey(seed), cfg))
         weight_preprocess = quantize_params
+    if lora_rank > 0:
+        # LoRA DELTA sync (trainer.weight_sync=lora_delta): serve the
+        # wrapped tree — the base (possibly int8 ⇒ QLoRA serving) never
+        # changes, and each push carries only the a/b adapters (~rank/
+        # hidden of the full tree), replacing them in place. The trainer
+        # must run the same lora_rank/alpha.
+        from polyrl_tpu.models import lora as lora_mod
+
+        params = lora_mod.wrap_lora(params,
+                                    jax.random.PRNGKey(7919 + lora_rank),
+                                    lora_rank, lora_alpha)
+        weight_template = lora_mod.adapter_template(cfg, lora_rank)
+        weight_preprocess = None
+        weight_apply = lora_mod.apply_adapters
     if backend == "cb":
         engine = CBEngine(
             cfg, params, pad_token_id=0, kv_cache_dtype=getattr(jnp, dtype),
@@ -146,6 +163,7 @@ def create_server(model: str, manager_endpoint: str | None = None,
                            advertise_host=advertise_host)
     server.weight_template = weight_template
     server.weight_preprocess = weight_preprocess
+    server.weight_apply = weight_apply
     server.start()
 
     if manager_endpoint:
@@ -214,6 +232,10 @@ def main() -> None:
                    help="chunked prefill: prompts longer than this prefill "
                         "one page-aligned chunk per engine iteration, "
                         "interleaved with decode (0 = off)")
+    p.add_argument("--lora-rank", type=int, default=0,
+                   help="LoRA delta sync: serve base + adapters; pushes "
+                        "carry only adapters (match the trainer's rank)")
+    p.add_argument("--lora-alpha", type=float, default=16.0)
     args = p.parse_args()
 
     logging.basicConfig(level=logging.INFO)
@@ -229,7 +251,9 @@ def main() -> None:
                            warmup=args.warmup,
                            prompt_buckets=args.prompt_buckets,
                            tp=args.tp,
-                           prefill_chunk=args.prefill_chunk)
+                           prefill_chunk=args.prefill_chunk,
+                           lora_rank=args.lora_rank,
+                           lora_alpha=args.lora_alpha)
     log.info("rollout server on %s", server.endpoint)
     try:
         while True:

@@ -80,9 +80,13 @@ class RolloutServer:
         # quantized serving (models/quant.py): the wire format stays the
         # trainer's bf16 tree — weight_template carries that tree's
         # structure for layout/unflatten, weight_preprocess re-quantizes
-        # each arriving push before the device swap
+        # each arriving push before the device swap. weight_apply (LoRA
+        # delta sync) instead REPLACES the whole install step: it maps
+        # (current engine params, received tree) -> new engine params —
+        # adapter pushes touch only the a/b leaves, never the base.
         self.weight_template = None
         self.weight_preprocess = None
+        self.weight_apply = None
         self._weight_lock = threading.Lock()
         self._loop_thread: threading.Thread | None = None
 
@@ -361,6 +365,15 @@ class RolloutServer:
             template = (self.weight_template if self.weight_template
                         is not None else self.engine.params)
             new_params = unflatten_like(template, named)
+            if self.weight_apply is not None:
+                # delta sync: the received tree is NOT full params (e.g.
+                # LoRA adapters) — the hook installs it into the current
+                # tree itself, device-putting only what changed
+                with self._weight_lock:
+                    self.engine.params = self.weight_apply(
+                        self.engine.params, new_params)
+                    self.engine.weight_version = version
+                return True, ""
             if self.weight_preprocess is not None:
                 new_params = self.weight_preprocess(new_params)
             with self._weight_lock:  # not mid-batch

@@ -82,6 +82,23 @@ def _build_rollout(cfg: RunConfig, mcfg, params, tokenizer, cleanup: list):
     ``python -m polyrl_tpu.rollout.serve``."""
     import jax.numpy as jnp
 
+    if cfg.trainer.weight_sync == "lora_delta":
+        # all delta-sync config validation BEFORE any manager spawn (the
+        # fail-fast convention build_trainer documents for the SP block)
+        if cfg.rollout.mode != "disaggregated":
+            raise NotImplementedError(
+                "weight_sync=lora_delta requires rollout.mode=disaggregated "
+                "(a colocated in-process engine holds the plain tree; "
+                "adapter pushes target workers serving --lora-rank)")
+        if cfg.actor.lora_rank <= 0:
+            raise ValueError(
+                "trainer.weight_sync=lora_delta requires actor.lora_rank>0")
+        if cfg.rollout.colocated_local:
+            raise NotImplementedError(
+                "weight_sync=lora_delta with colocated_local is not "
+                "supported: the in-process engine serves the plain merged "
+                "tree and cannot take adapter-only pushes")
+
     kv_dtype = getattr(jnp, cfg.rollout.kv_cache_dtype or cfg.model.dtype)
     pad = tokenizer.pad_token_id
 
@@ -123,8 +140,17 @@ def _build_rollout(cfg: RunConfig, mcfg, params, tokenizer, cleanup: list):
         log.info("spawned rollout manager on %s", endpoint)
     mgr = ManagerClient(endpoint)
     mgr.wait_healthy()
+    template = params
+    if cfg.trainer.weight_sync == "lora_delta":
+        # LoRA delta sync: the wire carries ONLY adapters (~rank/hidden of
+        # the model); workers must serve with the matching --lora-rank
+        # (combination validated fail-fast at the top of this function)
+        from polyrl_tpu.models import lora as lora_mod
+
+        template = lora_mod.adapter_template(mcfg, cfg.actor.lora_rank)
     iface = TransferInterface(
-        params, manager_client=mgr, num_streams=cfg.rollout.transfer_streams,
+        template, manager_client=mgr,
+        num_streams=cfg.rollout.transfer_streams,
         advertise_host=cfg.rollout.advertise_host)
     cleanup.append(iface.close)
 

@@ -83,6 +83,11 @@ class TrainerConfig:
     kl_coef: float = 0.001
     kl_penalty: str = "kl"
     norm_adv_by_std_in_grpo: bool = True
+    # weight push payload: "full" pushes the merged/plain tree;
+    # "lora_delta" pushes ONLY the LoRA adapters (requires
+    # actor.lora_rank > 0 and rollout workers serving --lora-rank) —
+    # ~rank/hidden of the bytes per sync
+    weight_sync: str = "full"
     # run
     total_steps: int = 10
     seed: int = 0
@@ -111,6 +116,10 @@ class TrainerConfig:
     top_k: int = 0
 
     def __post_init__(self):
+        if self.weight_sync not in ("full", "lora_delta"):
+            raise ValueError(
+                f"weight_sync must be 'full' or 'lora_delta', got "
+                f"{self.weight_sync!r}")
         total = self.train_batch_size * self.rollout_n
         if total % self.ppo_mini_batch_size != 0:
             raise ValueError(
@@ -365,11 +374,18 @@ class StreamRLTrainer:
         GATHERING cross-host-sharded params is collective — every host
         allgathers to host numpy first, or pack_params on process 0 would
         raise on non-addressable shards."""
-        # export: LoRA actors merge adapters into the plain layout here —
-        # the wire format and the rollout engines never see wrapper nodes
-        params = (self.actor.export_params()
-                  if hasattr(self.actor, "export_params")
-                  else self.actor.params)
+        if self.cfg.weight_sync == "lora_delta":
+            # delta sync: only the adapters ride the wire; workers hold the
+            # frozen base and install a/b in place
+            from polyrl_tpu.models import lora as lora_mod
+
+            params = lora_mod.extract_adapters(self.actor.params)
+        else:
+            # export: LoRA actors merge adapters into the plain layout here
+            # — the wire format and the engines never see wrapper nodes
+            params = (self.actor.export_params()
+                      if hasattr(self.actor, "export_params")
+                      else self.actor.params)
         if self._multi:
             from jax.experimental import multihost_utils as mhu
 

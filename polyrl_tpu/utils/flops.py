@@ -26,25 +26,51 @@ DEFAULT_PEAK_TFLOPS = 197.0
 
 def param_count(cfg: Any) -> int:
     """Decoder parameter count from the ModelConfig (embed + L·(attn+mlp+
-    norms) + final norm + head)."""
+    norms) + final norm + head). MoE configs count router + ALL experts."""
     d, L = cfg.hidden_size, cfg.num_layers
     hd = cfg.head_dim_
     q = d * cfg.num_heads * hd
     kv = 2 * d * cfg.num_kv_heads * hd
     o = cfg.num_heads * hd * d
-    mlp = 3 * d * cfg.intermediate_size       # gate, up, down
+    if getattr(cfg, "num_experts", 0):
+        mlp = (d * cfg.num_experts                       # router
+               + cfg.num_experts * 3 * d * cfg.moe_intermediate_size)
+    else:
+        mlp = 3 * d * cfg.intermediate_size              # gate, up, down
     norms = 2 * d
     embed = cfg.vocab_size * d
     head = 0 if cfg.tie_word_embeddings else cfg.vocab_size * d
     return embed + L * (q + kv + o + mlp + norms) + d + head
 
 
+def _active_matmul_params(cfg: Any) -> int:
+    """Matmul params a TOKEN actually touches: for MoE only the top-k
+    routed experts (+ router) do work, so MFU against total params would
+    be wildly understated (e.g. Qwen3-30B-A3B activates ~3B of 30B)."""
+    d, L = cfg.hidden_size, cfg.num_layers
+    hd = cfg.head_dim_
+    attn = (d * cfg.num_heads * hd + 2 * d * cfg.num_kv_heads * hd
+            + cfg.num_heads * hd * d)
+    if getattr(cfg, "num_experts", 0):
+        mlp = (d * cfg.num_experts
+               + cfg.num_experts_per_tok * 3 * d * cfg.moe_intermediate_size)
+    else:
+        mlp = 3 * d * cfg.intermediate_size
+    head = 0 if cfg.tie_word_embeddings else cfg.vocab_size * d
+    return L * (attn + mlp) + head
+
+
 def flops_per_token(cfg: Any, context_len: int, *, training: bool = True,
                     include_embed: bool = False) -> float:
-    """FLOPs for one token at the given mean context length."""
-    p = param_count(cfg)
-    if not include_embed:
-        p -= cfg.vocab_size * cfg.hidden_size  # lookup is not a matmul
+    """FLOPs for one token at the given mean context length (MoE: only the
+    routed top-k experts compute)."""
+    p = _active_matmul_params(cfg)
+    if include_embed:
+        p += cfg.vocab_size * cfg.hidden_size
+        if cfg.tie_word_embeddings:
+            p += cfg.vocab_size * cfg.hidden_size  # the tied head matmul
+    elif cfg.tie_word_embeddings:
+        p += cfg.vocab_size * cfg.hidden_size  # head matmul always runs
     dense = 2.0 * p
     attn = 4.0 * cfg.num_layers * cfg.num_heads * cfg.head_dim_ * context_len
     fwd = dense + attn

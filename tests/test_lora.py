@@ -165,3 +165,129 @@ def test_lora_grpo_e2e_fit_and_push():
     engine_wq = np.asarray(engine.params["layers"]["wq"])
     merged_wq = np.asarray(merge_lora(actor.params)["layers"]["wq"])
     np.testing.assert_allclose(engine_wq, merged_wq, rtol=1e-5, atol=1e-6)
+
+
+def test_adapter_delta_sync_server_path():
+    """LoRA delta sync end to end at the server boundary: the wire carries
+    ONLY adapters (layout ~100x smaller than the full tree), the worker
+    installs a/b in place over its (quantized = QLoRA) base, and serving
+    output changes accordingly."""
+    import jax
+
+    from polyrl_tpu.models.lora import (
+        adapter_template, apply_adapters, extract_adapters,
+    )
+    from polyrl_tpu.rollout.cb_engine import CBEngine
+    from polyrl_tpu.rollout.sampling import SamplingParams
+    from polyrl_tpu.rollout.server import RolloutServer
+    from polyrl_tpu.transfer.layout import (
+        alloc_buffer, build_layout, pack_params,
+    )
+
+    cfg, params = _setup()
+    # worker side: QLoRA serving tree (int8 base + zero adapters)
+    served = wrap_lora(quantize_params(params), jax.random.PRNGKey(9), rank=4)
+    engine = CBEngine(cfg, served, pad_token_id=0,
+                      kv_cache_dtype=jnp.float32, max_slots=4, page_size=8,
+                      max_seq_len=64, prompt_buckets=(8,), num_pages=64)
+    server = RolloutServer(engine, host="127.0.0.1", port=0)
+    template = adapter_template(cfg, rank=4, dtype=jnp.float32)
+    server.weight_template = template
+    server.weight_apply = apply_adapters
+
+    # trainer side: trained adapters (nonzero b), packed into the wire
+    # layout built from the SAME config-derived template
+    trained = wrap_lora(params, jax.random.PRNGKey(9), rank=4)
+    trained["layers"]["wq"] = LoraWeight(
+        base=trained["layers"]["wq"].base, a=trained["layers"]["wq"].a,
+        b=jnp.ones_like(trained["layers"]["wq"].b) * 0.05,
+        alpha=trained["layers"]["wq"].alpha)
+    adapters = extract_adapters(trained)
+    layout = build_layout(template)
+    full_layout = build_layout(params)
+    assert layout.total_bytes < full_layout.total_bytes / 5  # delta is small
+    buf = alloc_buffer(layout)
+    pack_params(adapters, layout, buf)
+
+    class FakeRx:
+        def __init__(self):
+            self.buffer, self.layout = buf, layout
+
+        def wait_for_version(self, v, timeout=0.0):
+            return None
+
+        def stop(self):
+            pass
+
+    server.receiver = FakeRx()
+    try:
+        server.start()
+        sp = SamplingParams(temperature=0.0, max_new_tokens=5,
+                            stop_token_ids=())
+        before = engine.generate([[1, 2, 3, 4]], sp, timeout=120.0)[0]
+        ok, err = server.update_weights_from_agent(4)
+        assert ok, err
+        wq = engine.params["layers"]["wq"]
+        assert isinstance(wq, LoraWeight)
+        # engine adapters are bf16 (QLoRA default) → one rounding step
+        np.testing.assert_allclose(np.asarray(wq.b, np.float32), 0.05,
+                                   rtol=2e-3)
+        assert wq.base.q.dtype == jnp.int8  # base untouched (still QLoRA)
+        after = engine.generate([[1, 2, 3, 4]], sp, timeout=120.0)[0]
+        assert before["token_ids"] != after["token_ids"]
+    finally:
+        server.stop()
+
+
+def test_lora_delta_config_guards():
+    from polyrl_tpu import train as train_mod
+    from polyrl_tpu.config import load_config
+
+    # colocated + lora_delta rejected
+    cfg = load_config(None, [
+        "model.dtype=float32", "trainer.weight_sync=lora_delta",
+        "actor.lora_rank=4"])
+    import pytest as _pytest
+
+    with _pytest.raises(NotImplementedError, match="disaggregated"):
+        train_mod.build_trainer(cfg, [])
+
+
+def test_qlora_tp_serving_shards_base():
+    """Regression: a LoRA-wrapped (QLoRA) tree on a tp mesh must shard the
+    base over tp — the path-keyed spec lookup previously missed wrapper
+    leaves and silently replicated the whole base per chip."""
+    from polyrl_tpu.parallel import mesh as meshlib
+    from polyrl_tpu.rollout.cb_engine import CBEngine
+    from polyrl_tpu.rollout.sampling import SamplingParams
+
+    cfg, params = _setup()
+    served = wrap_lora(quantize_params(params), jax.random.PRNGKey(9), rank=4)
+    mesh = meshlib.make_mesh(meshlib.MeshConfig(fsdp=1, tp=2),
+                             jax.devices()[:2])
+    engine = CBEngine(cfg, served, mesh=mesh, pad_token_id=0,
+                      kv_cache_dtype=jnp.float32, max_slots=4, page_size=8,
+                      max_seq_len=64, prompt_buckets=(8,), num_pages=64)
+    try:
+        wq = engine.params["layers"]["wq"]
+        assert isinstance(wq, LoraWeight)
+        assert wq.base.q.sharding.spec[-1] == "tp", wq.base.q.sharding
+        assert wq.b.sharding.spec[-1] == "tp", wq.b.sharding
+        sp = SamplingParams(temperature=0.0, max_new_tokens=4,
+                            stop_token_ids=())
+        out = engine.generate([[1, 2, 3]], sp, timeout=120.0)
+        assert len(out[0]["token_ids"]) == 4
+    finally:
+        engine.stop()
+
+
+def test_adapter_alpha_mismatch_rejected():
+    from polyrl_tpu.models.lora import apply_adapters, extract_adapters
+
+    import pytest as _pytest
+
+    cfg, params = _setup()
+    worker = wrap_lora(params, jax.random.PRNGKey(9), rank=4, alpha=16.0)
+    trainer = wrap_lora(params, jax.random.PRNGKey(9), rank=4, alpha=32.0)
+    with _pytest.raises(ValueError, match="lora_alpha mismatch"):
+        apply_adapters(worker, extract_adapters(trainer))

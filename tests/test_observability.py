@@ -72,3 +72,19 @@ def test_tracking_wandb_gated(tmp_path):
     t.log({"a": 1.0}, step=1)
     t.close()
     assert (tmp_path / "m.jsonl").read_text().strip()
+
+
+def test_moe_param_count_and_active_flops():
+    """MoE configs: param_count covers router + ALL experts; per-token
+    FLOPs cover only the routed top-k (MFU would otherwise be ~10x off on
+    e.g. Qwen3-30B-A3B, which activates ~3B of 30B params)."""
+    from polyrl_tpu.models import decoder
+
+    cfg = decoder.get_config("qwen3-30b-a3b")
+    total = flops_lib.param_count(cfg)
+    assert 29e9 < total < 32e9, total  # "30B" family
+
+    dense_equiv = flops_lib.flops_per_token(cfg, 1, training=False)
+    # active matmul params ≈ 3B ("A3B"): fwd ≈ 2 * active
+    active = dense_equiv / 2.0
+    assert 2e9 < active < 4e9, active
