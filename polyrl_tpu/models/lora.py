@@ -125,19 +125,41 @@ def lora_param_specs(specs: dict, targets=DEFAULT_TARGETS) -> dict:
     return out
 
 
+def base_stats(params: dict) -> jnp.ndarray:
+    """Per-target mean|w| of the first and last layer slabs of each FROZEN
+    base, [n_targets, 2] f32 — a cheap provenance fingerprint that rides
+    the delta-sync wire. Catches a worker serving a different CHECKPOINT
+    than the trainer trains against (layer statistics differ clearly
+    across models; int8-vs-bf16 of the SAME checkpoint agrees to <1%).
+    It cannot distinguish two random inits of the same architecture —
+    delta sync presumes both sides loaded the same pretrained weights."""
+
+    def slab(w):
+        if isinstance(w, QuantWeight):
+            w = w.q.astype(jnp.float32) * w.scale[..., None, :]
+        return jnp.stack([jnp.mean(jnp.abs(w[0])).astype(jnp.float32),
+                          jnp.mean(jnp.abs(w[-1])).astype(jnp.float32)])
+
+    rows = [slab(v.base) for k, v in sorted(params["layers"].items())
+            if isinstance(v, LoraWeight)]
+    return jnp.stack(rows)
+
+
 def extract_adapters(params: dict) -> dict:
     """The adapter subtree alone: {"layers": {k: {"a": ..., "b": ...}},
-    "alpha": scalar} — what a delta weight push puts on the wire
-    (~rank/hidden of the full tree, e.g. ~0.5% at rank 16 on an 8B model).
-    ``alpha`` rides the wire so a trainer/worker scaling mismatch fails
-    loudly at apply time instead of silently serving a different policy."""
+    "alpha": scalar, "base_stats": [n_targets, 2]} — what a delta weight
+    push puts on the wire (~rank/hidden of the full tree, e.g. ~0.5% at
+    rank 16 on an 8B model). ``alpha`` and the base fingerprint ride the
+    wire so trainer/worker mismatches fail loudly at apply time instead of
+    silently serving a different policy."""
     out: dict = {}
     alpha = None
     for k, v in params["layers"].items():
         if isinstance(v, LoraWeight):
             out[k] = {"a": v.a, "b": v.b}
             alpha = v.alpha
-    return {"layers": out, "alpha": jnp.float32(alpha or 0.0)}
+    return {"layers": out, "alpha": jnp.float32(alpha or 0.0),
+            "base_stats": base_stats(params)}
 
 
 def adapter_template(model_cfg, rank: int, targets=DEFAULT_TARGETS,
@@ -160,7 +182,8 @@ def adapter_template(model_cfg, rank: int, targets=DEFAULT_TARGETS,
             "a": jax.ShapeDtypeStruct((L, d_in, rank), dt),
             "b": jax.ShapeDtypeStruct((L, rank, d_out), dt),
         }
-    return {"layers": out, "alpha": jax.ShapeDtypeStruct((), jnp.float32)}
+    return {"layers": out, "alpha": jax.ShapeDtypeStruct((), jnp.float32),
+            "base_stats": jax.ShapeDtypeStruct((len(out), 2), jnp.float32)}
 
 
 def apply_adapters(wrapped: dict, adapters: dict) -> dict:
@@ -176,6 +199,18 @@ def apply_adapters(wrapped: dict, adapters: dict) -> dict:
 
     out = dict(wrapped)
     layers = dict(wrapped["layers"])
+    if "base_stats" in adapters:
+        mine = np.asarray(base_stats(wrapped), np.float32)
+        theirs = np.asarray(adapters["base_stats"], np.float32)
+        rel = np.abs(mine - theirs) / (np.abs(theirs) + 1e-12)
+        if mine.shape != theirs.shape or float(rel.max()) > 0.05:
+            # the worker's frozen base is not the trainer's checkpoint:
+            # installing adapters would silently serve a different policy
+            raise ValueError(
+                "delta-sync base mismatch: this worker's frozen base "
+                f"weights differ from the trainer's (rel diff up to "
+                f"{float(rel.max()):.3f}); both sides must load the same "
+                "checkpoint")
     recv_alpha = float(np.asarray(adapters.get("alpha", 0.0)))
     for k, ab in adapters["layers"].items():
         w = layers[k]

@@ -236,12 +236,17 @@ def main() -> None:
                    help="LoRA delta sync: serve base + adapters; pushes "
                         "carry only adapters (match the trainer's rank)")
     p.add_argument("--lora-alpha", type=float, default=16.0)
+    p.add_argument("--seed", type=int, default=0,
+                   help="random-init seed for preset models (delta sync "
+                        "presumes trainer and workers share the base — "
+                        "normally via the same checkpoint dir)")
     args = p.parse_args()
 
     logging.basicConfig(level=logging.INFO)
     server = create_server(args.model, args.manager_endpoint, host=args.host,
                            port=args.port, advertise_host=args.advertise_host,
                            dtype=args.dtype, is_local=args.is_local,
+                           seed=args.seed,
                            transfer_streams=args.transfer_streams,
                            backend=args.backend, max_slots=args.max_slots,
                            page_size=args.page_size,

@@ -380,6 +380,22 @@ class StreamRLTrainer:
             from polyrl_tpu.models import lora as lora_mod
 
             params = lora_mod.extract_adapters(self.actor.params)
+            if self._multi:
+                # gather ONLY the sharded adapter leaves; the alpha scalar
+                # and base_stats are host-local replicated values that
+                # process_allgather would stack/concat into wrong shapes
+                from jax.experimental import multihost_utils as mhu
+
+                params = dict(
+                    params,
+                    layers=jax.tree_util.tree_map(
+                        lambda x: np.asarray(
+                            mhu.process_allgather(x, tiled=True)),
+                        params["layers"]),
+                    base_stats=np.asarray(params["base_stats"]),
+                    alpha=np.asarray(params["alpha"]))
+            self.rollout.update_weights(params)
+            return
         else:
             # export: LoRA actors merge adapters into the plain layout here
             # — the wire format and the engines never see wrapper nodes

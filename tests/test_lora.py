@@ -291,3 +291,43 @@ def test_adapter_alpha_mismatch_rejected():
     trainer = wrap_lora(params, jax.random.PRNGKey(9), rank=4, alpha=32.0)
     with _pytest.raises(ValueError, match="lora_alpha mismatch"):
         apply_adapters(worker, extract_adapters(trainer))
+
+
+def test_lora_checkpoint_roundtrip(tmp_path):
+    """Orbax save/restore of a LoRA-wrapped actor state: wrapper nodes
+    (LoraWeight over a QuantWeight base) survive with types and alpha."""
+    from polyrl_tpu.utils.checkpoint import CheckpointManager
+
+    cfg, params = _setup()
+    wrapped = wrap_lora(quantize_params(params), jax.random.PRNGKey(1),
+                        rank=4, alpha=24.0)
+    mgr = CheckpointManager(str(tmp_path / "ckpt"))
+    mgr.save(3, {"actor": {"params": wrapped}})
+    mgr.wait()
+    items, _meta = mgr.restore(3, {"actor": {"params": wrapped}})
+    wq = items["actor"]["params"]["layers"]["wq"]
+    assert isinstance(wq, LoraWeight) and wq.alpha == 24.0
+    assert wq.base.q.dtype == jnp.int8
+    np.testing.assert_array_equal(
+        np.asarray(wq.b), np.asarray(wrapped["layers"]["wq"].b))
+
+
+def test_adapter_base_mismatch_rejected():
+    """A worker whose frozen base differs from the trainer's checkpoint
+    (wire base_stats fingerprint) rejects the push loudly."""
+    from polyrl_tpu.models.lora import apply_adapters, extract_adapters
+
+    import pytest as _pytest
+
+    cfg, params = _setup()
+    worker = wrap_lora(
+        {"embed": params["embed"], "final_norm": params["final_norm"],
+         "layers": {k: (v * 2.0 if k == "wq" else v)
+                    for k, v in params["layers"].items()}},
+        jax.random.PRNGKey(9), rank=4)
+    trainer = wrap_lora(params, jax.random.PRNGKey(9), rank=4)
+    with _pytest.raises(ValueError, match="base mismatch"):
+        apply_adapters(worker, extract_adapters(trainer))
+    # same base passes
+    ok = apply_adapters(trainer, extract_adapters(trainer))
+    assert isinstance(ok["layers"]["wq"], LoraWeight)
