@@ -10,8 +10,10 @@ PORT=${PORT:-30000}
 # chip; trainer pushes stay bf16 on the wire and re-quantize on arrival).
 # MODEL=qwen3-30b-a3b (or a Qwen3-MoE checkpoint dir) serves the MoE family.
 # PREFILL_CHUNK=512 interleaves long-prompt admission with decode.
+# LORA_RANK=16 serves base+adapters for trainer.weight_sync=lora_delta.
 WEIGHT_QUANT=${WEIGHT_QUANT:-}
 PREFILL_CHUNK=${PREFILL_CHUNK:-512}
+LORA_RANK=${LORA_RANK:-0}
 
 python -m polyrl_tpu.rollout.serve \
     --model "$MODEL" \
@@ -19,5 +21,6 @@ python -m polyrl_tpu.rollout.serve \
     --port "$PORT" \
     --warmup \
     --prefill-chunk "$PREFILL_CHUNK" \
+    --lora-rank "$LORA_RANK" \
     ${WEIGHT_QUANT:+--weight-quant "$WEIGHT_QUANT"} \
     "$@"

@@ -1,0 +1,22 @@
+#!/usr/bin/env bash
+# Disaggregated GRPO with LoRA delta weight sync: the trainer updates only
+# rank-r adapters (frozen base) and each weight push ships ~0.5% of the
+# model's bytes; workers serve base+adapters and install a/b in place.
+# QLoRA pool: add WEIGHT_QUANT=int8 on the workers (int8 frozen base).
+#
+#   bash examples/run_lora_grpo.sh                               # head node
+#   MANAGER=<head>:8899 LORA_RANK=16 bash examples/launch_rollout.sh
+#                                                                # each worker
+set -euo pipefail
+
+MODEL=${MODEL:-qwen3-1.7b}          # use the SAME checkpoint on workers —
+                                    # delta sync validates base provenance
+LORA_RANK=${LORA_RANK:-16}
+
+python -m polyrl_tpu.train \
+    --config examples/configs/stream_grpo_qwen3_1p7b.yaml \
+    model.preset="$MODEL" \
+    actor.lora_rank="$LORA_RANK" \
+    actor.lr=1e-4 \
+    trainer.weight_sync=lora_delta \
+    "$@"

@@ -98,19 +98,27 @@ class RolloutServer:
             def log_message(self, *a):
                 pass
 
-            def _json(self, code: int, obj: dict) -> None:
-                body = json.dumps(obj).encode()
+            def _send(self, code: int, body: bytes, ctype: str) -> None:
                 self.send_response(code)
-                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
+
+            def _json(self, code: int, obj: dict) -> None:
+                self._send(code, json.dumps(obj).encode(), "application/json")
 
             def do_GET(self):
                 if self.path in ("/health", "/health_generate"):
                     self._json(200, {"status": "ok"})
                 elif self.path == "/get_server_info":
                     self._json(200, outer.server_info())
+                elif self.path == "/metrics":
+                    # Prometheus text exposition of the same telemetry the
+                    # manager polls via /get_server_info (plus the engine's
+                    # POLYRL_CB_TRACE phase timers when enabled)
+                    self._send(200, outer.metrics_text().encode(),
+                               "text/plain; version=0.0.4")
                 else:
                     self._json(404, {"error": f"no route {self.path}"})
 
@@ -346,6 +354,35 @@ class RolloutServer:
         if pc is not None:
             info.update(pc.stats())
         return info
+
+    def metrics_text(self) -> str:
+        """Prometheus text format for /metrics: server_info fields as
+        gauges, cumulative values (tokens served, engine trace counts +
+        phase seconds) as counters. Full precision — %g-style rounding
+        makes rate() over large counters see flat-then-jump."""
+
+        def fmt(v):
+            return str(int(v)) if float(v).is_integer() else repr(float(v))
+
+        lines = []
+        info = dict(self.server_info())
+        info.setdefault("total_tokens_served",
+                        getattr(self.engine, "total_tokens_served", 0))
+        for k, v in info.items():
+            if isinstance(v, bool) or not isinstance(v, (int, float)):
+                continue
+            name = "polyrl_" + k.replace("#", "num_").replace("/", "_")
+            kind = "counter" if k == "total_tokens_served" else "gauge"
+            lines.append(f"# TYPE {name} {kind}")
+            lines.append(f"{name} {fmt(v)}")
+        trace = getattr(self.engine, "trace_report", lambda: {})()
+        for k, v in sorted(trace.items()):
+            # every trace entry is cumulative (call counts and phase
+            # seconds both only increase)
+            name = f"polyrl_engine_{k}"
+            lines.append(f"# TYPE {name} counter")
+            lines.append(f"{name} {fmt(v)}")
+        return "\n".join(lines) + "\n"
 
     def update_weights_from_agent(self, version: int) -> tuple[bool, str]:
         """Load weights v``version`` from the receiver buffer into the live

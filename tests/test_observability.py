@@ -88,3 +88,31 @@ def test_moe_param_count_and_active_flops():
     # active matmul params ≈ 3B ("A3B"): fwd ≈ 2 * active
     active = dense_equiv / 2.0
     assert 2e9 < active < 4e9, active
+
+
+def test_server_metrics_endpoint():
+    """GET /metrics: Prometheus text exposition of serving telemetry."""
+    import urllib.request
+
+    import jax
+    import jax.numpy as jnp
+
+    from polyrl_tpu.models import decoder
+    from polyrl_tpu.rollout.cb_engine import CBEngine
+    from polyrl_tpu.rollout.server import RolloutServer
+
+    cfg = decoder.get_config("tiny", dtype=jnp.float32)
+    params = decoder.init_params(jax.random.PRNGKey(0), cfg)
+    engine = CBEngine(cfg, params, pad_token_id=0,
+                      kv_cache_dtype=jnp.float32, max_slots=4, page_size=8,
+                      max_seq_len=64, prompt_buckets=(8,), num_pages=64)
+    server = RolloutServer(engine, host="127.0.0.1", port=0).start()
+    try:
+        with urllib.request.urlopen(
+                f"http://{server.endpoint}/metrics", timeout=30) as r:
+            assert r.headers["Content-Type"].startswith("text/plain")
+            body = r.read().decode()
+        assert "# TYPE polyrl_num_running_reqs gauge" in body, body
+        assert "polyrl_weight_version" in body
+    finally:
+        server.stop()
