@@ -336,6 +336,64 @@ void register_routes(phttp::Server& server, Manager& mgr) {
     rw.body = Value(std::move(top)).dump();
   });
 
+  // Prometheus text exposition for ops scrapers: pool-level gauges plus
+  // per-instance queue depths labeled by endpoint (the same data
+  // /get_instances_status serves as JSON).
+  server.route("GET", "/metrics",
+               [&](const phttp::Request&, phttp::ResponseWriter& rw) {
+    // label values per the Prometheus text format: escape \, " and
+    // newline — endpoints arrive via the unauthenticated registration
+    // route and must not be able to inject metric lines
+    auto esc = [](const std::string& s) {
+      std::string out;
+      out.reserve(s.size());
+      for (char c : s) {
+        if (c == '\\') out += "\\\\";
+        else if (c == '"') out += "\\\"";
+        else if (c == '\n') out += "\\n";
+        else out += c;
+      }
+      return out;
+    };
+    auto insts = state.all_instances();
+    long healthy = 0, local_n = 0, running = 0, queued = 0;
+    std::string per;
+    for (auto& inst : insts) {
+      if (inst->healthy.load()) healthy++;
+      if (inst->is_local) local_n++;
+      long r = inst->num_running_reqs.load();
+      long q = inst->num_queued_reqs.load();
+      running += r;
+      queued += q;
+      per += "polyrl_mgr_instance_running_reqs{endpoint=\"" +
+             esc(inst->endpoint) + "\"} " + std::to_string(r) + "\n";
+      per += "polyrl_mgr_instance_queued_reqs{endpoint=\"" +
+             esc(inst->endpoint) + "\"} " + std::to_string(q) + "\n";
+    }
+    std::string body;
+    body += "# TYPE polyrl_mgr_instances gauge\npolyrl_mgr_instances " +
+            std::to_string((long)insts.size()) + "\n";
+    body += "# TYPE polyrl_mgr_instances_healthy gauge\n"
+            "polyrl_mgr_instances_healthy " + std::to_string(healthy) + "\n";
+    body += "# TYPE polyrl_mgr_instances_local gauge\n"
+            "polyrl_mgr_instances_local " + std::to_string(local_n) + "\n";
+    body += "# TYPE polyrl_mgr_weight_version counter\n"
+            "polyrl_mgr_weight_version " +
+            std::to_string(state.weight_version()) + "\n";
+    body += "# TYPE polyrl_mgr_max_local_gen_s gauge\n"
+            "polyrl_mgr_max_local_gen_s " +
+            std::to_string(state.balance.max_local_gen_s()) + "\n";
+    body += "# TYPE polyrl_mgr_running_reqs gauge\npolyrl_mgr_running_reqs " +
+            std::to_string(running) + "\n";
+    body += "# TYPE polyrl_mgr_queued_reqs gauge\npolyrl_mgr_queued_reqs " +
+            std::to_string(queued) + "\n";
+    body += "# TYPE polyrl_mgr_instance_running_reqs gauge\n";
+    body += "# TYPE polyrl_mgr_instance_queued_reqs gauge\n";
+    body += per;
+    rw.content_type = "text/plain; version=0.0.4";
+    rw.body = body;
+  });
+
   server.route("POST", "/register_rollout_instance",
                [&](const phttp::Request& req, phttp::ResponseWriter& rw) {
     Value body = pjson::Parser::parse(req.body);

@@ -311,3 +311,24 @@ def test_bounded_generate_pool_completes_large_batch():
     finally:
         proc.kill()
         eng.stop()
+
+
+def test_manager_metrics_endpoint(manager):
+    """GET /metrics: Prometheus exposition of pool state (instances,
+    weight version, per-instance queue depths)."""
+    import urllib.request
+
+    eng = FakeEngine().start()
+    try:
+        manager.register_rollout_instance(eng.endpoint)
+        wait_active(manager, 1)
+        with urllib.request.urlopen(
+                f"{manager.endpoint}/metrics", timeout=10) as r:
+            assert r.headers["Content-Type"].startswith("text/plain")
+            body = r.read().decode()
+        assert "polyrl_mgr_instances 1" in body, body
+        assert "polyrl_mgr_instances_healthy 1" in body, body
+        assert f'polyrl_mgr_instance_running_reqs{{endpoint="{eng.endpoint}"}}' in body
+        assert "# TYPE polyrl_mgr_weight_version counter" in body
+    finally:
+        eng.stop()
