@@ -71,12 +71,26 @@ def test_manager_tsan_concurrent_load():
             except Exception as exc:  # noqa: BLE001
                 errors.append(exc)
 
+        scrapes = [0]
+
         def metrics_worker():
+            import urllib.request
+
             try:
                 for _ in range(10):
                     client.update_metrics(step_time_s=1.0, total_gen_time_s=0.5,
                                           trainer_bubble_s=0.1, throughput=100.0)
                     client.get_instances_status()
+                    try:
+                        # Prometheus scrape races the same instance atomics;
+                        # a transient scrape failure must not end the loop
+                        # (the race coverage would silently vanish)
+                        with urllib.request.urlopen(
+                                f"{client.endpoint}/metrics", timeout=10) as r:
+                            r.read()
+                        scrapes[0] += 1
+                    except Exception:  # noqa: BLE001
+                        pass
                     time.sleep(0.02)
             except Exception as exc:  # noqa: BLE001
                 errors.append(exc)
@@ -104,7 +118,9 @@ def test_manager_tsan_concurrent_load():
             t.join(timeout=120)
         for e in engines + [dying]:
             e.stop()
-        # tolerate request-level errors (dying instance) — the point is races
+        # tolerate request-level errors (dying instance) — the point is
+        # races — but the /metrics race coverage must have actually run
+        assert scrapes[0] >= 1, "no /metrics scrape succeeded under load"
     finally:
         proc.terminate()
         proc.wait(timeout=10)
