@@ -58,10 +58,10 @@ def test_two_process_fit_step(tmp_path):
     assert s0.split("param_sum=")[1] == s1.split("param_sum=")[1]
 
 
-def test_sp_trainer_single_process_mesh():
-    """parallel.sp=2 wires Ulysses sequence-parallel attention into the
-    actor and runs a real fit step over the 8-virtual-device mesh (dp=2,
-    fsdp=2, sp=2) — the long-context training config end to end."""
+def _fit_one_step_on_mesh(extra_overrides, check):
+    """Shared driver for the sp/pp/ep config-plane tests: build a trainer
+    over the 8-virtual-device mesh with the given parallel overrides, run
+    the per-test assertions, fit ONE step, and require finite results."""
     import jax
     import numpy as np
 
@@ -71,21 +71,17 @@ def test_sp_trainer_single_process_mesh():
     if jax.device_count() < 8:
         pytest.skip("needs the 8-virtual-device CPU mesh")
     cfg = load_config(None, [
-        "model.dtype=float32", "model.overrides={\"vocab_size\": 512}",
-        "parallel.dp=2", "parallel.fsdp=2", "parallel.sp=2",
+        "model.dtype=float32",
         "rollout.backend=step", "rollout.batch_buckets=8",
         "rollout.prompt_buckets=16",
         "trainer.train_batch_size=4", "trainer.rollout_n=2",
         "trainer.ppo_mini_batch_size=8", "trainer.micro_batch_size=8",
         "trainer.min_stream_batch_size=8", "trainer.max_prompt_length=16",
         "trainer.max_response_length=16", "trainer.total_steps=1",
-        "data.arithmetic_size=8"])
+        "data.arithmetic_size=8"] + extra_overrides)
     cleanup: list = []
     trainer = train_mod.build_trainer(cfg, cleanup)
-    assert trainer.actor.mesh is not None
-    assert dict(zip(trainer.actor.mesh.axis_names,
-                    trainer.actor.mesh.devices.shape))["sp"] == 2
-    assert "ulysses" in trainer.actor.attn_fn.__qualname__  # not the flash default
+    check(trainer)
     hist = trainer.fit()
     for fn in reversed(cleanup):
         fn()
@@ -93,6 +89,25 @@ def test_sp_trainer_single_process_mesh():
     assert np.isfinite(hist[0]["actor/pg_loss"])
     leaves = jax.tree_util.tree_leaves(trainer.actor.params)
     assert all(np.all(np.isfinite(np.asarray(x))) for x in leaves)
+
+
+def _axes(trainer):
+    return dict(zip(trainer.actor.mesh.axis_names,
+                    trainer.actor.mesh.devices.shape))
+
+
+def test_sp_trainer_single_process_mesh():
+    """parallel.sp=2 wires Ulysses sequence-parallel attention into the
+    actor and runs a real fit step over the 8-virtual-device mesh (dp=2,
+    fsdp=2, sp=2) — the long-context training config end to end."""
+
+    def check(trainer):
+        assert _axes(trainer)["sp"] == 2
+        assert "ulysses" in trainer.actor.attn_fn.__qualname__
+
+    _fit_one_step_on_mesh(
+        ['model.overrides={"vocab_size": 512}',
+         "parallel.dp=2", "parallel.fsdp=2", "parallel.sp=2"], check)
 
 
 def test_pp_trainer_single_process_mesh():
@@ -100,34 +115,27 @@ def test_pp_trainer_single_process_mesh():
     and runs a real fit step over the 8-virtual-device mesh (dp=2, fsdp=2,
     pp=2) — pipeline-parallel training end to end through the config
     plane."""
-    import jax
-    import numpy as np
 
-    from polyrl_tpu import train as train_mod
-    from polyrl_tpu.config import load_config
+    def check(trainer):
+        assert trainer.actor.layers_fn is not None
+        assert _axes(trainer)["pp"] == 2
 
-    if jax.device_count() < 8:
-        pytest.skip("needs the 8-virtual-device CPU mesh")
-    cfg = load_config(None, [
-        "model.dtype=float32", "model.overrides={\"vocab_size\": 512}",
-        "parallel.dp=2", "parallel.fsdp=2", "parallel.pp=2",
-        "parallel.pp_microbatches=2",
-        "rollout.backend=step", "rollout.batch_buckets=8",
-        "rollout.prompt_buckets=16",
-        "trainer.train_batch_size=4", "trainer.rollout_n=2",
-        "trainer.ppo_mini_batch_size=8", "trainer.micro_batch_size=8",
-        "trainer.min_stream_batch_size=8", "trainer.max_prompt_length=16",
-        "trainer.max_response_length=16", "trainer.total_steps=1",
-        "data.arithmetic_size=8"])
-    cleanup: list = []
-    trainer = train_mod.build_trainer(cfg, cleanup)
-    assert trainer.actor.layers_fn is not None
-    assert dict(zip(trainer.actor.mesh.axis_names,
-                    trainer.actor.mesh.devices.shape))["pp"] == 2
-    hist = trainer.fit()
-    for fn in reversed(cleanup):
-        fn()
-    assert len(hist) == 1
-    assert np.isfinite(hist[0]["actor/pg_loss"])
-    leaves = jax.tree_util.tree_leaves(trainer.actor.params)
-    assert all(np.all(np.isfinite(np.asarray(x))) for x in leaves)
+    _fit_one_step_on_mesh(
+        ['model.overrides={"vocab_size": 512}',
+         "parallel.dp=2", "parallel.fsdp=2", "parallel.pp=2",
+         "parallel.pp_microbatches=2"], check)
+
+
+def test_ep_moe_trainer_single_process_mesh():
+    """parallel.ep=2 with the MoE preset: expert weights shard over the
+    expert axis through the config plane and a real fit step runs over the
+    8-virtual-device mesh — completing the sp/pp/ep config-plane trio."""
+
+    def check(trainer):
+        assert _axes(trainer)["ep"] == 2
+        we = trainer.actor.params["layers"]["we_gate"]
+        assert we.sharding.spec[1] == "ep", we.sharding.spec
+
+    _fit_one_step_on_mesh(
+        ["model.preset=moe-tiny", 'model.overrides={"use_qk_norm": false}',
+         "parallel.dp=2", "parallel.fsdp=2", "parallel.ep=2"], check)
