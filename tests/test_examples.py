@@ -78,3 +78,18 @@ def test_hybrid_recipe_yaml_loads():
     assert cfg.trainer.use_remove_padding is True
     assert cfg.actor.offload_optimizer is True
     assert "--initial-local-gen-s" in cfg.rollout.manager_args
+
+
+def test_llama8b_recipe_yaml_loads():
+    """The north-star 8B recipe parses into a valid RunConfig with the
+    deployment-critical knobs set."""
+    from polyrl_tpu import config as cfg_lib
+
+    cfg = cfg_lib.load_config("examples/configs/stream_grpo_llama3_8b.yaml")
+    assert cfg.model.preset == "llama3-8b"
+    assert cfg.rollout.mode == "disaggregated"
+    assert cfg.trainer.use_remove_padding
+    assert cfg.trainer.micro_token_budget == 16384
+    assert cfg.trainer.max_response_length == 14336
+    assert cfg.rollout.prefill_chunk == 512
+    assert cfg.parallel.fsdp == -1
