@@ -166,11 +166,29 @@ class RolloutServer:
                     self.wfile.flush()
 
                 try:
-                    while True:
-                        item = out_q.get()
-                        if item is _SENTINEL:
-                            break
-                        chunk(json.dumps(item) + "\n")
+                    done = False
+                    while not done:
+                        items = [out_q.get()]
+                        # drain the burst: a multi-step dispatch fetch
+                        # delivers k lines at once — one chunked write per
+                        # burst instead of k write+flush syscall pairs
+                        try:
+                            while True:
+                                items.append(out_q.get_nowait())
+                        except queue.Empty:
+                            pass
+                        # truncate at the FIRST sentinel: failure paths can
+                        # enqueue lines after a sentinel (e.g. a batch-wide
+                        # error after a row already finished) and a
+                        # sentinel object must never reach json.dumps
+                        for i, it in enumerate(items):
+                            if it is _SENTINEL:
+                                items = items[:i]
+                                done = True
+                                break
+                        if items:
+                            chunk("".join(json.dumps(i) + "\n"
+                                          for i in items))
                     self.wfile.write(b"0\r\n\r\n")
                 except (BrokenPipeError, ConnectionResetError):
                     outer.abort_request(rid)
