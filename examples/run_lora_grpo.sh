@@ -13,9 +13,18 @@ MODEL=${MODEL:-qwen3-1.7b}          # use the SAME checkpoint on workers —
                                     # delta sync validates base provenance
 LORA_RANK=${LORA_RANK:-16}
 
+# a local checkpoint directory goes to model.hf_path (preset names are
+# looked up in decoder.PRESETS and a path would fail config load) —
+# mirrors serve.py's isdir dispatch
+if [ -d "$MODEL" ]; then
+    MODEL_ARG="model.hf_path=$MODEL"
+else
+    MODEL_ARG="model.preset=$MODEL"
+fi
+
 python -m polyrl_tpu.train \
     --config examples/configs/stream_grpo_qwen3_1p7b.yaml \
-    model.preset="$MODEL" \
+    "$MODEL_ARG" \
     actor.lora_rank="$LORA_RANK" \
     actor.lr=1e-4 \
     trainer.weight_sync=lora_delta \

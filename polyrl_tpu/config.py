@@ -71,6 +71,12 @@ class RolloutSection:
     manager_args: tuple = ()              # extra CLI args for the spawned manager
     transfer_streams: int = 4
     advertise_host: str = "127.0.0.1"
+    # multi-NIC weight push (transfer/nic.py): >1 runs one sender agent per
+    # CIDR-picked local interface and the manager partitions the pool across
+    # them (reference 4 groups × 8 engines, config.toml:19-20)
+    sender_groups: int = 1
+    sender_nic_cidr: str = ""             # e.g. "10.128.0.0/16,10.129.0.0/16"
+    groups_per_sender: int = 1            # manager-side instance sharding
     # hybrid colocated + remote: ALSO serve generation from an in-process
     # engine registered as a LOCAL (time-sliced) instance — the manager
     # aborts it after the balancer's local window and the engine yields its
@@ -102,6 +108,12 @@ class RewardSection:
     manager: str = "naive"
     custom_score_path: str = ""           # python file defining compute_score
     num_workers: int = 8
+    # remote sandbox-service code execution (rewards/sandbox.py; reference
+    # sandbox_fusion, reward.py:95-150). Empty url = local rlimit sandbox.
+    sandbox_url: str = ""
+    sandbox_max_concurrent: int = 64
+    sandbox_timeout_s: float = 30.0
+    sandbox_memory_limit_mb: int = 1024
 
 
 @dataclass

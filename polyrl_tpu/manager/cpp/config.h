@@ -2,13 +2,75 @@
 // mirroring the reference's config plane (SURVEY.md C16f, config.rs:6).
 #pragma once
 
+#include <arpa/inet.h>
+
+#include <cstdint>
 #include <fstream>
 #include <map>
 #include <sstream>
+#include <stdexcept>
 #include <string>
 #include <vector>
 
 namespace manager {
+
+// IPv4 CIDR filter for the sender/registration ACL (the reference enforces
+// allowed_sender_ips on both sides, utils.rs:303-339). A bare IP parses as
+// /32.
+struct Cidr {
+  uint32_t addr = 0;  // host byte order
+  uint32_t mask = 0;
+
+  bool contains(uint32_t ip) const { return (ip & mask) == (addr & mask); }
+};
+
+inline bool parse_ipv4(const std::string& s, uint32_t& out) {
+  in_addr a{};
+  if (inet_pton(AF_INET, s.c_str(), &a) != 1) return false;
+  out = ntohl(a.s_addr);
+  return true;
+}
+
+inline Cidr parse_cidr(const std::string& spec) {
+  Cidr c;
+  size_t slash = spec.find('/');
+  std::string ip = slash == std::string::npos ? spec : spec.substr(0, slash);
+  int bits = 32;
+  if (slash != std::string::npos) {
+    bits = std::stoi(spec.substr(slash + 1));
+    if (bits < 0 || bits > 32) throw std::invalid_argument("bad CIDR " + spec);
+  }
+  if (!parse_ipv4(ip, c.addr)) throw std::invalid_argument("bad CIDR " + spec);
+  c.mask = bits == 0 ? 0 : (~0u << (32 - bits));
+  return c;
+}
+
+// empty allowlist = open (matches the reference default: the field is
+// opt-in); otherwise the peer IP must fall inside one of the CIDRs.
+inline bool ip_allowed(const std::string& peer_ip,
+                       const std::vector<Cidr>& allow) {
+  if (allow.empty()) return true;
+  uint32_t ip = 0;
+  if (!parse_ipv4(peer_ip, ip)) return false;
+  for (const auto& c : allow)
+    if (c.contains(ip)) return true;
+  return false;
+}
+
+// `["a", "b"]` or bare `a,b` → vector of trimmed strings.
+inline std::vector<std::string> parse_string_list(std::string v) {
+  std::vector<std::string> out;
+  if (!v.empty() && v.front() == '[' && v.back() == ']')
+    v = v.substr(1, v.size() - 2);
+  std::stringstream ss(v);
+  std::string item;
+  while (std::getline(ss, item, ',')) {
+    size_t a = item.find_first_not_of(" \t\"'");
+    size_t b = item.find_last_not_of(" \t\"'");
+    if (a != std::string::npos) out.push_back(item.substr(a, b - a + 1));
+  }
+  return out;
+}
 
 struct Config {
   std::string bind_addr = "0.0.0.0:30000";
@@ -26,7 +88,15 @@ struct Config {
   // generate workers bound concurrent per-request engine streams.
   int http_workers = 64;
   int generate_workers = 128;
-  std::vector<std::string> allowed_sender_ips;  // CIDR filters (doc only v0)
+  // CIDR allowlist enforced on PUT /update_weight_senders and instance
+  // registration (empty = open; reference utils.rs:303-339)
+  std::vector<std::string> allowed_sender_ips;
+
+  std::vector<Cidr> sender_acl() const {
+    std::vector<Cidr> out;
+    for (const auto& s : allowed_sender_ips) out.push_back(parse_cidr(s));
+    return out;
+  }
 };
 
 // Minimal TOML subset: `key = value` lines; strings, ints, floats, bools,
@@ -85,6 +155,8 @@ inline Config load_config(int argc, char** argv) {
     if (auto* v = get("initial_local_gen_s")) cfg.initial_local_gen_s = std::stod(*v);
     if (auto* v = get("http_workers")) cfg.http_workers = std::stoi(*v);
     if (auto* v = get("generate_workers")) cfg.generate_workers = std::stoi(*v);
+    if (auto* v = get("allowed_sender_ips"))
+      cfg.allowed_sender_ips = parse_string_list(*v);
   }
   // pass 2: CLI overrides
   for (int i = 1; i < argc - 1; ++i) {
@@ -102,7 +174,10 @@ inline Config load_config(int argc, char** argv) {
     else if (a == "--initial-local-gen-s") cfg.initial_local_gen_s = std::stod(v);
     else if (a == "--http-workers") cfg.http_workers = std::stoi(v);
     else if (a == "--generate-workers") cfg.generate_workers = std::stoi(v);
+    else if (a == "--allowed-sender-ips")
+      cfg.allowed_sender_ips = parse_string_list(v);
   }
+  cfg.sender_acl();  // fail fast on malformed CIDRs at startup, not first use
   return cfg;
 }
 

@@ -33,6 +33,7 @@ struct Request {
   std::string query;
   std::map<std::string, std::string> headers;
   std::string body;
+  std::string peer_ip;  // dotted-quad of the connecting socket (for ACLs)
 };
 
 // Streaming response writer handed to handlers. Either set status+body and
@@ -68,7 +69,7 @@ class ResponseWriter {
       write_all(end, 5);
     } else {
       char head[256];
-      const char* status_text = status == 200 ? "OK" : (status == 404 ? "Not Found" : (status >= 500 ? "Internal Server Error" : "Bad Request"));
+      const char* status_text = status == 200 ? "OK" : (status == 404 ? "Not Found" : (status == 403 ? "Forbidden" : (status >= 500 ? "Internal Server Error" : "Bad Request")));
       snprintf(head, sizeof(head),
                "HTTP/1.1 %d %s\r\nContent-Type: %s\r\nContent-Length: %zu\r\nConnection: close\r\n\r\n",
                status, status_text, content_type.c_str(), body.size());
@@ -132,12 +133,18 @@ class Server {
     running_ = true;
     pool_ = std::make_unique<WorkerPool>(workers_);
     while (running_) {
-      int fd = ::accept(listen_fd_, nullptr, nullptr);
+      sockaddr_in peer{};
+      socklen_t plen = sizeof(peer);
+      int fd = ::accept(listen_fd_, reinterpret_cast<sockaddr*>(&peer), &plen);
       if (fd < 0) {
         if (!running_) break;
         continue;
       }
-      if (!pool_->submit([this, fd] { handle_conn(fd); })) ::close(fd);
+      char ip[INET_ADDRSTRLEN] = {0};
+      inet_ntop(AF_INET, &peer.sin_addr, ip, sizeof(ip));
+      std::string peer_ip(ip);
+      if (!pool_->submit([this, fd, peer_ip] { handle_conn(fd, peer_ip); }))
+        ::close(fd);
     }
     pool_->stop();
   }
@@ -155,8 +162,9 @@ class Server {
   }
 
  private:
-  void handle_conn(int fd) {
+  void handle_conn(int fd, const std::string& peer_ip = std::string()) {
     Request req;
+    req.peer_ip = peer_ip;
     if (read_request(fd, req)) {
       ResponseWriter rw(fd);
       auto it = routes_.find(req.method + " " + req.path);

@@ -308,6 +308,18 @@ class Manager {
 
 void register_routes(phttp::Server& server, Manager& mgr) {
   auto& state = mgr.state();
+  // sender/registration ACL (reference utils.rs:303-339): parsed once at
+  // route setup; shared by value into the handlers (immutable after).
+  const std::vector<Cidr> sender_acl = mgr.config().sender_acl();
+  auto acl_reject = [sender_acl](const phttp::Request& req,
+                                 phttp::ResponseWriter& rw) -> bool {
+    if (ip_allowed(req.peer_ip, sender_acl)) return false;
+    log_line("403 " + req.method + " " + req.path +
+             " from disallowed ip " + req.peer_ip);
+    rw.status = 403;
+    rw.body = "{\"error\":\"sender ip not in allowed_sender_ips\"}";
+    return true;
+  };
 
   server.route("GET", "/health", [](const phttp::Request&, phttp::ResponseWriter& rw) {
     rw.body = "{\"status\":\"ok\"}";
@@ -395,7 +407,8 @@ void register_routes(phttp::Server& server, Manager& mgr) {
   });
 
   server.route("POST", "/register_rollout_instance",
-               [&](const phttp::Request& req, phttp::ResponseWriter& rw) {
+               [&, acl_reject](const phttp::Request& req, phttp::ResponseWriter& rw) {
+    if (acl_reject(req, rw)) return;
     Value body = pjson::Parser::parse(req.body);
     std::string endpoint = body["endpoint"].as_str();
     if (endpoint.empty()) { rw.status = 400; rw.body = "{\"error\":\"endpoint required\"}"; return; }
@@ -409,7 +422,8 @@ void register_routes(phttp::Server& server, Manager& mgr) {
   });
 
   server.route("POST", "/register_local_rollout_instances",
-               [&](const phttp::Request& req, phttp::ResponseWriter& rw) {
+               [&, acl_reject](const phttp::Request& req, phttp::ResponseWriter& rw) {
+    if (acl_reject(req, rw)) return;
     Value body = pjson::Parser::parse(req.body);
     for (const auto& ep : body["endpoints"].as_arr())
       state.register_instance(ep.as_str(), true);
@@ -496,7 +510,8 @@ void register_routes(phttp::Server& server, Manager& mgr) {
   });
 
   server.route("PUT", "/update_weight_senders",
-               [&](const phttp::Request& req, phttp::ResponseWriter& rw) {
+               [&, acl_reject](const phttp::Request& req, phttp::ResponseWriter& rw) {
+    if (acl_reject(req, rw)) return;
     Value body = pjson::Parser::parse(req.body);
     std::vector<std::string> senders;
     for (const auto& s : body["senders"].as_arr()) senders.push_back(s.as_str());
@@ -562,7 +577,13 @@ void register_routes(phttp::Server& server, Manager& mgr) {
 
 int main(int argc, char** argv) {
   signal(SIGPIPE, SIG_IGN);
-  manager::Config cfg = manager::load_config(argc, argv);
+  manager::Config cfg;
+  try {
+    cfg = manager::load_config(argc, argv);
+  } catch (const std::exception& e) {
+    fprintf(stderr, "bad config: %s\n", e.what());
+    return 1;
+  }
   manager::Manager mgr(cfg);
   phttp::Server server(static_cast<size_t>(std::max(cfg.http_workers, 1)));
   manager::register_routes(server, mgr);

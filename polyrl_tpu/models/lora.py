@@ -202,8 +202,16 @@ def apply_adapters(wrapped: dict, adapters: dict) -> dict:
     if "base_stats" in adapters:
         mine = np.asarray(base_stats(wrapped), np.float32)
         theirs = np.asarray(adapters["base_stats"], np.float32)
+        if mine.shape != theirs.shape:
+            # different target sets wrapped on each side: the subtraction
+            # below would raise a raw broadcast error, not this diagnosis
+            raise ValueError(
+                "delta-sync base mismatch: trainer and worker wrapped "
+                f"different LoRA target sets (fingerprint shapes "
+                f"{mine.shape} vs {theirs.shape}); both sides must use the "
+                "same checkpoint and target_modules")
         rel = np.abs(mine - theirs) / (np.abs(theirs) + 1e-12)
-        if mine.shape != theirs.shape or float(rel.max()) > 0.05:
+        if float(rel.max()) > 0.05:
             # the worker's frozen base is not the trainer's checkpoint:
             # installing adapters would silently serve a different policy
             raise ValueError(

@@ -195,6 +195,7 @@ def compute_score_code(
     ground_truth: str,
     extra_info: dict | None = None,
     timeout_s: float = 6.0,
+    run_fn=None,
 ) -> float:
     """Code-contest scoring: fraction of test cases passed (the reference's
     prime_code / sandbox path for codecontests/apps/codeforces/taco).
@@ -202,7 +203,14 @@ def compute_score_code(
     Test cases come from ``extra_info`` (or JSON-decoded ``ground_truth``):
     ``{"inputs": [...], "outputs": [...]}`` stdin/stdout pairs, or
     ``{"asserts": "..."}`` appended to the program.
+
+    ``run_fn(code, stdin, timeout_s) -> (ok, stdout)`` selects the execution
+    backend: default is the local rlimit'd subprocess; the remote
+    sandbox-service client (rewards/sandbox.py) plugs in here for pod-scale
+    scoring.
     """
+    if run_fn is None:
+        run_fn = _run_sandboxed
     code = extract_code(solution_str)
     if code is None:
         return 0.0
@@ -221,7 +229,7 @@ def compute_score_code(
     if not tests:
         return 0.0
     if "asserts" in tests:
-        ok, _ = _run_sandboxed(code + "\n\n" + tests["asserts"], "", timeout_s)
+        ok, _ = run_fn(code + "\n\n" + tests["asserts"], "", timeout_s)
         return 1.0 if ok else 0.0
     inputs = tests.get("inputs", [])
     outputs = tests.get("outputs", [])
@@ -229,7 +237,7 @@ def compute_score_code(
         return 0.0
     passed = 0
     for stdin, expect in zip(inputs, outputs):
-        ok, out = _run_sandboxed(code, str(stdin), timeout_s)
+        ok, out = run_fn(code, str(stdin), timeout_s)
         if ok and out.strip() == str(expect).strip():
             passed += 1
     return passed / len(inputs)
@@ -273,8 +281,10 @@ def default_compute_score(
     solution_str: str,
     ground_truth: str,
     extra_info: dict | None = None,
+    run_fn=None,
 ) -> float:
-    """Per-dataset dispatch (reference reward_score/__init__.py:19-117)."""
+    """Per-dataset dispatch (reference reward_score/__init__.py:19-117).
+    ``run_fn`` overrides the code-execution backend (rewards/sandbox.py)."""
     ds = (data_source or "").lower()
     if "gsm8k" in ds:
         return compute_score_gsm8k(solution_str, ground_truth)
@@ -286,7 +296,8 @@ def default_compute_score(
         # geometry3k's vision-aware scorer reduces to boxed-math compare here
         return compute_score_math(solution_str, ground_truth)
     if any(k in ds for k in ("code", "apps", "taco", "codeforces")):
-        return compute_score_code(solution_str, ground_truth, extra_info)
+        return compute_score_code(solution_str, ground_truth, extra_info,
+                                  run_fn=run_fn)
     if any(k in ds for k in ("searchr1", "nq", "triviaqa", "hotpotqa", "qa_em")):
         return compute_score_qa_em(solution_str, ground_truth, extra_info)
     # default: MATH-style then gsm8k-style

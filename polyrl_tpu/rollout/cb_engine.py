@@ -115,10 +115,14 @@ class CBEngine:
         mesh=None,
         prefill_chunk: int = 0,
     ):
-        assert all(b % page_size == 0 for b in prompt_buckets), \
-            "prompt buckets must be page-aligned"
-        assert prefill_chunk % page_size == 0, \
-            "prefill_chunk must be page-aligned"
+        if any(b % page_size for b in prompt_buckets):
+            raise ValueError("prompt buckets must be page-aligned")
+        if prefill_chunk < 0 or prefill_chunk % page_size:
+            # assert would be skipped under -O, and -8 % 8 == 0 would let a
+            # negative (still truthy) chunk size enable chunking
+            raise ValueError(
+                f"prefill_chunk must be a non-negative multiple of "
+                f"page_size={page_size}, got {prefill_chunk}")
         self.cfg = cfg
         self.mesh = mesh
         if mesh is not None:

@@ -402,6 +402,15 @@ class RolloutServer:
             lines.append(f"{name} {fmt(v)}")
         return "\n".join(lines) + "\n"
 
+    def _flush_engine_prefix_cache(self) -> None:
+        """Cached prefix KV was computed under the OLD weights/adapters; any
+        disaggregated install path must invalidate it, exactly like
+        in-process swaps do (cb_engine.update_weights flushes for the same
+        reason). The bucketed v0 engine has no prefix cache — no-op there."""
+        flush = getattr(self.engine, "flush_prefix_cache", None)
+        if flush is not None:
+            flush()
+
     def update_weights_from_agent(self, version: int) -> tuple[bool, str]:
         """Load weights v``version`` from the receiver buffer into the live
         engine (TPU analogue of the reference's chunked host->GPU broadcast
@@ -428,6 +437,7 @@ class RolloutServer:
                     self.engine.params = self.weight_apply(
                         self.engine.params, new_params)
                     self.engine.weight_version = version
+                    self._flush_engine_prefix_cache()
                 return True, ""
             if self.weight_preprocess is not None:
                 new_params = self.weight_preprocess(new_params)
@@ -438,6 +448,7 @@ class RolloutServer:
                         np.asarray(n).astype(o.dtype), o.sharding), old,
                     new_params)
                 self.engine.weight_version = version
+                self._flush_engine_prefix_cache()
             return True, ""
         except Exception as exc:  # noqa: BLE001
             log.exception("weight load failed")

@@ -151,7 +151,10 @@ def _build_rollout(cfg: RunConfig, mcfg, params, tokenizer, cleanup: list):
     iface = TransferInterface(
         template, manager_client=mgr,
         num_streams=cfg.rollout.transfer_streams,
-        advertise_host=cfg.rollout.advertise_host)
+        advertise_host=cfg.rollout.advertise_host,
+        sender_groups=cfg.rollout.sender_groups,
+        sender_nic_cidr=cfg.rollout.sender_nic_cidr,
+        groups_per_sender=cfg.rollout.groups_per_sender)
     cleanup.append(iface.close)
 
     local_server = None
@@ -291,6 +294,17 @@ def build_trainer(cfg: RunConfig, cleanup: list | None = None):
 
     compute_score = (load_custom_score(cfg.reward.custom_score_path)
                      if cfg.reward.custom_score_path else None)
+    if compute_score is None and cfg.reward.sandbox_url:
+        # pod-scale code RL: ship code execution to the sandbox service,
+        # bounded by a concurrency semaphore (reference reward.py:95-150)
+        from polyrl_tpu.rewards.sandbox import SandboxClient
+
+        compute_score = SandboxClient(
+            cfg.reward.sandbox_url,
+            max_concurrent=cfg.reward.sandbox_max_concurrent,
+            timeout_s=cfg.reward.sandbox_timeout_s,
+            memory_limit_mb=cfg.reward.sandbox_memory_limit_mb,
+        ).compute_score
     reward_manager = load_reward_manager(
         cfg.reward.manager, tokenizer, compute_score=compute_score,
         num_workers=cfg.reward.num_workers)

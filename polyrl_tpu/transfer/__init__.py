@@ -9,8 +9,9 @@ Layers (SURVEY §3.3):
                    is a ``device_put`` reshard
 """
 
-from .agents import ReceiverAgent, SenderAgent
+from .agents import ReceiverAgent, SenderAgent, SenderGroup
 from .interface import TransferInterface, colocated_update
+from .nic import filter_ips_by_cidr, get_node_ips, pick_sender_ips
 from .layout import (
     ParamLayout,
     alloc_buffer,
@@ -25,12 +26,16 @@ __all__ = [
     "ParamLayout",
     "ReceiverAgent",
     "SenderAgent",
+    "SenderGroup",
     "TcpTransferEngine",
     "TransferInterface",
     "alloc_buffer",
     "build_layout",
     "colocated_update",
+    "filter_ips_by_cidr",
+    "get_node_ips",
     "pack_params",
+    "pick_sender_ips",
     "unflatten_like",
     "unpack_params",
 ]

@@ -21,6 +21,7 @@ Flow (mirrors §3.3 of the survey):
 
 from __future__ import annotations
 
+import contextlib
 import json
 import logging
 import queue
@@ -185,10 +186,14 @@ class SenderAgent:
 
     def __init__(self, buffer: np.ndarray, manager_client=None,
                  listen_host: str = "0.0.0.0", num_streams: int = 4,
-                 poll_s: float = 1.0, advertise_host: str | None = None):
+                 poll_s: float = 1.0, advertise_host: str | None = None,
+                 bind_host: str | None = None):
         self.buffer = buffer
         self.manager = manager_client
-        self.engine = TcpTransferEngine(num_streams=num_streams)
+        # bind_host pins this sender's outbound data streams to one NIC
+        # (SenderGroup runs one agent per interface for aggregate bandwidth)
+        self.engine = TcpTransferEngine(num_streams=num_streams,
+                                        bind_host=bind_host)
         self._notify_pool = ThreadPoolExecutor(max_workers=4)
         self.poll_s = poll_s
         self.reg_wait_s = 10.0
@@ -444,17 +449,85 @@ class SenderAgent:
                 pass
 
 
+class SenderGroup:
+    """N sender agents, one per local NIC, sharing one packed buffer.
+
+    The reference fans each trainer's weight push over
+    ``num_mooncake_groups_per_sender`` engine groups bound to different
+    node IPs (config.toml:19-20, fsdp_interface.py:97-138) so an 8B push
+    saturates aggregate NIC bandwidth, not one interface. Here each group
+    is a full :class:`SenderAgent` (own control endpoint + TCP engine
+    source-bound to its NIC); the MANAGER partitions rollout instances
+    across the groups when all endpoints are registered via
+    ``PUT /update_weight_senders`` — per-group work is 1/N of the pool.
+
+    The buffer is shared read-only during pushes; trainer-side mutation
+    (``signal_update`` / ``swap_buffer`` / ``buffer_write_lock``) fans out
+    to every agent so each agent's (buffer, version) snapshot invariant is
+    preserved independently.
+    """
+
+    def __init__(self, buffer: np.ndarray, sender_ips: list[str],
+                 manager_client=None, num_streams: int = 4,
+                 poll_s: float = 1.0, listen_host: str = "0.0.0.0"):
+        if not sender_ips:
+            raise ValueError("SenderGroup needs at least one sender IP")
+        self.manager = manager_client
+        self.senders = [
+            SenderAgent(buffer, manager_client=manager_client,
+                        listen_host=listen_host, num_streams=num_streams,
+                        poll_s=poll_s, advertise_host=ip, bind_host=ip)
+            for ip in sender_ips
+        ]
+
+    @property
+    def endpoints(self) -> list[str]:
+        return [s.endpoint for s in self.senders]
+
+    @property
+    def version(self) -> int:
+        return self.senders[0].version
+
+    @property
+    def buffer(self) -> np.ndarray:
+        return self.senders[0].buffer
+
+    def start(self) -> None:
+        for s in self.senders:
+            s.start()
+
+    def stop(self) -> None:
+        for s in self.senders:
+            s.stop()
+
+    def signal_update(self, version: int | None = None) -> int:
+        v = self.senders[0].signal_update(version)
+        for s in self.senders[1:]:
+            s.signal_update(v)
+        return v
+
+    def swap_buffer(self, new_buffer: np.ndarray, version: int) -> np.ndarray:
+        old = self.senders[0].swap_buffer(new_buffer, version)
+        for s in self.senders[1:]:
+            s.swap_buffer(new_buffer, version)
+        return old
+
+    @contextlib.contextmanager
+    def buffer_write_lock(self):
+        """All-agents pack guard (no push round may be in flight on ANY
+        NIC while the shared buffer is rewritten in place)."""
+        with contextlib.ExitStack() as stack:
+            for s in self.senders:
+                stack.enter_context(s.buffer_write_lock())
+            yield
+
+
 def _split(endpoint: str) -> tuple[str, int]:
     host, port = endpoint.rsplit(":", 1)
     return host, int(port)
 
 
 def _advertise_ip() -> str:
-    try:
-        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
-        s.connect(("8.8.8.8", 80))
-        ip = s.getsockname()[0]
-        s.close()
-        return ip
-    except OSError:
-        return "127.0.0.1"
+    from .nic import default_route_ip
+
+    return default_route_ip()

@@ -21,8 +21,9 @@ import logging
 import time
 from typing import Any
 
-from .agents import SenderAgent
+from .agents import SenderAgent, SenderGroup
 from .layout import ParamLayout, alloc_buffer, build_layout, pack_params
+from .nic import pick_sender_ips
 
 log = logging.getLogger(__name__)
 
@@ -30,19 +31,36 @@ log = logging.getLogger(__name__)
 class TransferInterface:
     def __init__(self, params_template: Any, manager_client=None,
                  num_streams: int = 4, poll_s: float = 1.0,
-                 advertise_host: str | None = None):
+                 advertise_host: str | None = None,
+                 sender_groups: int = 1, sender_nic_cidr: str = "",
+                 groups_per_sender: int = 1):
         self.layout: ParamLayout = build_layout(params_template)
         # double buffer: pack into _back while the sender pushes from its
         # front buffer; only the pointer swap synchronizes
         self._back = alloc_buffer(self.layout)
-        self.sender = SenderAgent(alloc_buffer(self.layout),
-                                  manager_client=manager_client,
-                                  num_streams=num_streams, poll_s=poll_s,
-                                  advertise_host=advertise_host)
+        front = alloc_buffer(self.layout)
+        if sender_groups > 1:
+            # multi-NIC fan-out: one sender agent per interface (CIDR-picked
+            # like the reference's 4-groups×8-engines layout,
+            # fsdp_interface.py:97-138); the manager partitions the pool
+            # across the advertised endpoints. ``advertise_host`` does not
+            # apply here — each group advertises ITS OWN NIC's IP (use
+            # sender_nic_cidr to steer which interfaces are picked).
+            ips = pick_sender_ips(sender_groups, sender_nic_cidr)
+            self.sender: SenderAgent | SenderGroup = SenderGroup(
+                front, ips, manager_client=manager_client,
+                num_streams=num_streams, poll_s=poll_s)
+            endpoints = self.sender.endpoints
+        else:
+            self.sender = SenderAgent(front, manager_client=manager_client,
+                                      num_streams=num_streams, poll_s=poll_s,
+                                      advertise_host=advertise_host)
+            endpoints = [self.sender.endpoint]
         self.manager = manager_client
         self.sender.start()
         if manager_client is not None:
-            manager_client.update_weight_senders([self.sender.endpoint])
+            manager_client.update_weight_senders(
+                endpoints, groups_per_sender=groups_per_sender)
 
     def update_weights_with_agent(self, params: Any) -> int:
         """Push new weights: pack (overlapped) -> version bump -> swap.

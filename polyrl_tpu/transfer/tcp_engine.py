@@ -159,16 +159,25 @@ class TransferBatch:
 
 
 class TcpTransferEngine:
-    """Sender side: fan a buffer out over N parallel streams."""
+    """Sender side: fan a buffer out over N parallel streams.
 
-    def __init__(self, num_streams: int = 8, workers: int | None = None):
+    ``bind_host`` pins the outbound streams' SOURCE address to one local
+    interface — multi-NIC hosts run one engine per NIC so sender groups
+    aggregate bandwidth instead of sharing the default route (reference
+    per-group local_hostname, fsdp_interface.py:118-126)."""
+
+    def __init__(self, num_streams: int = 8, workers: int | None = None,
+                 bind_host: str | None = None):
         self.num_streams = num_streams
+        self.bind_host = bind_host
         self._pool = ThreadPoolExecutor(max_workers=workers or num_streams)
 
     def _send_range(self, host: str, port: int, mv: memoryview,
                     round_id: int, offset: int, length: int,
                     nstreams: int) -> None:
-        with socket.create_connection((host, port), timeout=60.0) as s:
+        src = (self.bind_host, 0) if self.bind_host else None
+        with socket.create_connection((host, port), timeout=60.0,
+                                      source_address=src) as s:
             _tune(s)
             s.sendall(HEADER.pack(round_id, offset, length, nstreams))
             end = offset + length

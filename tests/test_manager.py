@@ -332,3 +332,60 @@ def test_manager_metrics_endpoint(manager):
         assert "# TYPE polyrl_mgr_weight_version counter" in body
     finally:
         eng.stop()
+
+
+def test_sender_ip_acl_allows_loopback():
+    """allowed_sender_ips covering the caller: registration + sender update
+    succeed (reference enforces the CIDR allowlist on both,
+    utils.rs:303-339)."""
+    proc, port = spawn_rollout_manager(
+        "127.0.0.1:0",
+        extra_args=["--health-check-interval-s", "0.1",
+                    "--allowed-sender-ips", "10.0.0.0/8,127.0.0.0/8"])
+    client = ManagerClient(f"127.0.0.1:{port}")
+    eng = FakeEngine().start()
+    try:
+        client.wait_healthy()
+        client.update_weight_senders(["127.0.0.1:9999"], groups_per_sender=2)
+        client.register_rollout_instance(eng.endpoint)
+        wait_active(client, 1)
+        st = client.get_instances_status()
+        assert st["instances"][0]["weight_sender"] == "127.0.0.1:9999"
+    finally:
+        proc.kill()
+        eng.stop()
+
+
+def test_sender_ip_acl_rejects_unlisted():
+    """Caller outside every CIDR: 403 on registration and on
+    PUT /update_weight_senders; data-plane routes (health/status) stay
+    open. Also covers the bare-IP (/32) spelling."""
+    import urllib.error
+
+    proc, port = spawn_rollout_manager(
+        "127.0.0.1:0",
+        extra_args=["--health-check-interval-s", "0.1",
+                    "--allowed-sender-ips", "10.0.0.0/8,192.168.77.5"])
+    client = ManagerClient(f"127.0.0.1:{port}")
+    try:
+        client.wait_healthy()  # /health is not ACL'd
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            client.register_rollout_instance("127.0.0.1:1234")
+        assert ei.value.code == 403
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            client.register_local_rollout_instances(["127.0.0.1:1234"])
+        assert ei.value.code == 403
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            client.update_weight_senders(["127.0.0.1:9999"])
+        assert ei.value.code == 403
+        assert client.get_instances_status()["instances"] == []
+    finally:
+        proc.kill()
+
+
+def test_sender_ip_acl_bad_cidr_fails_startup():
+    """A malformed CIDR must fail at startup, not at first enforcement."""
+    with pytest.raises(RuntimeError):
+        spawn_rollout_manager(
+            "127.0.0.1:0",
+            extra_args=["--allowed-sender-ips", "not-an-ip/8"])

@@ -172,3 +172,140 @@ def test_prime_manager_hung_scorer_is_abandoned():
     assert out.scores[0] == 1.0
     assert out.scores[1] == 0.0
     assert out.metrics["reward/score_errors"] >= 1.0
+
+
+# -- remote sandbox-service client (rewards/sandbox.py) ----------------------
+
+
+class _FakeSandboxService:
+    """Tiny sandbox-fusion-shaped /run_code service: actually executes the
+    code locally so stdout comparisons are real, and counts requests."""
+
+    def __init__(self, fail_mode=""):
+        import http.server
+        import json as _json
+        import threading
+
+        self.calls = 0
+        self.max_inflight = 0
+        self._inflight = 0
+        self._lock = threading.Lock()
+        svc = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_POST(self):  # noqa: N802
+                with svc._lock:
+                    svc.calls += 1
+                body = _json.loads(self.rfile.read(
+                    int(self.headers["Content-Length"])))
+                if fail_mode == "http500":
+                    self.send_error(500)
+                    return
+                # count only the EXECUTION window: it is strictly inside the
+                # client's semaphore hold (the response-write window is not —
+                # the client may release before our finally runs)
+                with svc._lock:
+                    svc._inflight += 1
+                    svc.max_inflight = max(svc.max_inflight, svc._inflight)
+                try:
+                    ok, out = scorers._run_sandboxed(
+                        body["code"], body.get("stdin", ""),
+                        float(body.get("run_timeout", 6.0)))
+                finally:
+                    with svc._lock:
+                        svc._inflight -= 1
+                resp = _json.dumps({
+                    "status": "Success",
+                    "run_result": {"status": "Finished",
+                                   "return_code": 0 if ok else 1,
+                                   "stdout": out if ok else "",
+                                   "stderr": "" if ok else out},
+                }).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(resp)))
+                self.end_headers()
+                self.wfile.write(resp)
+
+            def log_message(self, *a):  # quiet
+                pass
+
+        self._httpd = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.url = f"http://127.0.0.1:{self._httpd.server_port}"
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        self._httpd.shutdown()
+
+
+def test_sandbox_client_remote_run_and_score():
+    from polyrl_tpu.rewards.sandbox import SandboxClient
+
+    svc = _FakeSandboxService()
+    try:
+        client = SandboxClient(svc.url, max_concurrent=4, timeout_s=10.0)
+        ok, out = client.run("print(6*7)")
+        assert ok and out.strip() == "42"
+        ok, _ = client.run("raise SystemExit(3)")
+        assert not ok  # failing program is a real failure, NOT a fallback
+        assert client.stats()["local_fallbacks"] == 0
+        # full scoring path: code data source routed through the service
+        score = client.compute_score(
+            "codecontests", "```python\nprint(int(input())*2)\n```",
+            "", {"test_cases": {"inputs": ["4\n", "5\n"],
+                               "outputs": ["8", "11"]}})
+        assert score == 0.5
+        assert svc.calls >= 3
+    finally:
+        svc.stop()
+
+
+def test_sandbox_client_falls_back_local_on_service_outage():
+    from polyrl_tpu.rewards.sandbox import SandboxClient
+
+    # nothing listens on this port: every run() must fall back locally
+    client = SandboxClient("http://127.0.0.1:9", max_concurrent=2,
+                           timeout_s=5.0)
+    ok, out = client.run("print('via-local')")
+    assert ok and out.strip() == "via-local"
+    st = client.stats()
+    assert st["remote_failures"] == 1 and st["local_fallbacks"] == 1
+
+    strict = SandboxClient("http://127.0.0.1:9", fallback_local=False,
+                           timeout_s=5.0)
+    ok, msg = strict.run("print('x')")
+    assert not ok and "sandbox service error" in msg
+
+
+def test_sandbox_client_http_error_falls_back():
+    from polyrl_tpu.rewards.sandbox import SandboxClient
+
+    svc = _FakeSandboxService(fail_mode="http500")
+    try:
+        client = SandboxClient(svc.url, timeout_s=5.0)
+        ok, out = client.run("print('recovered')")
+        assert ok and out.strip() == "recovered"
+        assert client.stats()["local_fallbacks"] == 1
+    finally:
+        svc.stop()
+
+
+def test_sandbox_client_bounds_concurrency():
+    """The semaphore must cap in-flight service requests at max_concurrent
+    even when many scorer threads fire at once (reference reward.py:137)."""
+    import concurrent.futures
+
+    from polyrl_tpu.rewards.sandbox import SandboxClient
+
+    svc = _FakeSandboxService()
+    try:
+        client = SandboxClient(svc.url, max_concurrent=2, timeout_s=15.0)
+        code = "import time; time.sleep(0.2); print('ok')"
+        with concurrent.futures.ThreadPoolExecutor(8) as ex:
+            results = list(ex.map(lambda _: client.run(code), range(8)))
+        assert all(ok for ok, _ in results)
+        assert svc.max_inflight <= 2, svc.max_inflight
+    finally:
+        svc.stop()

@@ -251,3 +251,87 @@ def test_end_to_end_weight_sync(manager):
             rx.stop()
         eng.stop()
         iface.close()
+
+
+# -- multi-NIC sender groups (transfer/nic.py + SenderGroup) -----------------
+
+
+def test_nic_cidr_filter_and_pick():
+    from polyrl_tpu.transfer import filter_ips_by_cidr, pick_sender_ips
+    from polyrl_tpu.transfer.nic import get_node_ips
+
+    ips = ["10.128.0.5", "10.129.1.7", "192.168.3.2", "127.0.0.1"]
+    assert filter_ips_by_cidr(ips, "") == ips                      # open
+    assert filter_ips_by_cidr(ips, "0.0.0.0/0") == ips
+    assert filter_ips_by_cidr(ips, "10.0.0.0/8") == ["10.128.0.5",
+                                                     "10.129.1.7"]
+    assert filter_ips_by_cidr(
+        ips, "10.129.0.0/16, 192.168.0.0/16") == ["10.129.1.7",
+                                                  "192.168.3.2"]
+    # fewer NICs than groups wraps around (reference fsdp_interface.py:108)
+    assert pick_sender_ips(3, "10.129.0.0/16", ips=ips) == ["10.129.1.7"] * 3
+    # more NICs truncates
+    assert pick_sender_ips(1, "10.0.0.0/8", ips=ips) == ["10.128.0.5"]
+    with pytest.raises(RuntimeError):
+        pick_sender_ips(2, "172.16.0.0/12", ips=ips)
+    # real enumeration returns at least the fallback IP
+    assert len(get_node_ips(include_loopback=True)) >= 1
+
+
+def test_sender_group_partitioned_push():
+    """Two sender agents (one per 'NIC' — both loopback here) each serving
+    their own receivers from ONE shared packed buffer; both partitions get
+    every update and the pack guard excludes all in-flight rounds."""
+    from polyrl_tpu.transfer import SenderGroup
+
+    params = small_params(3)
+    layout = build_layout(params)
+    buf = alloc_buffer(layout)
+    group = SenderGroup(buf, ["127.0.0.1", "127.0.0.1"],
+                        manager_client=None, num_streams=2, poll_s=0.1,
+                        listen_host="127.0.0.1")
+    group.start()
+    assert len(set(group.endpoints)) == 2  # distinct control ports
+    rxs = [ReceiverAgent(layout, f"inst-g{i}", ep, num_streams=2,
+                         listen_host="127.0.0.1", advertise_host="127.0.0.1")
+           for i, ep in enumerate(group.endpoints)]
+    for rx in rxs:
+        rx.start()
+    try:
+        with group.buffer_write_lock():
+            pack_params(params, layout, group.buffer)
+        v = group.signal_update()
+        for rx in rxs:
+            rx.wait_for_version(v, timeout=30.0)
+            got = unflatten_like(params, unpack_params(rx.buffer, rx.layout))
+            assert_tree_equal(params, got)
+
+        # second round through swap_buffer (double-buffer path)
+        params2 = small_params(4)
+        back = alloc_buffer(layout)
+        pack_params(params2, layout, back)
+        old = group.swap_buffer(back, v + 1)
+        assert old is buf
+        for rx in rxs:
+            rx.wait_for_version(v + 1, timeout=30.0)
+            got = unflatten_like(params2, unpack_params(rx.buffer, rx.layout))
+            assert_tree_equal(params2, got)
+    finally:
+        for rx in rxs:
+            rx.stop()
+        group.stop()
+
+
+def test_transfer_interface_sender_groups_with_manager(manager):
+    """TransferInterface(sender_groups=2) registers BOTH sender endpoints
+    with the manager, which partitions registered instances across them."""
+    params = small_params(5)
+    iface = TransferInterface(params, manager_client=manager,
+                              num_streams=2, sender_groups=2,
+                              sender_nic_cidr="127.0.0.0/8")
+    try:
+        assert len(iface.sender.endpoints) == 2
+        st = manager.get_instances_status()
+        assert st is not None  # manager accepted the PUT (no exception)
+    finally:
+        iface.close()
