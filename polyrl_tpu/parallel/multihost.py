@@ -54,11 +54,76 @@ def broadcast_obj(obj: Any = None) -> Any:
     return pickle.loads(buf.tobytes())
 
 
+def broadcast_batch(tagged: tuple[str, Any] | None = None) -> tuple[str, Any]:
+    """Hot-path broadcast for the per-ibatch data plane: a ``("batch",
+    TensorBatch)`` message ships as a small pickled HEADER (tag, tensor
+    specs, non-tensors/meta) plus ONE raw-bytes round carrying the tensor
+    payload — the arrays never pass through pickle, and receivers rebuild
+    them as zero-copy views into the broadcast buffer. Any other tag
+    (``("end", ...)`` / ``("error", ...)``) rides the header alone.
+
+    At pod scale this is what keeps the control-plane fan-out off the step
+    critical path: pickling a batch copies every array and the generic
+    object broadcast re-copies the pickle; here the payload is one
+    contiguous buffer handed straight to the collective.
+    """
+    from jax.experimental import multihost_utils as mhu
+
+    from polyrl_tpu.data.batch import TensorBatch
+
+    if process_count() == 1:
+        return tagged
+    specs = None
+    total = 0
+    arrays: list[np.ndarray] = []
+    if is_main():
+        kind, payload = tagged
+        if kind == "batch" and isinstance(payload, TensorBatch):
+            specs = []
+            for k, v in payload.tensors.items():
+                arr = np.ascontiguousarray(np.asarray(v))
+                # dtype object (not .str): pickled in the header, so exotic
+                # dtypes (bfloat16 via ml_dtypes) round-trip too
+                specs.append((k, arr.dtype, arr.shape, arr.nbytes))
+                arrays.append(arr)
+            total = sum(s[3] for s in specs)
+            header = (kind, None,
+                      (specs, total, payload.non_tensors, payload.meta_info))
+        else:
+            header = (kind, payload, None)
+        broadcast_obj(header)
+    else:
+        kind, payload, extra = broadcast_obj(None)
+        if extra is None:
+            return kind, payload
+        specs, total, non_tensors, meta_info = extra
+    if specs is None:  # main, non-batch tag: header already carried it
+        return tagged
+    buf = np.zeros(max(total, 1), np.uint8)
+    if is_main():
+        off = 0
+        for arr in arrays:
+            n = arr.nbytes
+            buf[off : off + n] = arr.view(np.uint8).reshape(-1)
+            off += n
+    raw = np.asarray(mhu.broadcast_one_to_all(buf))
+    if is_main():
+        return tagged
+    tensors = {}
+    off = 0
+    for k, dtype, shape, nbytes in specs:
+        tensors[k] = raw[off : off + nbytes].view(dtype).reshape(shape)
+        off += nbytes
+    return "batch", TensorBatch(tensors=tensors, non_tensors=non_tensors,
+                                meta_info=meta_info)
+
+
 class NullRollout:
     """Rollout placeholder for non-main processes in multi-host runs: the
     control plane (manager streaming, weight push, balancer metrics) lives
-    on process 0; other hosts receive their batches via ``broadcast_obj``
-    and must never open their own manager/fabric connections."""
+    on process 0; other hosts receive their batches via ``broadcast_batch``
+    (header + raw-bytes fast path) and must never open their own
+    manager/fabric connections."""
 
     def __init__(self, pad_token_id: int = 0):
         self.pad_token_id = pad_token_id

@@ -323,16 +323,18 @@ class StreamRLTrainer:
                     try:
                         ib = next(it)
                     except StopIteration:
-                        self._mh.broadcast_obj(("end", None))
+                        self._mh.broadcast_batch(("end", None))
                         return
                     except Exception as exc:
-                        self._mh.broadcast_obj(("error", repr(exc)))
+                        self._mh.broadcast_batch(("error", repr(exc)))
                         raise
-                    self._mh.broadcast_obj(("batch", ib))
+                    with marked_timer("broadcast", metrics):
+                        self._mh.broadcast_batch(("batch", ib))
                     yield ib
             else:
                 while True:
-                    kind, ib = self._mh.broadcast_obj(None)
+                    with marked_timer("broadcast", metrics):
+                        kind, ib = self._mh.broadcast_batch(None)
                     if kind == "end":
                         return
                     if kind == "error":

@@ -1,8 +1,9 @@
-"""Worker for the 2-process jax.distributed CPU test (launched by
+"""Worker for the N-process jax.distributed CPU tests (launched by
 tests/test_multihost.py): one fit step of the stream trainer with the
-process-0 control plane + broadcast data plane + dp=2 mesh sharding.
+process-0 control plane + broadcast data plane + multi-axis mesh sharding
+(dp=2 at 2 processes; dp=2,fsdp=2 at 4).
 
-argv: coordinator_port process_id manager_port_file
+argv: coordinator_port process_id manager_port_file [num_processes]
 """
 
 import os
@@ -16,11 +17,12 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 def main() -> None:
     coord_port, pid = sys.argv[1], int(sys.argv[2])
+    nprocs = int(sys.argv[4]) if len(sys.argv) > 4 else 2
 
     import jax
 
-    jax.distributed.initialize(f"127.0.0.1:{coord_port}", num_processes=2,
-                               process_id=pid)
+    jax.distributed.initialize(f"127.0.0.1:{coord_port}",
+                               num_processes=nprocs, process_id=pid)
     import jax.numpy as jnp
     import numpy as np
 
@@ -33,12 +35,14 @@ def main() -> None:
     from polyrl_tpu.trainer.stream_trainer import StreamRLTrainer, TrainerConfig
     from polyrl_tpu.utils.tokenizer import ByteTokenizer
 
-    assert jax.process_count() == 2, jax.process_count()
-    assert jax.device_count() == 2, jax.device_count()
+    assert jax.process_count() == nprocs, jax.process_count()
+    assert jax.device_count() == nprocs, jax.device_count()
 
-    # dp=2 over the two hosts' devices: per-host data sharding — each
-    # process computes its half of every batch, GSPMD inserts the psums
-    mesh = meshlib.make_mesh(meshlib.MeshConfig(dp=2, fsdp=1, tp=1, sp=1))
+    # dp=2 over the hosts' devices (remaining hosts on fsdp at nprocs=4:
+    # cross-process data sharding AND cross-process param sharding) — each
+    # process computes its slice of every batch, GSPMD inserts the psums
+    mesh = meshlib.make_mesh(
+        meshlib.MeshConfig(dp=2, fsdp=nprocs // 2, tp=1, sp=1))
     cfg = decoder.get_config("tiny", dtype=jnp.float32)
     params = decoder.init_params(jax.random.PRNGKey(0), cfg)
     tok = ByteTokenizer()

@@ -1,6 +1,7 @@
-"""Multi-host stream trainer: 2 jax.distributed CPU processes run one fit
-step — process-0 control plane (manager/reward/weight push), broadcast data
-plane, dp=2 mesh sharding of the jitted updates (SURVEY.md L4; reference
+"""Multi-host stream trainer: N jax.distributed CPU processes (2 and 4)
+run one fit step — process-0 control plane (manager/reward/weight push),
+raw-bytes ibatch broadcast data plane, and cross-process dp (+fsdp at
+nprocs=4) mesh sharding of the jitted updates (SURVEY.md L4; reference
 worker groups stream_fsdp_workers.py:262-546)."""
 
 import os
@@ -20,7 +21,11 @@ def _free_port() -> int:
 
 
 @pytest.mark.slow
-def test_two_process_fit_step(tmp_path):
+@pytest.mark.parametrize("nprocs", [2, 4])
+def test_multi_process_fit_step(tmp_path, nprocs):
+    """N jax.distributed processes run one fit step: process-0 control
+    plane, raw-bytes ibatch broadcast, cross-process dp (and fsdp at
+    nprocs=4) sharding; params must end bit-identical on every host."""
     port = _free_port()
     env = dict(
         os.environ,
@@ -34,11 +39,12 @@ def test_two_process_fit_step(tmp_path):
         env.pop(k, None)
     worker = os.path.join(os.path.dirname(__file__), "multihost_fit_worker.py")
     procs = [
-        subprocess.Popen([sys.executable, worker, str(port), str(pid), ""],
+        subprocess.Popen([sys.executable, worker, str(port), str(pid), "",
+                          str(nprocs)],
                          env=env, stdout=subprocess.PIPE,
                          stderr=subprocess.STDOUT, text=True,
                          cwd="/root/repo")
-        for pid in (0, 1)
+        for pid in range(nprocs)
     ]
     outs = []
     try:
@@ -52,10 +58,10 @@ def test_two_process_fit_step(tmp_path):
     for pid, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"worker {pid} rc={p.returncode}:\n{out[-4000:]}"
         assert "MULTIHOST_OK" in out, f"worker {pid}:\n{out[-4000:]}"
-    # identical param sums printed by both (cross-checked in-process too)
-    s0 = [ln for ln in outs[0].splitlines() if "MULTIHOST_OK" in ln][0]
-    s1 = [ln for ln in outs[1].splitlines() if "MULTIHOST_OK" in ln][0]
-    assert s0.split("param_sum=")[1] == s1.split("param_sum=")[1]
+    # identical param sums printed by all (cross-checked in-process too)
+    sums = [[ln for ln in o.splitlines() if "MULTIHOST_OK" in ln][0]
+            .split("param_sum=")[1] for o in outs]
+    assert len(set(sums)) == 1, sums
 
 
 def _fit_one_step_on_mesh(extra_overrides, check):
