@@ -129,41 +129,35 @@ def _http_generate(endpoint: str, rid: str, input_ids, max_new: int) -> int:
     return n
 
 
-def bench_cb(cfg, params, batch, prompt_len, new_tokens, max_slots=64,
-             page_size=64, steps_per_dispatch=8):
-    """CB engine: direct in-process batch, then concurrent HTTP serving
-    (FRESH prompts per phase so the serve number isn't inflated by
-    prefix-cache hits on the direct phase's pages)."""
+def make_cb_engine(cfg, params, prompt_len, new_tokens, *, max_slots=64,
+                   page_size=64, steps_per_dispatch=8, trace=False):
+    """Shared CB-engine construction for bench phases AND the knob-sweep
+    tool (tools/bench_cb_sweep.py) — one code path so sweep findings
+    reproduce in bench.py."""
     import jax.numpy as jnp
-    import numpy as np
 
     from polyrl_tpu.rollout.cb_engine import CBEngine
-    from polyrl_tpu.rollout.sampling import SamplingParams
-    from polyrl_tpu.rollout.server import RolloutServer
 
     page_size = min(page_size, prompt_len)  # buckets must be page-aligned
     max_seq = prompt_len + new_tokens
     max_seq = -(-max_seq // page_size) * page_size
     pages_per = max_seq // page_size
-    engine = CBEngine(
+    return CBEngine(
         cfg, params, pad_token_id=0, kv_cache_dtype=jnp.bfloat16,
         max_slots=max_slots, page_size=page_size, max_seq_len=max_seq,
         prompt_buckets=(prompt_len,), steps_per_dispatch=steps_per_dispatch,
-        num_pages=max_slots * pages_per * 2 + 8)
-    rng = np.random.default_rng(1)
-    prompts = [rng.integers(1, cfg.vocab_size, prompt_len).tolist()
-               for _ in range(batch)]
-    serve_prompts = [rng.integers(1, cfg.vocab_size, prompt_len).tolist()
-                     for _ in range(batch)]
-    sp = SamplingParams(temperature=1.0, max_new_tokens=new_tokens,
-                        stop_token_ids=())
+        num_pages=max_slots * pages_per * 2 + 8, trace=trace)
 
-    # deterministic precompile of every admission bucket + decode variant
-    # (engine.warmup drives each compiled fn against the sink row — the
-    # generate-based warmup fragmented into prefix-cache suffix hits and
-    # left batch buckets uncompiled, putting ~15 s XLA compiles in the
-    # timed window), then one tiny generate for end-to-end sanity. This
-    # bench samples temperature-only → only the no-filter variants run.
+
+def warmup_cb(engine, cfg, rng, prompt_len):
+    """Deterministic precompile of every admission bucket + decode variant
+    (engine.warmup drives each compiled fn against the sink row — a
+    generate-based warmup fragmented into prefix-cache suffix hits and left
+    batch buckets uncompiled, putting ~15 s XLA compiles in the timed
+    window), then tiny generates covering the end-to-end and prefix-suffix
+    paths. Benches sample temperature-only → only no-filter variants."""
+    from polyrl_tpu.rollout.sampling import SamplingParams
+
     engine.warmup(filter_variants=(False,))
     warm_prompts = [rng.integers(1, cfg.vocab_size, prompt_len).tolist()
                     for _ in range(2)]
@@ -172,6 +166,31 @@ def bench_cb(cfg, params, batch, prompt_len, new_tokens, max_slots=64,
     engine.generate(warm_prompts, warm_sp, timeout=600.0)
     engine.generate([warm_prompts[0]], warm_sp, timeout=600.0)  # suffix path
     engine.flush_prefix_cache()
+
+
+def bench_cb(cfg, params, batch, prompt_len, new_tokens, max_slots=64,
+             page_size=64, steps_per_dispatch=8):
+    """CB engine: direct in-process batch, then concurrent HTTP serving
+    (FRESH prompts per phase so the serve number isn't inflated by
+    prefix-cache hits on the direct phase's pages). trace=True adds ~4
+    clock reads per multi-token dispatch — negligible next to a dispatch,
+    and scoped to this engine only (the 8b phase runs untraced)."""
+    import numpy as np
+
+    from polyrl_tpu.rollout.sampling import SamplingParams
+    from polyrl_tpu.rollout.server import RolloutServer
+
+    engine = make_cb_engine(cfg, params, prompt_len, new_tokens,
+                            max_slots=max_slots, page_size=page_size,
+                            steps_per_dispatch=steps_per_dispatch, trace=True)
+    rng = np.random.default_rng(1)
+    prompts = [rng.integers(1, cfg.vocab_size, prompt_len).tolist()
+               for _ in range(batch)]
+    serve_prompts = [rng.integers(1, cfg.vocab_size, prompt_len).tolist()
+                     for _ in range(batch)]
+    sp = SamplingParams(temperature=1.0, max_new_tokens=new_tokens,
+                        stop_token_ids=())
+    warmup_cb(engine, cfg, rng, prompt_len)
 
     # direct (no HTTP): device + scheduler, no dispatch layer
     t0 = time.monotonic()
@@ -223,9 +242,11 @@ def bench_cb(cfg, params, batch, prompt_len, new_tokens, max_slots=64,
     sampler_t.join(timeout=5.0)  # before del engine: the closure reads it
     serve_tokens = sum(counts)
     server.stop()
+    trace = {k: round(v, 3) for k, v in sorted(engine.trace_report().items())}
     del engine
     gc.collect()
     return {
+        "trace": trace,  # cumulative s (and n_*) per engine phase
         "direct_tok_s": round(direct_tokens / dt_direct, 1),
         "serve_tok_s": round(serve_tokens / dt_serve, 1),
         "serve_wall_s": round(dt_serve, 2),
@@ -271,7 +292,23 @@ def bench_weight_sync(params):
         swapped = jax.device_put(rebuilt)              # engine hot-swap
         jax.block_until_ready(swapped)
         t1 = time.monotonic()
-        del swapped, rebuilt
+        del rebuilt
+        # int8 workers (WEIGHT_QUANT=int8) re-quantize every bf16 push on
+        # arrival (serve.py wires quantize_params as weight_preprocess) —
+        # record that extra install cost for the 8B int8 deployment math.
+        # Quantize the DEVICE-resident tree (a host tree would re-pay H2D —
+        # tunnel-bound on this rig — and time the wire, not the kernel);
+        # first call compiles (one-time per worker), the per-push cost is
+        # the second, compiled call.
+        from polyrl_tpu.models.quant import quantize_params
+
+        quant_fn = jax.jit(quantize_params)
+        jax.block_until_ready(jax.tree_util.tree_leaves(quant_fn(swapped)))
+        t1b = time.monotonic()
+        quantized = quant_fn(swapped)
+        jax.block_until_ready(jax.tree_util.tree_leaves(quantized))
+        t_quant = time.monotonic()
+        del quantized, swapped
         gc.collect()
         mb = layout.total_bytes / (1 << 20)
         return {
@@ -279,6 +316,7 @@ def bench_weight_sync(params):
             "pack_s": round(t_pack - t0, 3),
             "wire_s": round(t_wire - t_pack, 3),
             "swap_s": round(t1 - t_wire, 3),
+            "int8_requantize_s": round(t_quant - t1b, 3),
             "mb": round(mb, 1),
             "wire_mb_s": round(mb / max(t_wire - t_pack, 1e-9), 1),
             # pack/swap are device<->host copies: on this dev rig they ride
@@ -526,6 +564,22 @@ def child_main() -> None:
         _note(key, extra[key])
 
     # ---- first backend dial happens HERE, inside the retry envelope ----
+    # Watchdog: a wedged TPU relay can HANG the dial (not raise) — r3 sat
+    # silently for the driver's whole budget. If the backend + flagship
+    # param build haven't completed within the dial deadline, hard-exit so
+    # the parent retries in a fresh process while wall clock remains.
+    dial_done = threading.Event()
+    dial_deadline = float(os.environ.get("POLYRL_BENCH_DIAL_TIMEOUT", "900"))
+
+    def _dial_watchdog() -> None:
+        if not dial_done.wait(dial_deadline):
+            print(f"[bench] backend dial exceeded {dial_deadline:.0f}s — "
+                  "aborting child for a fresh-process retry",
+                  file=sys.stderr, flush=True)
+            os._exit(17)
+
+    threading.Thread(target=_dial_watchdog, daemon=True).start()
+
     import jax
     import jax.numpy as jnp
 
@@ -547,6 +601,9 @@ def child_main() -> None:
     }
     extra.setdefault("hbm_gb", round(_hbm_limit_gb(), 1))
     _save_state(state)
+    dial_done.set()
+    _note("dial", {"device": state["meta"]["device_kind"],
+                   "flagship_params_built": bool(needs_flagship)})
 
     import numpy as np
 

@@ -99,7 +99,10 @@ def broadcast_batch(tagged: tuple[str, Any] | None = None) -> tuple[str, Any]:
         specs, total, non_tensors, meta_info = extra
     if specs is None:  # main, non-batch tag: header already carried it
         return tagged
-    buf = np.zeros(max(total, 1), np.uint8)
+    # np.empty, not zeros: main overwrites every byte below and receivers'
+    # contents are replaced by the collective — a memset of the whole batch
+    # per ibatch is pure waste on the hot path
+    buf = np.empty(max(total, 1), np.uint8)
     if is_main():
         off = 0
         for arr in arrays:

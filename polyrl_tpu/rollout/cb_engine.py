@@ -114,6 +114,7 @@ class CBEngine:
         steps_per_dispatch: int = 8,
         mesh=None,
         prefill_chunk: int = 0,
+        trace: bool | None = None,
     ):
         if any(b % page_size for b in prompt_buckets):
             raise ValueError("prompt buckets must be page-aligned")
@@ -219,9 +220,10 @@ class CBEngine:
         # of the trainer's marked_timer spans (SURVEY.md §5.1)
         import os as _os
 
+        if trace is None:  # explicit arg wins; env is the ops-facing toggle
+            trace = bool(_os.environ.get("POLYRL_CB_TRACE"))
         self._trace: dict | None = (collections.defaultdict(float)
-                                    if _os.environ.get("POLYRL_CB_TRACE")
-                                    else None)
+                                    if trace else None)
 
     def trace_report(self) -> dict:
         """Cumulative seconds per phase (POLYRL_CB_TRACE=1), else empty."""
