@@ -130,7 +130,8 @@ def _http_generate(endpoint: str, rid: str, input_ids, max_new: int) -> int:
 
 
 def make_cb_engine(cfg, params, prompt_len, new_tokens, *, max_slots=64,
-                   page_size=64, steps_per_dispatch=8, trace=False):
+                   page_size=64, steps_per_dispatch=8, trace=False,
+                   spec_tokens=0):
     """Shared CB-engine construction for bench phases AND the knob-sweep
     tool (tools/bench_cb_sweep.py) — one code path so sweep findings
     reproduce in bench.py."""
@@ -146,7 +147,8 @@ def make_cb_engine(cfg, params, prompt_len, new_tokens, *, max_slots=64,
         cfg, params, pad_token_id=0, kv_cache_dtype=jnp.bfloat16,
         max_slots=max_slots, page_size=page_size, max_seq_len=max_seq,
         prompt_buckets=(prompt_len,), steps_per_dispatch=steps_per_dispatch,
-        num_pages=max_slots * pages_per * 2 + 8, trace=trace)
+        num_pages=max_slots * pages_per * 2 + 8, trace=trace,
+        spec_tokens=spec_tokens)
 
 
 def warmup_cb(engine, cfg, rng, prompt_len):
@@ -257,6 +259,54 @@ def bench_cb(cfg, params, batch, prompt_len, new_tokens, max_slots=64,
         "error_sample": errs[0][:200] if errs else "",
         "serve_peak_tok_s": round(peak[0], 1),
     }
+
+
+def bench_spec(cfg, params, batch=64, prompt_len=128, new_tokens=128,
+               spec_tokens=4):
+    """Prompt-lookup speculative decoding A/B on the SAME prompts and
+    engine geometry — GREEDY decode, the locally-repetitive regime the
+    lookup targets (random-init models loop under greedy; real math/code
+    CoT rollouts behave similarly). Records tok/s off vs on, the speedup,
+    and tokens-per-dispatch acceptance telemetry."""
+    import numpy as np
+
+    from polyrl_tpu.rollout.sampling import SamplingParams
+
+    rng = np.random.default_rng(11)
+    prompts = [rng.integers(1, cfg.vocab_size, prompt_len).tolist()
+               for _ in range(batch)]
+    sp = SamplingParams(temperature=0.0, max_new_tokens=new_tokens,
+                        stop_token_ids=())
+    res: dict = {"spec_tokens": spec_tokens, "temperature": 0.0}
+    for label, st in (("off", 0), ("on", spec_tokens)):
+        engine = make_cb_engine(cfg, params, prompt_len, new_tokens,
+                                max_slots=batch, spec_tokens=st)
+        try:
+            warmup_cb(engine, cfg, rng, prompt_len)  # greedy uses no-filter
+            warm = [rng.integers(1, cfg.vocab_size, prompt_len).tolist()
+                    for _ in range(2)]
+            engine.generate(warm, SamplingParams(
+                temperature=0.0, max_new_tokens=8, stop_token_ids=()),
+                timeout=600.0)  # end-to-end sanity before timing
+            engine.flush_prefix_cache()
+            # acceptance telemetry must reflect the TIMED run only
+            engine.spec_emitted = engine.spec_dispatches = 0
+            t0 = time.monotonic()
+            outs = engine.generate(prompts, sp, timeout=1800.0)
+            dt = time.monotonic() - t0
+            total = sum(len(o["token_ids"]) for o in outs)
+            res[label] = {"tok_s": round(total / dt, 1),
+                          "wall_s": round(dt, 2)}
+            if st:
+                res[label]["tok_per_dispatch"] = round(
+                    engine.spec_emitted / max(engine.spec_dispatches, 1), 2)
+        finally:
+            engine.stop()
+            del engine
+            gc.collect()
+    if res.get("off", {}).get("tok_s"):
+        res["speedup"] = round(res["on"]["tok_s"] / res["off"]["tok_s"], 3)
+    return res
 
 
 def bench_weight_sync(params):
@@ -530,7 +580,7 @@ def child_main() -> None:
     prompt_len = int(os.environ.get("POLYRL_BENCH_PROMPT", "128"))
     new_tokens = int(os.environ.get("POLYRL_BENCH_NEW", "128"))
     phases = os.environ.get(
-        "POLYRL_BENCH_PHASES", "bucketed,cb,weight_sync,8b").split(",")
+        "POLYRL_BENCH_PHASES", "bucketed,cb,spec,weight_sync,8b").split(",")
 
     def run_phase(name: str, fn, store_key: str | None = None) -> None:
         key = store_key or name
@@ -586,7 +636,7 @@ def child_main() -> None:
     from polyrl_tpu.models import decoder
 
     cfg = decoder.get_config(preset, dtype=jnp.bfloat16)
-    needs_flagship = [p for p in ("bucketed", "cb", "weight_sync")
+    needs_flagship = [p for p in ("bucketed", "cb", "spec", "weight_sync")
                       if p in phases and p not in extra]
     params = None
     if needs_flagship:
@@ -630,6 +680,10 @@ def child_main() -> None:
                  steps_per_dispatch=int(os.environ.get("POLYRL_BENCH_K",
                                                        "8"))),
         "serve_tok_s", min(max_slots, batch), param_count, param_count * 2))
+    run_phase("spec", lambda: bench_spec(
+        cfg, params, batch=min(batch, 64), prompt_len=prompt_len,
+        new_tokens=new_tokens,
+        spec_tokens=int(os.environ.get("POLYRL_BENCH_SPEC", "4"))))
     run_phase("weight_sync", lambda: bench_weight_sync(params))
     if params is not None:
         del params

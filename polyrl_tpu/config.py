@@ -65,6 +65,10 @@ class RolloutSection:
     # page-aligned chunk per engine iteration, interleaved with decode.
     # 0 = off (whole-prompt dispatches).
     prefill_chunk: int = 0
+    # prompt-lookup speculative decoding (cb backend): N ngram-proposed
+    # draft tokens verified per decode dispatch — up to N+1 tokens per
+    # weight read, distribution-exact rejection sampling. 0 = off.
+    spec_tokens: int = 0
     # disaggregated plumbing (reference rollout_manager.{port,endpoint},
     # workers/config/rollout.py:95-101)
     manager_endpoint: str = ""            # "" → spawn the C++ manager locally

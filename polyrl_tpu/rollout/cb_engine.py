@@ -46,7 +46,11 @@ import numpy as np
 from polyrl_tpu.models import decoder
 from polyrl_tpu.rollout.engine import next_bucket
 from polyrl_tpu.rollout.prefix_cache import PrefixCache
-from polyrl_tpu.rollout.sampling import SamplingParams, sample_token_vec
+from polyrl_tpu.rollout.sampling import (
+    SamplingParams,
+    sample_token_vec,
+    spec_verify_sample_vec,
+)
 
 log = logging.getLogger(__name__)
 
@@ -115,6 +119,7 @@ class CBEngine:
         mesh=None,
         prefill_chunk: int = 0,
         trace: bool | None = None,
+        spec_tokens: int = 0,
     ):
         if any(b % page_size for b in prompt_buckets):
             raise ValueError("prompt buckets must be page-aligned")
@@ -207,6 +212,25 @@ class CBEngine:
         # 0 disables (prompts prefill in one dispatch as before).
         self.prefill_chunk = int(prefill_chunk)
         self._chunk_jobs: collections.deque = collections.deque()
+        # prompt-lookup speculative decoding (opt-in): each decode dispatch
+        # carries spec_tokens ngram-proposed draft tokens per slot; ONE
+        # verify forward scores them all and distribution-exact rejection
+        # sampling (sampling.spec_verify_sample_vec) emits the accepted
+        # prefix + 1 — up to spec_tokens+1 tokens per weight read instead
+        # of 1. Wins when outputs are locally repetitive (math/code CoT);
+        # costs m× attention reads per dispatch, so it trades against long
+        # contexts. Host proposals need current mirrors → spec dispatches
+        # are not pipelined (the pipeline drains before each one).
+        if spec_tokens < 0:
+            raise ValueError(f"spec_tokens must be >= 0, got {spec_tokens}")
+        self.spec_tokens = int(spec_tokens)
+        self.spec_ngram = 3  # longest suffix n-gram tried for the lookup
+        # per-slot token history (prompt + emitted) backing the ngram
+        # proposer; maintained only when speculation is on
+        self._hist: list[list[int] | None] | None = (
+            [None] * s if self.spec_tokens > 0 else None)
+        self.spec_emitted = 0     # tokens emitted by spec dispatches
+        self.spec_dispatches = 0  # spec dispatch count (acceptance telemetry)
 
         # serving telemetry (server_info contract)
         self.weight_version = 0
@@ -333,6 +357,94 @@ class CBEngine:
             self._step_fns[key] = jax.jit(
                 step, donate_argnums=(1, 2, 5, 6, 7, 9), static_argnames=())
         return self._step_fns[key]
+
+    def _get_spec_step(self, use_filters: bool, m: int):
+        """One speculative dispatch: verify ``m`` tokens per slot (the last
+        real token + m-1 ngram drafts) in ONE forward, then emit the
+        rejection-sampled accepted prefix + 1. The verify forward IS
+        ``forward_paged_decode`` on S·m flattened 'virtual slots' — token
+        (s, i) is a row at position seq_lens[s]+i sharing slot s's page
+        table, so the paged-attention kernel and KV scatter are reused
+        unchanged; within a layer all m rows' KV is scattered before the
+        attention reads, giving exact causal semantics. Outputs are
+        [m, slots] rows + an ``emitted`` mask (rejected-draft rows are not
+        real emissions)."""
+        key = ("spec", use_filters, m)
+        if key not in self._step_fns:
+            cfg, pad = self.cfg, self.pad_token_id
+            paged_attn = self._tp_paged_attn()
+            page_size = self.page_size
+
+            def spec(params, kp, vp, rng, draft, page_table, seq_lens,
+                     last_tokens, n_generated, budgets, active, temps,
+                     top_ps, top_ks, stop_table):
+                s = seq_lens.shape[0]
+                tokens_in = jnp.concatenate([last_tokens[:, None], draft], 1)
+                pos = seq_lens[:, None] + jnp.arange(m, dtype=jnp.int32)[None]
+                max_pos = page_table.shape[1] * page_size
+                # rows past the slot's page capacity write to the null page
+                # (their logits are garbage; budgets stop emission first)
+                okf = (pos < max_pos) & active[:, None]
+                logits, (kp, vp) = decoder.forward_paged_decode(
+                    params, cfg, tokens_in.reshape(s * m),
+                    pos.reshape(s * m), (kp, vp),
+                    jnp.repeat(page_table, m, axis=0), pos.reshape(s * m),
+                    active=okf.reshape(s * m), attn_fn=paged_attn)
+                logits = logits.reshape(s, m, -1)
+                rng, sub = jax.random.split(rng)
+                toks, logps, n_acc = spec_verify_sample_vec(
+                    logits, draft, sub, temps, top_ps, top_ks, use_filters)
+                # sequential stop/budget semantics over the emitted prefix
+                stopped = jnp.zeros_like(active)
+                n_gen = n_generated
+                emit_cnt = jnp.zeros((s,), jnp.int32)
+                last_emitted = last_tokens
+                out_t, out_l, out_d, out_e = [], [], [], []
+                for i in range(m):  # static unroll, m is small
+                    want = active & ~stopped & (i <= n_acc)
+                    tok_i = jnp.where(want, toks[:, i], pad)
+                    n_gen = n_gen + want.astype(jnp.int32)
+                    hit = jnp.any(tok_i[:, None] == stop_table, axis=-1) & want
+                    done_i = want & (hit | (n_gen >= budgets))
+                    out_t.append(tok_i)
+                    out_l.append(jnp.where(want, logps[:, i], 0.0))
+                    out_d.append(done_i)
+                    out_e.append(want)
+                    stopped = stopped | done_i
+                    emit_cnt = emit_cnt + want.astype(jnp.int32)
+                    last_emitted = jnp.where(want, toks[:, i], last_emitted)
+                new_active = active & ~stopped
+                return (kp, vp, rng, jnp.stack(out_t), jnp.stack(out_l),
+                        jnp.stack(out_d), jnp.stack(out_e),
+                        seq_lens + emit_cnt, last_emitted, n_gen, new_active)
+
+            self._step_fns[key] = jax.jit(
+                spec, donate_argnums=(1, 2, 6, 7, 8, 10))
+        return self._step_fns[key]
+
+    def _propose_ngram(self, slot: int, m: int) -> np.ndarray:
+        """m draft tokens for ``slot`` by prompt lookup: find the most
+        recent earlier occurrence of the history's final g-gram (g =
+        spec_ngram, falling back to shorter grams) and propose its
+        continuation; no match repeats the last token (rejection sampling
+        keeps any proposal distribution-exact — a bad guess only wastes
+        verify FLOPs)."""
+        hist = self._hist[slot] if self._hist is not None else None
+        if not hist:
+            return np.full((m,), self.pad_token_id, np.int32)
+        h = np.asarray(hist, np.int32)
+        n = h.size
+        out = np.full((m,), int(h[-1]), np.int32)
+        for g in range(min(self.spec_ngram, n - 1), 0, -1):
+            key = h[n - g:]
+            win = np.lib.stride_tricks.sliding_window_view(h[: n - 1], g)
+            matches = np.flatnonzero((win == key).all(axis=1))
+            if matches.size:
+                start = int(matches[-1]) + g  # continuation of last match
+                cont = h[start : start + m]
+                out[: cont.size] = cont
+                return out
+        return out
 
     def _tp_paged_attn(self):
         """Under a tp>1 mesh the Pallas paged-attention custom call must be
@@ -662,15 +774,30 @@ class CBEngine:
                             n_pre *= 2
             for uf in filter_variants:
                 st = self._dev_state
-                fn = self._get_step(uf, self.steps_per_dispatch)
                 t0 = time.monotonic()
-                (kp, vp, self._rng, _t, _l, _d, st["seq_lens"],
-                 st["last_tokens"], st["n_generated"], st["active"]) = fn(
-                    self.params, self._pools[0], self._pools[1], self._rng,
-                    st["page_table"], st["seq_lens"], st["last_tokens"],
-                    st["n_generated"], st["budgets"], st["active"],
-                    st["temps"], st["top_ps"], st["top_ks"],
-                    st["stop_table"])
+                if self.spec_tokens > 0:
+                    # speculative engines route EVERY decode dispatch
+                    # through the spec step — precompile it (the k-step
+                    # variants would never run)
+                    m = self.spec_tokens + 1
+                    fn = self._get_spec_step(uf, m)
+                    draft = jnp.zeros((self.max_slots + 1, m - 1), jnp.int32)
+                    (kp, vp, self._rng, _t, _l, _d, _e, st["seq_lens"],
+                     st["last_tokens"], st["n_generated"], st["active"]) = fn(
+                        self.params, self._pools[0], self._pools[1],
+                        self._rng, draft, st["page_table"], st["seq_lens"],
+                        st["last_tokens"], st["n_generated"], st["budgets"],
+                        st["active"], st["temps"], st["top_ps"],
+                        st["top_ks"], st["stop_table"])
+                else:
+                    fn = self._get_step(uf, self.steps_per_dispatch)
+                    (kp, vp, self._rng, _t, _l, _d, st["seq_lens"],
+                     st["last_tokens"], st["n_generated"], st["active"]) = fn(
+                        self.params, self._pools[0], self._pools[1],
+                        self._rng, st["page_table"], st["seq_lens"],
+                        st["last_tokens"], st["n_generated"], st["budgets"],
+                        st["active"], st["temps"], st["top_ps"],
+                        st["top_ks"], st["stop_table"])
                 self._pools = (kp, vp)
                 self._tmark("warmup_step", t0)
             jax.block_until_ready(self._pools[0][0])
@@ -1048,9 +1175,11 @@ class CBEngine:
             self._stop_table[slot] = stops
             self._slots[slot] = _SlotInfo(req, private, set(sp.stop_token_ids),
                                           cache_entries=entries)
+            if self._hist is not None:
+                self._hist[slot] = list(req.input_ids)
             self._slot_gen[slot] += 1
             idxs.append((slot, int(self._slot_gen[slot])))
-        self._emit_q.append(("prefillb", token, logp, done, idxs))
+        self._emit_q.append(("prefillb", (token, logp, done), idxs))
 
     def _prefill_request(self, slot: int, req: _Request, pages: list[int],
                          budget: int, matched_pages: list[int] | None = None,
@@ -1132,8 +1261,10 @@ class CBEngine:
         self._stop_table[slot] = stops
         self._slots[slot] = _SlotInfo(req, private, set(sp.stop_token_ids),
                                       cache_entries=matched_entries)
+        if self._hist is not None:
+            self._hist[slot] = list(req.input_ids)
         self._slot_gen[slot] += 1
-        self._emit_q.append(("prefill", token, logp, done,
+        self._emit_q.append(("prefill", (token, logp, done),
                              (slot, int(self._slot_gen[slot]))))
 
     # -- device-resident state + pipelined stepping --------------------------
@@ -1181,17 +1312,22 @@ class CBEngine:
         # ONE batched transfer for every outstanding output (a device_get
         # per entry would serialize a tunnel round trip each)
         t0 = time.monotonic()
-        fetched = jax.device_get([e[1:4] for e in entries])
+        fetched = jax.device_get([e[1] for e in entries])
         self._tmark("fetch", t0)
-        for (kind, _t, _l, _d, tail), (token, logp, done) in zip(entries, fetched):
+        for (kind, _payload, tail), arrs in zip(entries, fetched):
             if kind == "step":
-                self._emit_fetched(token, logp, done, tail)
+                self._emit_fetched(*arrs, tail)
+            elif kind == "spec":
+                token, logp, done, emitted = arrs
+                self._emit_fetched(token, logp, done, tail, emitted=emitted)
             elif kind == "prefillb":
                 # batched admission wave: one output row per real request
+                token, logp, done = arrs
                 for j, slot_gen in enumerate(tail):
                     self._emit_prefill(int(token[j]), float(logp[j]),
                                        bool(done[j]), slot_gen)
             else:
+                token, logp, done = arrs
                 self._emit_prefill(int(token), float(logp), bool(done), tail)
 
     def _emit_prefill(self, t: int, lp: float, device_done: bool,
@@ -1208,6 +1344,8 @@ class CBEngine:
         info.req.out.put({"token_ids": [t], "logprobs": [lp],
                           "finished": fin, "finish_reason": reason})
         self._last_tokens[slot] = t
+        if self._hist is not None:
+            self._hist[slot].append(t)
         self._count_tokens(1)
         if fin:
             info.req.out.put(STREAM_END)
@@ -1217,22 +1355,27 @@ class CBEngine:
                 # stop token beyond the device table: device active is stale
                 self._invalidate_dev_state()
 
-    def _emit_fetched(self, token, logp, done, idxs) -> None:
+    def _emit_fetched(self, token, logp, done, idxs, emitted=None) -> None:
         """Stream one fetched dispatch ([k, slots] token/logp/done rows, one
         per fused step) to the requests; ``idxs`` is a list of (slot,
         generation) pairs and may be a superset of live slots (mirrors lag
         the pipeline by one step) — finished slots, slots that finished in
         an EARLIER row of this same dispatch (pad-token tail of the scan),
         and slots reused by a newer admission (generation mismatch) are all
-        filtered."""
+        filtered. ``emitted`` ([rows, slots] bool, speculative dispatches
+        only) masks rows a slot did not actually emit (rejected drafts)."""
         token, logp, done = (np.atleast_2d(np.asarray(a))
                              for a in (token, logp, done))
+        if emitted is not None:
+            emitted = np.atleast_2d(np.asarray(emitted))
         n_emitted = 0
         host_stop_fix = False
         for r in range(token.shape[0]):
             for i, gen in idxs:
                 info = self._slots[i]
                 if info is None or not self._active[i] or self._slot_gen[i] != gen:
+                    continue
+                if emitted is not None and not emitted[r, i]:
                     continue
                 t = int(token[r, i])
                 # host check is authoritative: covers stop tokens beyond the
@@ -1248,6 +1391,8 @@ class CBEngine:
                 self._seq_lens[i] += 1
                 self._last_tokens[i] = t
                 self._n_generated[i] += 1
+                if self._hist is not None:
+                    self._hist[i].append(t)
                 if fin:
                     info.req.out.put(STREAM_END)
                     self._active[i] = False
@@ -1262,6 +1407,8 @@ class CBEngine:
                         host_stop_fix = True
         if host_stop_fix:
             self._invalidate_dev_state()
+        if emitted is not None:
+            self.spec_emitted += n_emitted
         self._count_tokens(n_emitted)
         self.num_running = int(self._active.sum())
 
@@ -1289,6 +1436,9 @@ class CBEngine:
             return
         use_filters = bool(np.any(
             (self._top_ps[self._active] < 1.0) | (self._top_ks[self._active] > 0)))
+        if self.spec_tokens > 0:
+            self._spec_step_once(use_filters)
+            return
         t0 = time.monotonic()
         self._ensure_dev_state()
         self._tmark("upload", t0)
@@ -1303,12 +1453,46 @@ class CBEngine:
             st["top_ps"], st["top_ks"], st["stop_table"])
         self._tmark("step_dispatch", t0)
         self._pools = (kp, vp)
-        self._emit_q.append(("step", token, logp, done,
+        self._emit_q.append(("step", (token, logp, done),
                              [(int(i), int(self._slot_gen[i]))
                               for i in np.flatnonzero(self._active)]))
         # keep a couple of dispatches outstanding: older outputs stream out
         # while the device computes, hiding the tunnel round trip entirely
         self._drain_emit_q(keep=self.pipeline_depth)
+
+    def _spec_step_once(self, use_filters: bool) -> None:
+        """One speculative decode dispatch. Host ngram proposals require
+        CURRENT mirrors (the draft continues from each slot's true last
+        token), so the emission pipeline drains before AND after — spec
+        trades the pipeline's RTT hiding for multi-token weight-read
+        amortization."""
+        m = self.spec_tokens + 1
+        self._drain_emit_q()
+        if not self._active.any():
+            return
+        t0 = time.monotonic()
+        self._ensure_dev_state()
+        self._tmark("upload", t0)
+        st = self._dev_state
+        draft = np.zeros((self.max_slots + 1, m - 1), np.int32)  # + sink row
+        for i in np.flatnonzero(self._active):
+            draft[i] = self._propose_ngram(int(i), m - 1)
+        fn = self._get_spec_step(use_filters, m)
+        t0 = time.monotonic()
+        (kp, vp, self._rng, token, logp, done, emitted, st["seq_lens"],
+         st["last_tokens"], st["n_generated"], st["active"]) = fn(
+            self.params, self._pools[0], self._pools[1], self._rng,
+            jnp.asarray(draft), st["page_table"], st["seq_lens"],
+            st["last_tokens"], st["n_generated"], st["budgets"],
+            st["active"], st["temps"], st["top_ps"], st["top_ks"],
+            st["stop_table"])
+        self._tmark("spec_dispatch", t0)
+        self._pools = (kp, vp)
+        self.spec_dispatches += 1
+        self._emit_q.append(("spec", (token, logp, done, emitted),
+                             [(int(i), int(self._slot_gen[i]))
+                              for i in np.flatnonzero(self._active)]))
+        self._drain_emit_q()  # sync: the next proposals need these tokens
 
     def _finalize(self, slot: int) -> None:
         info = self._slots[slot]
@@ -1322,6 +1506,8 @@ class CBEngine:
         self._last_tokens[slot] = self.pad_token_id
         self._n_generated[slot] = 0
         self._budgets[slot] = 0
+        if self._hist is not None:
+            self._hist[slot] = None
 
     # -- emission helpers ----------------------------------------------------
 

@@ -63,6 +63,33 @@ def apply_top_p(logits: jnp.ndarray, p: float) -> jnp.ndarray:
     return jnp.where(logits < threshold, NEG_INF, logits)
 
 
+def _filtered_scaled(
+    logits: jnp.ndarray,  # [S, V] f32
+    temps: jnp.ndarray,   # [S] f32
+    top_ps: jnp.ndarray,  # [S] f32
+    top_ks: jnp.ndarray,  # [S] int32
+    use_filters: bool,
+) -> jnp.ndarray:
+    """Temperature-scaled logits with per-row top-k/top-p masks applied —
+    THE sampling distribution (shared by the plain sampler and the
+    speculative verify sampler, which must accept/reject against exactly
+    the distribution tokens are sampled from)."""
+    scaled = logits / jnp.maximum(temps, 1e-6)[:, None]
+    if use_filters:
+        v = logits.shape[-1]
+        sorted_desc = jnp.sort(scaled, axis=-1)[:, ::-1]
+        idx_k = jnp.clip(top_ks - 1, 0, v - 1)
+        thr_k = jnp.take_along_axis(sorted_desc, idx_k[:, None], axis=-1)
+        scaled = jnp.where((top_ks[:, None] > 0) & (scaled < thr_k), NEG_INF, scaled)
+        sorted2 = jnp.sort(scaled, axis=-1)[:, ::-1]
+        probs = jax.nn.softmax(sorted2, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        kept = jnp.sum(cum - probs < top_ps[:, None], axis=-1, keepdims=True)
+        thr_p = jnp.take_along_axis(sorted2, jnp.maximum(kept - 1, 0), axis=-1)
+        scaled = jnp.where(scaled < thr_p, NEG_INF, scaled)
+    return scaled
+
+
 def sample_token_vec(
     logits: jnp.ndarray,  # [S, V] f32
     rng: jax.Array,
@@ -80,19 +107,7 @@ def sample_token_vec(
     greedy_logp = jnp.take_along_axis(
         jax.nn.log_softmax(logits, axis=-1), greedy_tok[:, None], axis=-1)[:, 0]
 
-    scaled = logits / jnp.maximum(temps, 1e-6)[:, None]
-    if use_filters:
-        v = logits.shape[-1]
-        sorted_desc = jnp.sort(scaled, axis=-1)[:, ::-1]
-        idx_k = jnp.clip(top_ks - 1, 0, v - 1)
-        thr_k = jnp.take_along_axis(sorted_desc, idx_k[:, None], axis=-1)
-        scaled = jnp.where((top_ks[:, None] > 0) & (scaled < thr_k), NEG_INF, scaled)
-        sorted2 = jnp.sort(scaled, axis=-1)[:, ::-1]
-        probs = jax.nn.softmax(sorted2, axis=-1)
-        cum = jnp.cumsum(probs, axis=-1)
-        kept = jnp.sum(cum - probs < top_ps[:, None], axis=-1, keepdims=True)
-        thr_p = jnp.take_along_axis(sorted2, jnp.maximum(kept - 1, 0), axis=-1)
-        scaled = jnp.where(scaled < thr_p, NEG_INF, scaled)
+    scaled = _filtered_scaled(logits, temps, top_ps, top_ks, use_filters)
     logp_all = jax.nn.log_softmax(scaled, axis=-1)
     tok = jax.random.categorical(rng, scaled, axis=-1).astype(jnp.int32)
     logp = jnp.take_along_axis(logp_all, tok[:, None], axis=-1)[:, 0]
@@ -101,6 +116,74 @@ def sample_token_vec(
     token = jnp.where(is_greedy, greedy_tok, tok)
     logp = jnp.where(is_greedy, greedy_logp, logp)
     return token, logp
+
+
+def spec_verify_sample_vec(
+    logits: jnp.ndarray,  # [S, m, V] f32 — verify logits: [s, i] is the
+                          # next-token distribution AFTER draft token i-1
+                          # (position 0 follows the slot's last real token)
+    draft: jnp.ndarray,   # [S, m-1] int32 — deterministic (ngram) proposals
+    rng: jax.Array,
+    temps: jnp.ndarray,   # [S] f32; 0 = greedy
+    top_ps: jnp.ndarray,
+    top_ks: jnp.ndarray,
+    use_filters: bool = True,
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Speculative (prompt-lookup) verify sampling. Returns
+    ``(tokens [S, m], logps [S, m], n_acc [S])``: per slot the first
+    ``n_acc`` tokens are accepted draft tokens and position ``n_acc`` holds
+    the replacement/bonus sample — so ``n_acc + 1`` tokens are emitted.
+
+    Distribution-exact for a deterministic proposal q = δ(draft):
+    accept draft ``d`` with prob ``p(d)`` (= min(1, p/q)); on rejection
+    sample from ``normalize(max(p - q, 0))`` = p with d masked out; after
+    accepting ALL drafts, the bonus token samples from the last verify
+    distribution unadjusted. Greedy rows accept iff argmax == d and replace
+    with the argmax, which makes spec output token-EXACT vs plain greedy
+    decode. ``p`` is the engine's real sampling distribution
+    (temperature + top-k/top-p via ``_filtered_scaled``)."""
+    s, m, v = logits.shape
+    flat = logits.reshape(s * m, v)
+    rep = lambda a: jnp.repeat(a, m, axis=0)  # noqa: E731
+    scaled = _filtered_scaled(flat, rep(temps), rep(top_ps), rep(top_ks),
+                              use_filters).reshape(s, m, v)
+    logp_all = jax.nn.log_softmax(scaled, axis=-1)          # [S, m, V]
+    raw_logp = jax.nn.log_softmax(logits, axis=-1)
+    greedy_tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [S, m]
+    is_greedy = temps <= 0.0                                 # [S]
+
+    r_accept, r_repl = jax.random.split(rng)
+    p_draft = jnp.exp(jnp.take_along_axis(
+        logp_all[:, : m - 1], draft[:, :, None], axis=-1))[:, :, 0]  # [S,m-1]
+    u = jax.random.uniform(r_accept, (s, m - 1))
+    acc = jnp.where(is_greedy[:, None], greedy_tok[:, : m - 1] == draft,
+                    u < p_draft)                             # [S, m-1]
+    prefix = jnp.cumprod(acc.astype(jnp.int32), axis=-1)     # [S, m-1]
+    n_acc = prefix.sum(axis=-1).astype(jnp.int32)            # [S]
+
+    # replacement distribution per position: draft token masked out
+    # (positions < m-1); the bonus position m-1 is unadjusted. In greedy
+    # rows rejection implies argmax != draft, so the argmax is unaffected
+    # by the mask — replacement = argmax keeps token-exactness.
+    adj = scaled.at[
+        jnp.arange(s)[:, None], jnp.arange(m - 1)[None], draft].set(NEG_INF)
+    repl = jax.random.categorical(
+        r_repl, adj.reshape(s * m, v), axis=-1).reshape(s, m).astype(jnp.int32)
+    repl = jnp.where(is_greedy[:, None], greedy_tok, repl)
+
+    tokens = jnp.concatenate(
+        [draft, jnp.zeros((s, 1), jnp.int32)], axis=1)       # [S, m]
+    sel = n_acc[:, None]
+    tokens = jnp.where(jnp.arange(m)[None] == sel,
+                       jnp.take_along_axis(repl, sel, axis=1), tokens)
+    # reported logp = target-model logp of the emitted token (the marginal
+    # of speculative sampling IS the target distribution): filtered dist
+    # for sampled rows, raw log-softmax for greedy rows — matching
+    # sample_token_vec's convention exactly.
+    lp_f = jnp.take_along_axis(logp_all, tokens[:, :, None], axis=-1)[:, :, 0]
+    lp_g = jnp.take_along_axis(raw_logp, tokens[:, :, None], axis=-1)[:, :, 0]
+    logps = jnp.where(is_greedy[:, None], lp_g, lp_f)
+    return tokens, logps, n_acc
 
 
 def sample_token(

@@ -41,6 +41,7 @@ def create_server(model: str, manager_endpoint: str | None = None,
                   warmup: bool = False,
                   tp: int = 1,
                   prefill_chunk: int = 0,
+                  spec_tokens: int = 0,
                   lora_rank: int = 0,
                   lora_alpha: float = 16.0):
     """Build engine + server, register with the manager, attach receiver.
@@ -145,7 +146,7 @@ def create_server(model: str, manager_endpoint: str | None = None,
             num_pages=num_pages, steps_per_dispatch=steps_per_dispatch,
             prompt_buckets=tuple(prompt_buckets) if prompt_buckets
             else (128, 256, 512, 1024, 2048, 4096), seed=seed, mesh=mesh,
-            prefill_chunk=prefill_chunk)
+            prefill_chunk=prefill_chunk, spec_tokens=spec_tokens)
     else:
         kwargs = {}
         if batch_buckets:
@@ -232,6 +233,11 @@ def main() -> None:
                    help="chunked prefill: prompts longer than this prefill "
                         "one page-aligned chunk per engine iteration, "
                         "interleaved with decode (0 = off)")
+    p.add_argument("--spec-tokens", type=int, default=0,
+                   help="prompt-lookup speculative decoding: verify this "
+                        "many ngram-proposed draft tokens per decode "
+                        "dispatch — up to N+1 tokens per weight read, "
+                        "distribution-exact (0 = off)")
     p.add_argument("--lora-rank", type=int, default=0,
                    help="LoRA delta sync: serve base + adapters; pushes "
                         "carry only adapters (match the trainer's rank)")
@@ -257,6 +263,7 @@ def main() -> None:
                            prompt_buckets=args.prompt_buckets,
                            tp=args.tp,
                            prefill_chunk=args.prefill_chunk,
+                           spec_tokens=args.spec_tokens,
                            lora_rank=args.lora_rank,
                            lora_alpha=args.lora_alpha)
     log.info("rollout server on %s", server.endpoint)

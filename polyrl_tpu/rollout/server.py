@@ -371,6 +371,11 @@ class RolloutServer:
         pc = getattr(self.engine, "prefix_cache", None)
         if pc is not None:
             info.update(pc.stats())
+        if getattr(self.engine, "spec_tokens", 0):
+            # speculative acceptance telemetry: emitted/dispatch vs the
+            # spec_tokens+1 ceiling says whether the lookup is paying off
+            info["spec_emitted"] = self.engine.spec_emitted
+            info["spec_dispatches"] = self.engine.spec_dispatches
         return info
 
     def metrics_text(self) -> str:

@@ -1,0 +1,21 @@
+"""XLA persistent-compilation-cache location, keyed by CPU features.
+
+This build VM migrates between physical hosts; loading an XLA:CPU AOT
+executable compiled with a different machine feature set can SIGILL/abort
+the process (cpu_aot_loader's warning). Keying the cache directory by the
+host's /proc/cpuinfo flags line means a migrated VM starts a fresh cache
+instead of crashing. Shared by tests/conftest.py and __graft_entry__.py.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+
+def cpu_feature_cache_dir(prefix: str = "/tmp/jax_cache_") -> str:
+    try:
+        with open("/proc/cpuinfo") as f:
+            flags = next(ln for ln in f if ln.startswith("flags"))
+    except (OSError, StopIteration):
+        flags = "unknown"
+    return prefix + hashlib.md5(flags.encode()).hexdigest()[:10]

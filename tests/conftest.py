@@ -10,6 +10,11 @@ import os
 os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ["XLA_FLAGS"] = (
     os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+    # 8 virtual devices share ONE core: a loaded box can miss XLA:CPU's
+    # default 40 s collective-rendezvous termination window, which ABORTS
+    # the whole pytest process. Slow is fine; aborted is not.
+    + " --xla_cpu_collective_call_warn_stuck_timeout_seconds=120"
+    + " --xla_cpu_collective_call_terminate_timeout_seconds=1200"
 )
 
 import jax  # noqa: E402
@@ -18,8 +23,13 @@ jax.config.update("jax_threefry_partitionable", True)
 # Numerical tests assume exact f32 matmuls (TPU bf16-MXU defaults would add
 # ~1e-3 noise); production code paths keep the fast default.
 jax.config.update("jax_default_matmul_precision", "highest")
-# Single-core machine: persist compiled executables across test runs.
-jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache")
+# Single-core machine: persist compiled executables across test runs. The
+# cache dir is keyed by the host's CPU feature set (a migrated VM must
+# start a fresh cache, not SIGABRT loading foreign AOT executables —
+# see polyrl_tpu/utils/xla_cache.py).
+from polyrl_tpu.utils.xla_cache import cpu_feature_cache_dir  # noqa: E402
+
+jax.config.update("jax_compilation_cache_dir", cpu_feature_cache_dir())
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
 import numpy as np  # noqa: E402

@@ -1,0 +1,189 @@
+"""Prompt-lookup speculative decoding (cb_engine spec_tokens > 0).
+
+The non-negotiable property: speculation must be INVISIBLE in the output
+distribution. Greedy decode must be token-EXACT vs the non-speculative
+engine; sampled decode must preserve the target distribution (verified
+statistically on the verify-sampler itself, where the math lives —
+sampling.spec_verify_sample_vec). The serving counterpart is SGLang-class
+speculative/lookahead decoding (SURVEY.md §2.2 row 1 — beyond the
+reference's deployed surface)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from polyrl_tpu.models import decoder
+from polyrl_tpu.rollout.cb_engine import CBEngine
+from polyrl_tpu.rollout.sampling import SamplingParams, spec_verify_sample_vec
+
+
+def tiny_cfg():
+    return decoder.get_config("tiny", dtype=jnp.float32, vocab_size=128)
+
+
+def make_engine(cfg, params, spec_tokens, max_slots=4, seed=0):
+    return CBEngine(cfg, params, pad_token_id=0, kv_cache_dtype=jnp.float32,
+                    max_slots=max_slots, page_size=8, max_seq_len=128,
+                    prompt_buckets=(16, 32), seed=seed,
+                    spec_tokens=spec_tokens)
+
+
+# -- verify-sampler math -----------------------------------------------------
+
+
+def test_spec_sampler_greedy_accepts_matching_prefix():
+    s, m, v = 2, 4, 16
+    rng = jax.random.PRNGKey(0)
+    logits = jnp.asarray(np.random.default_rng(0).normal(size=(s, m, v)),
+                         jnp.float32)
+    greedy = np.asarray(jnp.argmax(logits, axis=-1))
+    # slot 0: draft matches greedy everywhere → all accepted + bonus
+    # slot 1: draft wrong at position 1 → 1 accepted, replacement = argmax
+    draft = np.stack([greedy[0, : m - 1],
+                      greedy[1, : m - 1]]).astype(np.int32)
+    draft[1, 1] = (draft[1, 1] + 1) % v
+    toks, logps, n_acc = spec_verify_sample_vec(
+        logits, jnp.asarray(draft), rng,
+        temps=jnp.zeros((s,)), top_ps=jnp.ones((s,)),
+        top_ks=jnp.zeros((s,), jnp.int32), use_filters=False)
+    toks, n_acc = np.asarray(toks), np.asarray(n_acc)
+    assert n_acc.tolist() == [m - 1, 1]
+    assert toks[0].tolist() == greedy[0].tolist()  # drafts + greedy bonus
+    assert toks[1, :2].tolist() == greedy[1, :2].tolist()
+    assert toks[1, 1] == greedy[1, 1]  # replacement is the argmax
+    assert np.all(np.asarray(logps)[0] <= 0)
+
+
+def test_spec_sampler_preserves_target_distribution():
+    """Marginal of the FIRST emitted token must equal softmax(logits[0])
+    regardless of what the (deterministic) draft proposes — the core
+    speculative-sampling guarantee."""
+    v, m, n = 8, 3, 4000
+    logits_row = np.random.default_rng(1).normal(size=(v,)).astype(np.float32)
+    target = np.exp(logits_row) / np.exp(logits_row).sum()
+    draft_tok = int(np.argmax(target))  # propose the most likely token
+    logits = jnp.asarray(np.broadcast_to(logits_row, (n, m, v)))
+    draft = jnp.full((n, m - 1), draft_tok, jnp.int32)
+    toks, _, _ = spec_verify_sample_vec(
+        logits, draft, jax.random.PRNGKey(2),
+        temps=jnp.ones((n,)), top_ps=jnp.ones((n,)),
+        top_ks=jnp.zeros((n,), jnp.int32), use_filters=False)
+    first = np.asarray(toks)[:, 0]
+    emp = np.bincount(first, minlength=v) / n
+    # 4000 samples: generous tolerance, catches any systematic skew
+    assert np.abs(emp - target).max() < 0.04, (emp, target)
+
+
+def test_spec_sampler_respects_filters():
+    """With top_k=2 the emitted tokens may only come from the top-2 set,
+    draft proposals outside it must be rejected."""
+    v, m, n = 16, 3, 256
+    logits_row = np.zeros((v,), np.float32)
+    logits_row[3], logits_row[7] = 4.0, 3.5  # top-2
+    logits = jnp.asarray(np.broadcast_to(logits_row, (n, m, v)))
+    draft = jnp.full((n, m - 1), 11, jnp.int32)  # outside top-2
+    toks, _, n_acc = spec_verify_sample_vec(
+        logits, draft, jax.random.PRNGKey(3),
+        temps=jnp.ones((n,)), top_ps=jnp.ones((n,)),
+        top_ks=jnp.full((n,), 2, jnp.int32), use_filters=True)
+    toks, n_acc = np.asarray(toks), np.asarray(n_acc)
+    assert (n_acc == 0).all()  # a zero-probability draft can never accept
+    assert np.isin(toks[:, 0], [3, 7]).all()
+
+
+# -- engine end-to-end -------------------------------------------------------
+
+
+def _gen(engine, prompts, max_new, temperature):
+    sp = SamplingParams(temperature=temperature, max_new_tokens=max_new,
+                        stop_token_ids=())
+    outs = engine.generate(prompts, sp, timeout=600.0)
+    return [o["token_ids"] for o in outs], [o["logprobs"] for o in outs]
+
+
+def test_spec_greedy_token_exact_vs_plain():
+    """Greedy outputs with speculation ON must be IDENTICAL to plain
+    decode — for a repetitive prompt (high acceptance) AND a random one
+    (mostly rejected)."""
+    cfg = tiny_cfg()
+    params = decoder.init_params(jax.random.PRNGKey(0), cfg)
+    rep = [5, 6, 7, 5, 6, 7, 5, 6, 7, 5, 6]          # period-3 repetition
+    rnd = np.random.default_rng(4).integers(1, 100, 13).tolist()
+
+    plain = make_engine(cfg, params, spec_tokens=0)
+    try:
+        want_toks, want_lps = _gen(plain, [rep, rnd], 24, 0.0)
+    finally:
+        plain.stop()
+    spec = make_engine(cfg, params, spec_tokens=4)
+    try:
+        got_toks, got_lps = _gen(spec, [rep, rnd], 24, 0.0)
+        assert spec.spec_dispatches > 0
+        emitted = spec.spec_emitted
+    finally:
+        spec.stop()
+    assert got_toks == want_toks
+    for g, w in zip(got_lps, want_lps):
+        np.testing.assert_allclose(g, w, atol=1e-4)
+    # sanity: speculation actually emitted multi-token dispatches overall
+    assert emitted == sum(len(t) for t in got_toks) - 2  # minus 2 prefill toks
+
+
+def test_spec_budget_and_stop_semantics():
+    """Budgets are exact under speculation (never overshoot max_new_tokens)
+    and a stop token inside an accepted draft truncates emission there."""
+    cfg = tiny_cfg()
+    params = decoder.init_params(jax.random.PRNGKey(0), cfg)
+    eng = make_engine(cfg, params, spec_tokens=4)
+    try:
+        prompts = [[9, 8, 9, 8, 9, 8, 9], [3, 4, 5, 6, 3, 4, 5, 6]]
+        toks, _ = _gen(eng, prompts, 17, 1.0)
+        assert all(len(t) == 17 for t in toks)  # exact budget, no overshoot
+
+        # force a stop: greedy-decode to learn token 2 of the stream, then
+        # re-run with that token as a stop id
+        ref, _ = _gen(eng, [prompts[0]], 8, 0.0)
+        stop_tok = ref[0][2]
+        sp = SamplingParams(temperature=0.0, max_new_tokens=8,
+                            stop_token_ids=(stop_tok,))
+        out = eng.generate([prompts[0]], sp, timeout=600.0)[0]
+        assert out["token_ids"] == ref[0][: 3]  # truncated AT the stop token
+        assert out["token_ids"][-1] == stop_tok
+    finally:
+        eng.stop()
+
+
+def test_spec_sampled_run_is_healthy():
+    """Temperature-1 speculative serving: correct lengths, finite logprobs,
+    concurrent mixed requests."""
+    cfg = tiny_cfg()
+    params = decoder.init_params(jax.random.PRNGKey(0), cfg)
+    eng = make_engine(cfg, params, spec_tokens=3, max_slots=4)
+    try:
+        rng = np.random.default_rng(5)
+        prompts = [rng.integers(1, 100, rng.integers(5, 14)).tolist()
+                   for _ in range(6)]
+        toks, lps = _gen(eng, prompts, 12, 1.0)
+        assert all(len(t) == 12 for t in toks)
+        assert all(np.isfinite(lp).all() and (np.asarray(lp) <= 1e-6).all()
+                   for lp in lps)
+        assert eng.spec_emitted >= eng.spec_dispatches  # ≥1 token/dispatch
+    finally:
+        eng.stop()
+
+
+def test_spec_ngram_proposer():
+    cfg = tiny_cfg()
+    params = decoder.init_params(jax.random.PRNGKey(0), cfg)
+    eng = make_engine(cfg, params, spec_tokens=4)
+    try:
+        eng._hist[0] = [1, 2, 3, 9, 9, 1, 2, 3]
+        # last 3-gram [1,2,3] matched at position 0 → continuation [9, 9, 1, 2]
+        assert eng._propose_ngram(0, 4).tolist() == [9, 9, 1, 2]
+        eng._hist[0] = [4, 5, 6, 7]          # no repeat → repeat-last
+        assert eng._propose_ngram(0, 3).tolist() == [7, 7, 7]
+        eng._hist[0] = [8]
+        assert eng._propose_ngram(0, 2).tolist() == [8, 8]
+    finally:
+        eng.stop()
