@@ -194,9 +194,21 @@ def bench_cb(cfg, params, batch, prompt_len, new_tokens, max_slots=64,
                         stop_token_ids=())
     warmup_cb(engine, cfg, rng, prompt_len)
 
-    # direct (no HTTP): device + scheduler, no dispatch layer
+    # direct (no HTTP): device + scheduler, no dispatch layer. Optional
+    # on-chip profile of this window (POLYRL_BENCH_PROFILE_DIR): the trace
+    # to study when attacking the serving roofline (VERDICT r3 item 2).
+    import contextlib
+
+    prof_dir = os.environ.get("POLYRL_BENCH_PROFILE_DIR", "")
+    if prof_dir:
+        import jax as _jax
+
+        prof_cm = _jax.profiler.trace(prof_dir)
+    else:
+        prof_cm = contextlib.nullcontext()
     t0 = time.monotonic()
-    outs = engine.generate(prompts, sp, timeout=1200.0)
+    with prof_cm:
+        outs = engine.generate(prompts, sp, timeout=1200.0)
     dt_direct = time.monotonic() - t0
     direct_tokens = sum(len(o["token_ids"]) for o in outs)
     engine.flush_prefix_cache()

@@ -100,6 +100,24 @@ PRESETS: dict[str, ModelConfig] = {
                                  high_freq_factor=4.0,
                                  original_max_position_embeddings=8192),
     ),
+    # Llama-3.2-1B (HF config: meta-llama/Llama-3.2-1B)
+    "llama3.2-1b": ModelConfig(
+        vocab_size=128256, hidden_size=2048, intermediate_size=8192,
+        num_layers=16, num_heads=32, num_kv_heads=8, head_dim=64,
+        rope_theta=500000.0, tie_word_embeddings=True,
+        rope_scaling=RopeScaling(factor=32.0, low_freq_factor=1.0,
+                                 high_freq_factor=4.0,
+                                 original_max_position_embeddings=8192),
+    ),
+    # Llama-3.2-3B (HF config: meta-llama/Llama-3.2-3B)
+    "llama3.2-3b": ModelConfig(
+        vocab_size=128256, hidden_size=3072, intermediate_size=8192,
+        num_layers=28, num_heads=24, num_kv_heads=8, head_dim=128,
+        rope_theta=500000.0, tie_word_embeddings=True,
+        rope_scaling=RopeScaling(factor=32.0, low_freq_factor=1.0,
+                                 high_freq_factor=4.0,
+                                 original_max_position_embeddings=8192),
+    ),
     # Qwen3-1.7B (the reference recipe model, run_async_grpo_pipeline.sh:17)
     "qwen3-1.7b": ModelConfig(
         vocab_size=151936, hidden_size=2048, intermediate_size=6144,
@@ -119,8 +137,8 @@ PRESETS: dict[str, ModelConfig] = {
         attention_bias=True, tie_word_embeddings=True,
         max_position_embeddings=32768,
     ),
-    # Qwen2.5-7B — also the DeepSeek-R1-Distill-Qwen-7B architecture
-    # (BASELINE config 3: long-CoT GRPO on MATH)
+    # Qwen2.5-7B (BASELINE config 3's R1-Distill-Qwen-7B derives from the
+    # MATH variant — see the distill preset below for its rope difference)
     "qwen2.5-7b": ModelConfig(
         vocab_size=152064, hidden_size=3584, intermediate_size=18944,
         num_layers=28, num_heads=28, num_kv_heads=4, rope_theta=1000000.0,
@@ -163,6 +181,15 @@ PRESETS: dict[str, ModelConfig] = {
         num_experts=8, num_experts_per_tok=2, moe_intermediate_size=14336,
     ),
 }
+
+# DeepSeek-R1-Distill presets (BASELINE config 3 runs long-CoT GRPO on
+# R1-Distill-Qwen-7B). The 32B/Llama-8B distills reuse their base
+# architectures verbatim; the 7B is based on Qwen2.5-MATH-7B, whose rope
+# differs from the base Qwen2.5-7B (theta 10000, 4k positions).
+PRESETS["deepseek-r1-distill-qwen-7b"] = dataclasses.replace(
+    PRESETS["qwen2.5-7b"], rope_theta=10000.0, max_position_embeddings=4096)
+PRESETS["deepseek-r1-distill-qwen-32b"] = PRESETS["qwen2.5-32b"]
+PRESETS["deepseek-r1-distill-llama-8b"] = PRESETS["llama3-8b"]
 
 
 def get_config(name: str, **overrides) -> ModelConfig:

@@ -138,3 +138,26 @@ def test_sharded_forward_on_mesh(devices8):
     logits = f(sharded, ids, pos, mask)
     ref, _ = decoder.forward(params, cfg, jnp.ones((b, t), jnp.int32), pos, mask)
     np.testing.assert_allclose(np.asarray(logits), np.asarray(ref), atol=2e-4)
+
+
+def test_new_presets_param_counts_and_aliases():
+    """Llama-3.2 presets carry the published architecture (param count is
+    the cheapest full-config fingerprint) and the R1-Distill presets track
+    their actual base checkpoints (the 7B derives from Qwen2.5-MATH-7B,
+    whose rope differs from base Qwen2.5-7B)."""
+    from polyrl_tpu.models import decoder
+
+    def count(name):
+        cfg = decoder.get_config(name)
+        shapes = jax.eval_shape(
+            lambda c=cfg: decoder.init_params(jax.random.PRNGKey(0), c))
+        return sum(int(np.prod(l.shape))
+                   for l in jax.tree_util.tree_leaves(shapes))
+
+    assert abs(count("llama3.2-1b") / 1.24e9 - 1) < 0.01
+    assert abs(count("llama3.2-3b") / 3.21e9 - 1) < 0.02
+    r1_7b = decoder.PRESETS["deepseek-r1-distill-qwen-7b"]
+    assert (r1_7b.rope_theta, r1_7b.max_position_embeddings) == (10000.0, 4096)
+    assert r1_7b.hidden_size == decoder.PRESETS["qwen2.5-7b"].hidden_size
+    assert (decoder.PRESETS["deepseek-r1-distill-llama-8b"]
+            is decoder.PRESETS["llama3-8b"])
