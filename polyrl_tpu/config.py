@@ -69,6 +69,7 @@ class RolloutSection:
     # draft tokens verified per decode dispatch — up to N+1 tokens per
     # weight read, distribution-exact rejection sampling. 0 = off.
     spec_tokens: int = 0
+    spec_rounds: int = 2                  # fused device-side rounds/dispatch
     # disaggregated plumbing (reference rollout_manager.{port,endpoint},
     # workers/config/rollout.py:95-101)
     manager_endpoint: str = ""            # "" → spawn the C++ manager locally

@@ -184,10 +184,11 @@ PRESETS: dict[str, ModelConfig] = {
 
 # DeepSeek-R1-Distill presets (BASELINE config 3 runs long-CoT GRPO on
 # R1-Distill-Qwen-7B). The 32B/Llama-8B distills reuse their base
-# architectures verbatim; the 7B is based on Qwen2.5-MATH-7B, whose rope
-# differs from the base Qwen2.5-7B (theta 10000, 4k positions).
+# architectures verbatim; the 7B is based on Qwen2.5-MATH-7B (rope_theta
+# 10000, unlike base Qwen2.5-7B's 1e6) with the released distill config
+# raising max positions to 131072 for its ~32k-token CoT traces.
 PRESETS["deepseek-r1-distill-qwen-7b"] = dataclasses.replace(
-    PRESETS["qwen2.5-7b"], rope_theta=10000.0, max_position_embeddings=4096)
+    PRESETS["qwen2.5-7b"], rope_theta=10000.0)
 PRESETS["deepseek-r1-distill-qwen-32b"] = PRESETS["qwen2.5-32b"]
 PRESETS["deepseek-r1-distill-llama-8b"] = PRESETS["llama3-8b"]
 

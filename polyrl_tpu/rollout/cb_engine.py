@@ -59,6 +59,41 @@ STREAM_END = object()  # terminal marker on every request's output queue
 MAX_STOP_TOKENS = 8
 
 
+def device_ngram_propose(tok_buf: jnp.ndarray, hist_len: jnp.ndarray,
+                         n_draft: int) -> jnp.ndarray:
+    """Vectorized prompt-lookup proposal on device: for each slot, find the
+    LATEST earlier occurrence of the history's final bigram in
+    ``tok_buf[s, :hist_len[s]]`` and propose the ``n_draft`` tokens that
+    followed it; no match (or history < 3) repeats the last token.
+    Rejection sampling keeps ANY proposal distribution-exact — a bad guess
+    only wastes verify FLOPs. O(S·L) compares; jit-safe static shapes.
+
+    tok_buf: [S, L] int32 (prompt + generated, front-filled)
+    hist_len: [S] int32 valid-prefix lengths
+    returns: [S, n_draft] int32
+    """
+    s, length = tok_buf.shape
+    rows = jnp.arange(s)
+    t_last = tok_buf[rows, jnp.clip(hist_len - 1, 0, length - 1)]
+    t_prev = tok_buf[rows, jnp.clip(hist_len - 2, 0, length - 1)]
+    idx = jnp.arange(length - 1)
+    # bigram match at p: buf[p] == t_prev and buf[p+1] == t_last, with the
+    # matched bigram strictly before the final one (p+1 < hist_len-1)
+    match = ((tok_buf[:, :-1] == t_prev[:, None])
+             & (tok_buf[:, 1:] == t_last[:, None])
+             & (idx[None] + 1 < (hist_len - 1)[:, None]))
+    p = jnp.max(jnp.where(match, idx[None], -1), axis=1)          # latest
+    found = (p >= 0) & (hist_len >= 3)
+    gather = jnp.clip(p[:, None] + 2 + jnp.arange(n_draft)[None], 0,
+                      length - 1)
+    cont = jnp.take_along_axis(tok_buf, gather, axis=1)
+    # past-the-history continuation positions fall back to the last token
+    cont = jnp.where(gather < hist_len[:, None], cont, t_last[:, None])
+    return jnp.where(found[:, None], cont,
+                     jnp.broadcast_to(t_last[:, None], (s, n_draft))
+                     ).astype(jnp.int32)
+
+
 @dataclasses.dataclass
 class _Request:
     rid: str
@@ -120,6 +155,7 @@ class CBEngine:
         prefill_chunk: int = 0,
         trace: bool | None = None,
         spec_tokens: int = 0,
+        spec_rounds: int = 2,
     ):
         if any(b % page_size for b in prompt_buckets):
             raise ValueError("prompt buckets must be page-aligned")
@@ -213,20 +249,24 @@ class CBEngine:
         self.prefill_chunk = int(prefill_chunk)
         self._chunk_jobs: collections.deque = collections.deque()
         # prompt-lookup speculative decoding (opt-in): each decode dispatch
-        # carries spec_tokens ngram-proposed draft tokens per slot; ONE
-        # verify forward scores them all and distribution-exact rejection
-        # sampling (sampling.spec_verify_sample_vec) emits the accepted
-        # prefix + 1 — up to spec_tokens+1 tokens per weight read instead
-        # of 1. Wins when outputs are locally repetitive (math/code CoT);
-        # costs m× attention reads per dispatch, so it trades against long
-        # contexts. Host proposals need current mirrors → spec dispatches
-        # are not pipelined (the pipeline drains before each one).
+        # runs spec_rounds fused speculation rounds; every round proposes
+        # spec_tokens draft tokens per slot by DEVICE-side ngram lookup in
+        # a device token buffer, verifies them all in ONE forward, and
+        # distribution-exact rejection sampling (spec_verify_sample_vec)
+        # emits the accepted prefix + 1 — up to spec_tokens+1 tokens per
+        # weight read instead of 1. Fully device-resident (proposals, the
+        # token history, acceptance) so spec dispatches pipeline like
+        # normal steps — no host round trip per round. Wins when outputs
+        # are locally repetitive (math/code CoT); costs m× attention reads
+        # per verify, so it trades against very long contexts.
         if spec_tokens < 0:
             raise ValueError(f"spec_tokens must be >= 0, got {spec_tokens}")
+        if spec_rounds < 1:
+            raise ValueError(f"spec_rounds must be >= 1, got {spec_rounds}")
         self.spec_tokens = int(spec_tokens)
-        self.spec_ngram = 3  # longest suffix n-gram tried for the lookup
-        # per-slot token history (prompt + emitted) backing the ngram
-        # proposer; maintained only when speculation is on
+        self.spec_rounds = int(spec_rounds)
+        # per-slot token history mirror (prompt + emitted) — rebuilds the
+        # device token buffer on state re-uploads; spec mode only
         self._hist: list[list[int] | None] | None = (
             [None] * s if self.spec_tokens > 0 else None)
         self.spec_emitted = 0     # tokens emitted by spec dispatches
@@ -358,93 +398,112 @@ class CBEngine:
                 step, donate_argnums=(1, 2, 5, 6, 7, 9), static_argnames=())
         return self._step_fns[key]
 
-    def _get_spec_step(self, use_filters: bool, m: int):
-        """One speculative dispatch: verify ``m`` tokens per slot (the last
-        real token + m-1 ngram drafts) in ONE forward, then emit the
-        rejection-sampled accepted prefix + 1. The verify forward IS
-        ``forward_paged_decode`` on S·m flattened 'virtual slots' — token
-        (s, i) is a row at position seq_lens[s]+i sharing slot s's page
-        table, so the paged-attention kernel and KV scatter are reused
-        unchanged; within a layer all m rows' KV is scattered before the
-        attention reads, giving exact causal semantics. Outputs are
-        [m, slots] rows + an ``emitted`` mask (rejected-draft rows are not
-        real emissions)."""
-        key = ("spec", use_filters, m)
+    def _get_spec_step(self, use_filters: bool, m: int, rounds: int):
+        """``rounds`` fused speculation rounds per dispatch, fully
+        device-resident. Each round: propose m-1 draft tokens per slot via
+        bigram lookup in the device token buffer
+        (:func:`device_ngram_propose`), verify all m (the newest real token
+        + drafts) in ONE forward, rejection-sample the accepted prefix + 1,
+        and write the emitted tokens back into the buffer for the next
+        round's lookup. The verify forward IS ``forward_paged_decode`` on
+        S·m flattened 'virtual slots' — token (s, i) is a row at position
+        seq_lens[s]+i sharing slot s's page table, so the paged-attention
+        kernel and KV scatter are reused unchanged; within a layer all m
+        rows' KV is scattered before the attention reads, giving exact
+        causal semantics. Outputs are [rounds·m, slots] rows + an
+        ``emitted`` mask (rejected-draft rows are not real emissions)."""
+        key = ("spec", use_filters, m, rounds)
         if key not in self._step_fns:
             cfg, pad = self.cfg, self.pad_token_id
             paged_attn = self._tp_paged_attn()
             page_size = self.page_size
 
-            def spec(params, kp, vp, rng, draft, page_table, seq_lens,
+            def spec(params, kp, vp, rng, tok_buf, page_table, seq_lens,
                      last_tokens, n_generated, budgets, active, temps,
                      top_ps, top_ks, stop_table):
                 s = seq_lens.shape[0]
-                tokens_in = jnp.concatenate([last_tokens[:, None], draft], 1)
-                pos = seq_lens[:, None] + jnp.arange(m, dtype=jnp.int32)[None]
+                buf_len = tok_buf.shape[1]
+                rows = jnp.arange(s)
                 max_pos = page_table.shape[1] * page_size
-                # rows past the slot's page capacity write to the null page
-                # (their logits are garbage; budgets stop emission first)
-                okf = (pos < max_pos) & active[:, None]
-                logits, (kp, vp) = decoder.forward_paged_decode(
-                    params, cfg, tokens_in.reshape(s * m),
-                    pos.reshape(s * m), (kp, vp),
-                    jnp.repeat(page_table, m, axis=0), pos.reshape(s * m),
-                    active=okf.reshape(s * m), attn_fn=paged_attn)
-                logits = logits.reshape(s, m, -1)
-                rng, sub = jax.random.split(rng)
-                toks, logps, n_acc = spec_verify_sample_vec(
-                    logits, draft, sub, temps, top_ps, top_ks, use_filters)
-                # sequential stop/budget semantics over the emitted prefix
-                stopped = jnp.zeros_like(active)
-                n_gen = n_generated
-                emit_cnt = jnp.zeros((s,), jnp.int32)
-                last_emitted = last_tokens
-                out_t, out_l, out_d, out_e = [], [], [], []
-                for i in range(m):  # static unroll, m is small
-                    want = active & ~stopped & (i <= n_acc)
-                    tok_i = jnp.where(want, toks[:, i], pad)
-                    n_gen = n_gen + want.astype(jnp.int32)
-                    hit = jnp.any(tok_i[:, None] == stop_table, axis=-1) & want
-                    done_i = want & (hit | (n_gen >= budgets))
-                    out_t.append(tok_i)
-                    out_l.append(jnp.where(want, logps[:, i], 0.0))
-                    out_d.append(done_i)
-                    out_e.append(want)
-                    stopped = stopped | done_i
-                    emit_cnt = emit_cnt + want.astype(jnp.int32)
-                    last_emitted = jnp.where(want, toks[:, i], last_emitted)
-                new_active = active & ~stopped
-                return (kp, vp, rng, jnp.stack(out_t), jnp.stack(out_l),
-                        jnp.stack(out_d), jnp.stack(out_e),
-                        seq_lens + emit_cnt, last_emitted, n_gen, new_active)
+                pt_rep = jnp.repeat(page_table, m, axis=0)
+
+                def one_round(carry, _):
+                    (kp, vp, rng, tok_buf, seq_lens, last_tokens,
+                     n_generated, active) = carry
+                    # splice the newest (KV-pending) token into the
+                    # history — prefill-sampled first tokens arrive this
+                    # way; idempotent for tokens this fn wrote itself
+                    tok_buf = tok_buf.at[
+                        rows, jnp.clip(seq_lens, 0, buf_len - 1)
+                    ].set(last_tokens)
+                    draft = device_ngram_propose(tok_buf, seq_lens + 1,
+                                                 m - 1)
+                    tokens_in = jnp.concatenate(
+                        [last_tokens[:, None], draft], 1)
+                    pos = (seq_lens[:, None]
+                           + jnp.arange(m, dtype=jnp.int32)[None])
+                    # rows past the slot's page capacity write to the null
+                    # page (garbage logits; budgets stop emission first)
+                    okf = (pos < max_pos) & active[:, None]
+                    logits, (kp, vp) = decoder.forward_paged_decode(
+                        params, cfg, tokens_in.reshape(s * m),
+                        pos.reshape(s * m), (kp, vp), pt_rep,
+                        pos.reshape(s * m), active=okf.reshape(s * m),
+                        attn_fn=paged_attn)
+                    logits = logits.reshape(s, m, -1)
+                    rng, sub = jax.random.split(rng)
+                    toks, logps, n_acc = spec_verify_sample_vec(
+                        logits, draft, sub, temps, top_ps, top_ks,
+                        use_filters)
+                    # sequential stop/budget semantics over the prefix
+                    stopped = jnp.zeros_like(active)
+                    n_gen = n_generated
+                    emit_cnt = jnp.zeros((s,), jnp.int32)
+                    last_emitted = last_tokens
+                    out_t, out_l, out_d, out_e = [], [], [], []
+                    for i in range(m):  # static unroll, m is small
+                        want = active & ~stopped & (i <= n_acc)
+                        tok_i = jnp.where(want, toks[:, i], pad)
+                        n_gen = n_gen + want.astype(jnp.int32)
+                        hit = (jnp.any(tok_i[:, None] == stop_table, axis=-1)
+                               & want)
+                        done_i = want & (hit | (n_gen >= budgets))
+                        out_t.append(tok_i)
+                        out_l.append(jnp.where(want, logps[:, i], 0.0))
+                        out_d.append(done_i)
+                        out_e.append(want)
+                        stopped = stopped | done_i
+                        emit_cnt = emit_cnt + want.astype(jnp.int32)
+                        last_emitted = jnp.where(want, toks[:, i],
+                                                 last_emitted)
+                    # write emitted tokens into the history at
+                    # seq+1 .. seq+emit_cnt (masked rows re-write their
+                    # current value — a no-op)
+                    emit_mask = jnp.stack(out_e, axis=1)        # [S, m]
+                    widx = jnp.clip(pos + 1, 0, buf_len - 1)
+                    cur = jnp.take_along_axis(tok_buf, widx, axis=1)
+                    tok_buf = tok_buf.at[rows[:, None], widx].set(
+                        jnp.where(emit_mask, toks, cur))
+                    carry = (kp, vp, rng, tok_buf, seq_lens + emit_cnt,
+                             last_emitted, n_gen, active & ~stopped)
+                    return carry, (jnp.stack(out_t), jnp.stack(out_l),
+                                   jnp.stack(out_d), jnp.stack(out_e))
+
+                carry = (kp, vp, rng, tok_buf, seq_lens, last_tokens,
+                         n_generated, active)
+                carry, (t, l, d, e) = jax.lax.scan(one_round, carry, None,
+                                                   length=rounds)
+                (kp, vp, rng, tok_buf, seq_lens, last_tokens, n_generated,
+                 active) = carry
+                # [rounds, m, S] → [rounds·m, S] rows in emission order
+                return (kp, vp, rng, tok_buf,
+                        t.reshape(rounds * m, s), l.reshape(rounds * m, s),
+                        d.reshape(rounds * m, s), e.reshape(rounds * m, s),
+                        seq_lens, last_tokens, n_generated, active)
 
             self._step_fns[key] = jax.jit(
-                spec, donate_argnums=(1, 2, 6, 7, 8, 10))
+                spec, donate_argnums=(1, 2, 4, 6, 7, 8, 10))
         return self._step_fns[key]
-
-    def _propose_ngram(self, slot: int, m: int) -> np.ndarray:
-        """m draft tokens for ``slot`` by prompt lookup: find the most
-        recent earlier occurrence of the history's final g-gram (g =
-        spec_ngram, falling back to shorter grams) and propose its
-        continuation; no match repeats the last token (rejection sampling
-        keeps any proposal distribution-exact — a bad guess only wastes
-        verify FLOPs)."""
-        hist = self._hist[slot] if self._hist is not None else None
-        if not hist:
-            return np.full((m,), self.pad_token_id, np.int32)
-        h = np.asarray(hist, np.int32)
-        n = h.size
-        out = np.full((m,), int(h[-1]), np.int32)
-        for g in range(min(self.spec_ngram, n - 1), 0, -1):
-            key = h[n - g:]
-            win = np.lib.stride_tricks.sliding_window_view(h[: n - 1], g)
-            matches = np.flatnonzero((win == key).all(axis=1))
-            if matches.size:
-                start = int(matches[-1]) + g  # continuation of last match
-                cont = h[start : start + m]
-                out[: cont.size] = cont
-                return out
-        return out
 
     def _tp_paged_attn(self):
         """Under a tp>1 mesh the Pallas paged-attention custom call must be
@@ -780,15 +839,16 @@ class CBEngine:
                     # through the spec step — precompile it (the k-step
                     # variants would never run)
                     m = self.spec_tokens + 1
-                    fn = self._get_spec_step(uf, m)
-                    draft = jnp.zeros((self.max_slots + 1, m - 1), jnp.int32)
-                    (kp, vp, self._rng, _t, _l, _d, _e, st["seq_lens"],
-                     st["last_tokens"], st["n_generated"], st["active"]) = fn(
+                    fn = self._get_spec_step(uf, m, self.spec_rounds)
+                    (kp, vp, self._rng, st["tok_buf"], _t, _l, _d, _e,
+                     st["seq_lens"], st["last_tokens"], st["n_generated"],
+                     st["active"]) = fn(
                         self.params, self._pools[0], self._pools[1],
-                        self._rng, draft, st["page_table"], st["seq_lens"],
-                        st["last_tokens"], st["n_generated"], st["budgets"],
-                        st["active"], st["temps"], st["top_ps"],
-                        st["top_ks"], st["stop_table"])
+                        self._rng, st["tok_buf"], st["page_table"],
+                        st["seq_lens"], st["last_tokens"],
+                        st["n_generated"], st["budgets"], st["active"],
+                        st["temps"], st["top_ps"], st["top_ks"],
+                        st["stop_table"])
                 else:
                     fn = self._get_step(uf, self.steps_per_dispatch)
                     (kp, vp, self._rng, _t, _l, _d, st["seq_lens"],
@@ -812,6 +872,7 @@ class CBEngine:
             self._rng, **state_kwargs)
         self._tmark("warmup_prefill", t0)
         self._pools = (kp, vp)
+        self._carry_spec_state(new_st, [])
         self._dev_state = new_st
 
     # -- submission API (server-facing) -------------------------------------
@@ -1149,6 +1210,9 @@ class CBEngine:
             self.params, self._pools[0], self._pools[1],
             jnp.asarray(np.stack(rows_np)), self._rng, **state_kwargs)
         self._pools = (kp, vp)
+        self._carry_spec_state(new_st,
+                               [(slot, req.input_ids)
+                                for req, slot, *_rest in metas])
         self._dev_state = new_st
 
         idxs = []
@@ -1233,6 +1297,7 @@ class CBEngine:
             self.params, self._pools[0], self._pools[1],
             jnp.asarray(packed), self._rng, **state_kwargs)
         self._pools = (kp, vp)
+        self._carry_spec_state(new_st, [(slot, req.input_ids)])
         self._dev_state = new_st
 
         # publish the prompt's freshly computed full pages; ownership of
@@ -1272,6 +1337,34 @@ class CBEngine:
     def _invalidate_dev_state(self) -> None:
         self._dev_state = None
 
+    def _carry_spec_state(self, new_st: dict,
+                          admissions: list[tuple[int, list[int]]]) -> None:
+        """Prefill dispatches return a fresh state dict without the spec
+        token buffer — carry it over and write each newly admitted slot's
+        PROMPT into its row (the device-sampled first token arrives via
+        the spec step's last_tokens splice)."""
+        if self._hist is None or self._dev_state is None:
+            return
+        buf = self._dev_state.get("tok_buf")
+        if buf is None:
+            return
+        if admissions:
+            # ONE batched scatter for the whole admission wave (per-slot
+            # .at[].set would copy the full buffer once per request)
+            slots = np.array([s for s, _ in admissions], np.int32)
+            width = min(max(len(ids) for _, ids in admissions),
+                        self.max_seq_len)
+            rows = np.zeros((len(admissions), width), np.int32)
+            keep = np.zeros((len(admissions), width), bool)
+            for j, (_s, ids) in enumerate(admissions):
+                n = min(len(ids), width)
+                rows[j, :n] = ids[:n]
+                keep[j, :n] = True
+            cur = buf[jnp.asarray(slots), :width]
+            buf = buf.at[jnp.asarray(slots), :width].set(
+                jnp.where(jnp.asarray(keep), jnp.asarray(rows), cur))
+        new_st["tok_buf"] = buf
+
     def _ensure_dev_state(self) -> None:
         if self._dev_state is not None:
             return
@@ -1300,6 +1393,15 @@ class CBEngine:
                 [self._stop_table,
                  np.full((1, MAX_STOP_TOKENS), -1, np.int32)])),
         }
+        if self._hist is not None:
+            # spec token buffer (prompt + emitted per slot, front-filled),
+            # rebuilt from the host history mirror
+            buf = np.zeros((self.max_slots + 1, self.max_seq_len), np.int32)
+            for i, h in enumerate(self._hist):
+                if h:
+                    n = min(len(h), self.max_seq_len)
+                    buf[i, :n] = h[:n]
+            self._dev_state["tok_buf"] = jnp.asarray(buf)
 
     def _drain_emit_q(self, keep: int = 0) -> None:
         """Fetch queued dispatch outputs FIFO and stream them out, bringing
@@ -1461,28 +1563,23 @@ class CBEngine:
         self._drain_emit_q(keep=self.pipeline_depth)
 
     def _spec_step_once(self, use_filters: bool) -> None:
-        """One speculative decode dispatch. Host ngram proposals require
-        CURRENT mirrors (the draft continues from each slot's true last
-        token), so the emission pipeline drains before AND after — spec
-        trades the pipeline's RTT hiding for multi-token weight-read
-        amortization."""
+        """One speculative decode dispatch: spec_rounds fused rounds of
+        device-side propose→verify→accept. Fully device-resident (the
+        token history lives in dev state), so spec dispatches pipeline
+        exactly like fused normal steps — outputs drain lazily while the
+        device runs ahead."""
         m = self.spec_tokens + 1
-        self._drain_emit_q()
-        if not self._active.any():
-            return
         t0 = time.monotonic()
         self._ensure_dev_state()
         self._tmark("upload", t0)
         st = self._dev_state
-        draft = np.zeros((self.max_slots + 1, m - 1), np.int32)  # + sink row
-        for i in np.flatnonzero(self._active):
-            draft[i] = self._propose_ngram(int(i), m - 1)
-        fn = self._get_spec_step(use_filters, m)
+        fn = self._get_spec_step(use_filters, m, self.spec_rounds)
         t0 = time.monotonic()
-        (kp, vp, self._rng, token, logp, done, emitted, st["seq_lens"],
-         st["last_tokens"], st["n_generated"], st["active"]) = fn(
+        (kp, vp, self._rng, st["tok_buf"], token, logp, done, emitted,
+         st["seq_lens"], st["last_tokens"], st["n_generated"],
+         st["active"]) = fn(
             self.params, self._pools[0], self._pools[1], self._rng,
-            jnp.asarray(draft), st["page_table"], st["seq_lens"],
+            st["tok_buf"], st["page_table"], st["seq_lens"],
             st["last_tokens"], st["n_generated"], st["budgets"],
             st["active"], st["temps"], st["top_ps"], st["top_ks"],
             st["stop_table"])
@@ -1492,7 +1589,7 @@ class CBEngine:
         self._emit_q.append(("spec", (token, logp, done, emitted),
                              [(int(i), int(self._slot_gen[i]))
                               for i in np.flatnonzero(self._active)]))
-        self._drain_emit_q()  # sync: the next proposals need these tokens
+        self._drain_emit_q(keep=self.pipeline_depth)
 
     def _finalize(self, slot: int) -> None:
         info = self._slots[slot]

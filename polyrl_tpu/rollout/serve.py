@@ -42,6 +42,7 @@ def create_server(model: str, manager_endpoint: str | None = None,
                   tp: int = 1,
                   prefill_chunk: int = 0,
                   spec_tokens: int = 0,
+                  spec_rounds: int = 2,
                   lora_rank: int = 0,
                   lora_alpha: float = 16.0):
     """Build engine + server, register with the manager, attach receiver.
@@ -146,7 +147,8 @@ def create_server(model: str, manager_endpoint: str | None = None,
             num_pages=num_pages, steps_per_dispatch=steps_per_dispatch,
             prompt_buckets=tuple(prompt_buckets) if prompt_buckets
             else (128, 256, 512, 1024, 2048, 4096), seed=seed, mesh=mesh,
-            prefill_chunk=prefill_chunk, spec_tokens=spec_tokens)
+            prefill_chunk=prefill_chunk, spec_tokens=spec_tokens,
+            spec_rounds=spec_rounds)
     else:
         kwargs = {}
         if batch_buckets:
@@ -238,6 +240,9 @@ def main() -> None:
                         "many ngram-proposed draft tokens per decode "
                         "dispatch — up to N+1 tokens per weight read, "
                         "distribution-exact (0 = off)")
+    p.add_argument("--spec-rounds", type=int, default=2,
+                   help="fused device-side speculation rounds per dispatch "
+                        "(proposals and acceptance never leave the chip)")
     p.add_argument("--lora-rank", type=int, default=0,
                    help="LoRA delta sync: serve base + adapters; pushes "
                         "carry only adapters (match the trainer's rank)")
@@ -264,6 +269,7 @@ def main() -> None:
                            tp=args.tp,
                            prefill_chunk=args.prefill_chunk,
                            spec_tokens=args.spec_tokens,
+                           spec_rounds=args.spec_rounds,
                            lora_rank=args.lora_rank,
                            lora_alpha=args.lora_alpha)
     log.info("rollout server on %s", server.endpoint)

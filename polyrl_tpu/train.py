@@ -174,6 +174,7 @@ def _build_rollout(cfg: RunConfig, mcfg, params, tokenizer, cleanup: list):
             max_seq_len=cfg.rollout.max_seq_len,
             prefill_chunk=cfg.rollout.prefill_chunk,
             spec_tokens=cfg.rollout.spec_tokens,
+            spec_rounds=cfg.rollout.spec_rounds,
             **({"prompt_buckets": tuple(cfg.rollout.prompt_buckets)}
                if cfg.rollout.prompt_buckets else {}))
         local_server = RolloutServer(eng, host="127.0.0.1", port=0).start()

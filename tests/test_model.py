@@ -157,7 +157,8 @@ def test_new_presets_param_counts_and_aliases():
     assert abs(count("llama3.2-1b") / 1.24e9 - 1) < 0.01
     assert abs(count("llama3.2-3b") / 3.21e9 - 1) < 0.02
     r1_7b = decoder.PRESETS["deepseek-r1-distill-qwen-7b"]
-    assert (r1_7b.rope_theta, r1_7b.max_position_embeddings) == (10000.0, 4096)
+    assert (r1_7b.rope_theta, r1_7b.max_position_embeddings) == (10000.0,
+                                                                 131072)
     assert r1_7b.hidden_size == decoder.PRESETS["qwen2.5-7b"].hidden_size
     assert (decoder.PRESETS["deepseek-r1-distill-llama-8b"]
             is decoder.PRESETS["llama3-8b"])

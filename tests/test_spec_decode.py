@@ -173,17 +173,39 @@ def test_spec_sampled_run_is_healthy():
         eng.stop()
 
 
-def test_spec_ngram_proposer():
+def test_device_ngram_proposer():
+    """The in-jit bigram prompt-lookup: latest-match continuation,
+    self-match exclusion, past-history fallback, short-history fallback."""
+    from polyrl_tpu.rollout.cb_engine import device_ngram_propose
+
+    buf = np.zeros((4, 16), np.int32)
+    buf[0, :8] = [1, 2, 3, 9, 9, 1, 2, 3]  # final bigram (2,3) at pos 1
+    buf[1, :4] = [4, 5, 6, 7]              # bigram (6,7) never seen before
+    buf[2, :1] = [8]                       # history too short
+    buf[3, :4] = [5, 6, 5, 6]              # match at 0; cont runs past hist
+    out = np.asarray(device_ngram_propose(
+        jnp.asarray(buf), jnp.asarray([8, 4, 1, 4], jnp.int32), 4))
+    assert out[0].tolist() == [9, 9, 1, 2]
+    assert out[1].tolist() == [7, 7, 7, 7]
+    assert out[2].tolist() == [8, 8, 8, 8]
+    assert out[3].tolist() == [5, 6, 6, 6]  # in-hist cont then last-token
+
+
+def test_spec_single_round_matches_plain_greedy():
+    """spec_rounds=1 (no fusion) must also be token-exact."""
     cfg = tiny_cfg()
     params = decoder.init_params(jax.random.PRNGKey(0), cfg)
-    eng = make_engine(cfg, params, spec_tokens=4)
+    rep = [5, 6, 7, 5, 6, 7, 5, 6, 7, 5, 6]
+    plain = make_engine(cfg, params, spec_tokens=0)
     try:
-        eng._hist[0] = [1, 2, 3, 9, 9, 1, 2, 3]
-        # last 3-gram [1,2,3] matched at position 0 → continuation [9, 9, 1, 2]
-        assert eng._propose_ngram(0, 4).tolist() == [9, 9, 1, 2]
-        eng._hist[0] = [4, 5, 6, 7]          # no repeat → repeat-last
-        assert eng._propose_ngram(0, 3).tolist() == [7, 7, 7]
-        eng._hist[0] = [8]
-        assert eng._propose_ngram(0, 2).tolist() == [8, 8]
+        want, _ = _gen(plain, [rep], 20, 0.0)
+    finally:
+        plain.stop()
+    eng = CBEngine(cfg, params, pad_token_id=0, kv_cache_dtype=jnp.float32,
+                   max_slots=4, page_size=8, max_seq_len=128,
+                   prompt_buckets=(16, 32), spec_tokens=3, spec_rounds=1)
+    try:
+        got, _ = _gen(eng, [rep], 20, 0.0)
     finally:
         eng.stop()
+    assert got == want
