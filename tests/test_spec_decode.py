@@ -209,3 +209,60 @@ def test_spec_single_round_matches_plain_greedy():
     finally:
         eng.stop()
     assert got == want
+
+
+def test_spec_tp_greedy_parity():
+    """Speculation under tensor-parallel serving (the 8B deployment shape):
+    a tp=2 spec engine's greedy output must equal the plain single-device
+    engine's — the verify forward, device proposer, and token buffer all
+    run under GSPMD."""
+    from polyrl_tpu.parallel import mesh as meshlib
+
+    cfg = tiny_cfg()
+    params = decoder.init_params(jax.random.PRNGKey(0), cfg)
+    kw = dict(pad_token_id=0, kv_cache_dtype=jnp.float32, max_slots=4,
+              page_size=8, max_seq_len=64, prompt_buckets=(16,),
+              num_pages=64)
+    prompts = [[5, 6, 7, 5, 6, 7, 5, 6], [9, 8, 7, 9, 8, 7, 9]]
+
+    ref_engine = CBEngine(cfg, params, **kw)
+    try:
+        ref, _ = _gen(ref_engine, prompts, 12, 0.0)
+    finally:
+        ref_engine.stop()
+
+    mesh = meshlib.make_mesh(meshlib.MeshConfig(fsdp=1, tp=2),
+                             jax.devices()[:2])
+    eng = CBEngine(cfg, params, mesh=mesh, spec_tokens=3, spec_rounds=2,
+                   **kw)
+    try:
+        got, _ = _gen(eng, prompts, 12, 0.0)
+        assert eng.spec_dispatches > 0
+    finally:
+        eng.stop()
+    assert got == ref, (got, ref)
+
+
+def test_spec_int8_greedy_parity():
+    """Speculation over int8 weight-only-quantized serving (the 8B
+    single-chip headline configuration): spec and plain int8 engines must
+    be token-identical under greedy."""
+    from polyrl_tpu.models.quant import quantize_params
+
+    cfg = tiny_cfg()
+    params = decoder.init_params(jax.random.PRNGKey(0), cfg)
+    qparams = quantize_params(params)
+    prompts = [[5, 6, 7, 5, 6, 7, 5, 6, 7, 5, 6]]
+
+    plain = make_engine(cfg, qparams, spec_tokens=0)
+    try:
+        ref, _ = _gen(plain, prompts, 16, 0.0)
+    finally:
+        plain.stop()
+    eng = make_engine(cfg, qparams, spec_tokens=4)
+    try:
+        got, _ = _gen(eng, prompts, 16, 0.0)
+        assert eng.spec_dispatches > 0
+    finally:
+        eng.stop()
+    assert got == ref, (got, ref)
