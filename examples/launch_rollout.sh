@@ -11,9 +11,12 @@ PORT=${PORT:-30000}
 # MODEL=qwen3-30b-a3b (or a Qwen3-MoE checkpoint dir) serves the MoE family.
 # PREFILL_CHUNK=512 interleaves long-prompt admission with decode.
 # LORA_RANK=16 serves base+adapters for trainer.weight_sync=lora_delta.
+# SPEC_TOKENS=4 turns on prompt-lookup speculative decoding (up to N+1
+# tokens per weight read; distribution-exact — composes with int8).
 WEIGHT_QUANT=${WEIGHT_QUANT:-}
 PREFILL_CHUNK=${PREFILL_CHUNK:-512}
 LORA_RANK=${LORA_RANK:-0}
+SPEC_TOKENS=${SPEC_TOKENS:-0}
 
 python -m polyrl_tpu.rollout.serve \
     --model "$MODEL" \
@@ -22,5 +25,6 @@ python -m polyrl_tpu.rollout.serve \
     --warmup \
     --prefill-chunk "$PREFILL_CHUNK" \
     --lora-rank "$LORA_RANK" \
+    --spec-tokens "$SPEC_TOKENS" \
     ${WEIGHT_QUANT:+--weight-quant "$WEIGHT_QUANT"} \
     "$@"
