@@ -42,6 +42,9 @@ def main() -> None:
         'model.overrides={"num_layers": 1, "vocab_size": 2048}',
         "rollout.colocated_local=true",   # serve in-process (single jax proc)
         "rollout.max_slots=8", "rollout.max_seq_len=256",
+        "rollout.spec_tokens=2",  # speculation on the flagship path: spec ×
+                                  # time-slice abort × weight push × manager
+                                  # continuation all interact here
         "trainer.train_batch_size=4", "trainer.rollout_n=2",
         "trainer.ppo_mini_batch_size=8", "trainer.micro_batch_size=8",
         "trainer.min_stream_batch_size=8", "trainer.max_prompt_length=16",

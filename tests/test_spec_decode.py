@@ -266,3 +266,25 @@ def test_spec_int8_greedy_parity():
     finally:
         eng.stop()
     assert got == ref, (got, ref)
+
+
+def test_spec_moe_greedy_parity():
+    """Speculation over the MoE family: the grouped expert dispatch sees
+    S·m flattened verify rows with the inactive mask — greedy output must
+    match plain MoE decode exactly."""
+    cfg = decoder.get_config("moe-tiny", dtype=jnp.float32)
+    params = decoder.init_params(jax.random.PRNGKey(0), cfg)
+    prompts = [[5, 6, 7, 5, 6, 7, 5, 6], [9, 8, 9, 8, 9, 8, 9]]
+
+    plain = make_engine(cfg, params, spec_tokens=0)
+    try:
+        ref, _ = _gen(plain, prompts, 12, 0.0)
+    finally:
+        plain.stop()
+    eng = make_engine(cfg, params, spec_tokens=3)
+    try:
+        got, _ = _gen(eng, prompts, 12, 0.0)
+        assert eng.spec_dispatches > 0
+    finally:
+        eng.stop()
+    assert got == ref, (got, ref)
