@@ -288,3 +288,30 @@ def test_spec_moe_greedy_parity():
     finally:
         eng.stop()
     assert got == ref, (got, ref)
+
+
+def test_spec_with_chunked_prefill_greedy_parity():
+    """Long prompts admitted chunk-by-chunk while speculative decode
+    dispatches interleave: greedy output must match the plain engine
+    (no chunking, no speculation) exactly."""
+    rng = np.random.default_rng(13)
+    cfg = decoder.get_config("tiny", dtype=jnp.float32)
+    params = decoder.init_params(jax.random.PRNGKey(0), cfg)
+    kw = dict(pad_token_id=0, kv_cache_dtype=jnp.float32, max_slots=4,
+              page_size=8, max_seq_len=96, prompt_buckets=(8, 16, 64),
+              num_pages=96)
+    base = rng.integers(1, cfg.vocab_size, 12).tolist()
+    prompts = [base * 2, base * 3 + base[:4], base[:5]]  # 24/40/5 tokens
+
+    plain = CBEngine(cfg, params, **kw)
+    try:
+        ref, _ = _gen(plain, prompts, 10, 0.0)
+    finally:
+        plain.stop()
+    eng = CBEngine(cfg, params, prefill_chunk=8, spec_tokens=3, **kw)
+    try:
+        got, _ = _gen(eng, prompts, 10, 0.0)
+        assert eng.spec_dispatches > 0
+    finally:
+        eng.stop()
+    assert got == ref, (got, ref)
