@@ -271,6 +271,7 @@ class CBEngine:
             [None] * s if self.spec_tokens > 0 else None)
         self.spec_emitted = 0     # tokens emitted by spec dispatches
         self.spec_dispatches = 0  # spec dispatch count (acceptance telemetry)
+        self.chunk_dispatches = 0  # chunked-prefill extend dispatch count
 
         # serving telemetry (server_info contract)
         self.weight_version = 0
@@ -745,6 +746,7 @@ class CBEngine:
         kp, vp, self._rng = fn(self.params, self._pools[0], self._pools[1],
                                jnp.asarray(packed), self._rng)
         self._pools = (kp, vp)
+        self.chunk_dispatches += 1
         job["pos"] = pos + chunk
         job["own_filled"] += n_chunk_pg
 

@@ -311,7 +311,10 @@ def test_spec_with_chunked_prefill_greedy_parity():
     eng = CBEngine(cfg, params, prefill_chunk=8, spec_tokens=3, **kw)
     try:
         got, _ = _gen(eng, prompts, 10, 0.0)
+        # BOTH halves of the scenario must actually run: speculation AND
+        # chunk-extend admission dispatches
         assert eng.spec_dispatches > 0
+        assert eng.chunk_dispatches > 0
     finally:
         eng.stop()
     assert got == ref, (got, ref)
