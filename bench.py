@@ -707,17 +707,53 @@ def child_main() -> None:
     print(json.dumps(state["result"]))
 
 
+def _emit_partial(note: str) -> None:
+    """Print the state-derived JSON line (partial results beat none)."""
+    state = _load_state()
+    result = state.get("result") or assemble_result(state)
+    result.setdefault("extra", {})["bench_incomplete"] = note[:300]
+    if not result.get("value"):
+        result["metric"] = "bench_failed"
+    print(json.dumps(result), flush=True)
+
+
 def parent_main() -> None:
     """Driver entry: NO jax import here (a wedged axon relay must never be
     able to hang/poison this process). Re-runs the child while it makes
     PROGRESS (phases completing or consuming retry attempts — each failing
     phase deliberately exits the child so the next phase gets a fresh,
     unpoisoned jax backend); gives up after MAX_ATTEMPTS consecutive runs
-    with no state change or 12 runs total. Always prints one JSON line."""
+    with no state change, 12 runs, or the wall budget. ALWAYS prints one
+    JSON line — including when the DRIVER times this process out
+    (SIGTERM/SIGINT print the partial state before dying)."""
+    import signal
     import subprocess
 
     if os.path.exists(STATE_PATH):
         os.remove(STATE_PATH)  # state is per-invocation, not per-round
+    child_ref: list = [None]
+
+    def on_term(signum, frame):  # noqa: ARG001
+        # non-reentrant: a second signal mid-emission must not interleave a
+        # second JSON line into the one the driver parses
+        signal.signal(signal.SIGTERM, signal.SIG_IGN)
+        signal.signal(signal.SIGINT, signal.SIG_IGN)
+        if child_ref[0] is not None:
+            try:
+                child_ref[0].kill()
+            except OSError:
+                pass
+        _emit_partial(f"killed by signal {signum}")
+        os._exit(0)
+
+    signal.signal(signal.SIGTERM, on_term)
+    signal.signal(signal.SIGINT, on_term)
+
+    # generous by default: the retry ladder keeps its full semantics (one
+    # legitimate full-phase TPU run can take ~45 min through the tunnel);
+    # a stricter DRIVER timeout is handled by the SIGTERM partial emit
+    budget_s = float(os.environ.get("POLYRL_BENCH_BUDGET", "7200"))
+    t_start = time.monotonic()
     last_err = ""
     runs, no_progress = 0, 0
 
@@ -727,24 +763,32 @@ def parent_main() -> None:
                           sort_keys=True)
 
     prev = snapshot()
-    while runs < 12 and no_progress < MAX_ATTEMPTS:
+    while (runs < 12 and no_progress < MAX_ATTEMPTS
+           and time.monotonic() - t_start < budget_s):
         runs += 1
         print(f"[bench] child run {runs} (no-progress streak {no_progress})",
               file=sys.stderr, flush=True)
+        attempt_s = min(ATTEMPT_TIMEOUT_S,
+                        max(budget_s - (time.monotonic() - t_start), 60.0))
         try:
-            proc = subprocess.run(
+            child_ref[0] = subprocess.Popen(
                 [sys.executable, os.path.abspath(__file__), "--child"],
                 stdout=subprocess.PIPE, stderr=None,  # stderr streams live
-                timeout=ATTEMPT_TIMEOUT_S, text=True,
+                text=True,
                 cwd=os.path.dirname(os.path.abspath(__file__)) or ".")
-            rc, out = proc.returncode, proc.stdout
+            out, _ = child_ref[0].communicate(timeout=attempt_s)
+            rc = child_ref[0].returncode
             if rc != 0:
                 last_err = f"run {runs}: child rc={rc}"
                 print(f"[bench] {last_err}", file=sys.stderr, flush=True)
         except subprocess.TimeoutExpired:
+            child_ref[0].kill()
+            child_ref[0].communicate()
             rc, out = -1, ""
-            last_err = f"run {runs}: timeout {ATTEMPT_TIMEOUT_S}s"
+            last_err = f"run {runs}: timeout {attempt_s:.0f}s"
             print(f"[bench] {last_err}", file=sys.stderr, flush=True)
+        finally:
+            child_ref[0] = None
         if rc == 0 and out.strip():
             sys.stdout.write(out.strip().splitlines()[-1] + "\n")
             return
@@ -753,12 +797,7 @@ def parent_main() -> None:
         prev = cur
         time.sleep(RETRY_SLEEP_S)  # give the TPU relay time to recover
     # exhausted: print whatever the state file accumulated
-    state = _load_state()
-    result = state.get("result") or assemble_result(state)
-    result.setdefault("extra", {})["bench_incomplete"] = last_err[:300]
-    if not result.get("value"):
-        result["metric"] = "bench_failed"
-    print(json.dumps(result))
+    _emit_partial(last_err or "wall budget exhausted")
 
 
 if __name__ == "__main__":
