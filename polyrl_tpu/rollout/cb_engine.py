@@ -17,10 +17,16 @@ sglang_http_async_engine.py:286-298). Design:
   device-resident control state and the first token joins the deferred
   emission queue. No host round trip per admission.
 - Decode: the control state lives on device and the step ADVANCES it there;
-  dispatches stay `pipeline_depth` ahead and outputs are fetched in one
-  batched transfer, so device compute overlaps host streaming and the
-  dispatch round trip. Host np mirrors (updated at drain) drive admission
-  and are re-uploaded only after host-side events (abort, overflow stop).
+  dispatches stay `pipeline_depth` ahead while a dedicated FETCHER THREAD
+  owns the blocking device->host output transfer, batching every queued
+  dispatch output into one ``device_get`` — so the loop keeps the device
+  fed and result round trips overlap both compute and each other. On
+  remote-attached TPUs (PJRT proxy/tunnel) a fetch round trip costs
+  O(100ms); serializing one per dispatch was the round-3 serving
+  bottleneck. Host np mirrors (updated at drain) drive admission and are
+  re-uploaded only after host-side events (abort, overflow stop); a full
+  drain (``keep=0``) barriers on the fetcher first, so re-uploads never
+  rewind slots past results still in flight.
 
 Weight hot-swap = atomic ``self.params`` swap between steps (buffer shapes
 and shardings unchanged → no recompilation), mirroring the reference's
@@ -34,6 +40,7 @@ from __future__ import annotations
 import collections
 import dataclasses
 import logging
+import os
 import queue
 import threading
 import time
@@ -234,8 +241,38 @@ class CBEngine:
         # queued async and their (token, logp, done) outputs fetched later,
         # so device compute overlaps the tunnel round trips and streaming
         self._dev_state: dict | None = None
+        # fetch pipeline (loop thread dispatches; fetcher thread transfers):
+        #   _emit_q     dispatched outputs awaiting device_get
+        #   _fetched_q  (epoch, entry, np arrays) awaiting emission
+        #   _fetch_inflight  entries inside the fetcher's current device_get
+        #   _fetch_epoch     bumped by _recover/stop: stale results dropped
+        # all four guarded by _fetch_cv; emission stays on the loop thread
         self._emit_q: collections.deque = collections.deque()
-        self.pipeline_depth = 2
+        self._fetched_q: collections.deque = collections.deque()
+        self._fetch_cv = threading.Condition()
+        self._fetch_inflight = 0
+        self._fetch_epoch = 0
+        self._fetch_exc: BaseException | None = None
+        self._fetch_thread: threading.Thread | None = None
+        # per-slot lower bound on tokens the in-flight dispatches will
+        # deliver (loop thread only) — drives the tail cutoff in
+        # _step_once: once every mirror-active slot's remaining budget is
+        # covered by work already in flight FOR THAT SLOT, dispatching
+        # more could only produce pad rows. Per-slot matters: a slot
+        # admitted after a dispatch launched gets nothing from it.
+        self._inflight_tok = np.zeros(s, np.int64)
+        # in-flight dispatch budget: how far the loop runs ahead of emission.
+        # Needs ~2*ceil(fetch RTT / per-dispatch compute): the fetcher pulls
+        # the oldest half-window per round trip while the newer half
+        # computes, so 16 hides a ~300 ms tunnel RTT at ~40 ms/dispatch.
+        # Cost: up to this many run-ahead dispatches after the last slot
+        # finishes (near-free on device: the step no-ops via lax.cond when
+        # nothing is active) and that much abort/admission latency.
+        # 0 = fully synchronous (drain every dispatch); negative would make
+        # the drain's `outstanding <= keep` exit unreachable and spin the
+        # loop thread forever
+        self.pipeline_depth = max(
+            0, int(os.environ.get("POLYRL_CB_PIPELINE") or 16))
         # fused decode steps per dispatch (multi-step scheduling): divides
         # dispatch/fetch overhead by k at the cost of ≤(k-1) wasted
         # device iterations per finished slot and up to k steps of
@@ -283,12 +320,13 @@ class CBEngine:
         # POLYRL_CB_TRACE=1: cumulative wall per engine phase (dispatch vs
         # fetch vs prefill vs host bookkeeping) — the serving-path analogue
         # of the trainer's marked_timer spans (SURVEY.md §5.1)
-        import os as _os
-
         if trace is None:  # explicit arg wins; env is the ops-facing toggle
-            trace = bool(_os.environ.get("POLYRL_CB_TRACE"))
+            trace = bool(os.environ.get("POLYRL_CB_TRACE"))
         self._trace: dict | None = (collections.defaultdict(float)
                                     if trace else None)
+        # the fetcher thread marks "fetch"; += on a shared dict is a
+        # non-atomic read-modify-write against the loop thread's marks
+        self._trace_lock = threading.Lock()
 
     def trace_report(self) -> dict:
         """Cumulative seconds per phase (POLYRL_CB_TRACE=1), else empty."""
@@ -338,8 +376,9 @@ class CBEngine:
 
     def _tmark(self, key: str, t0: float) -> None:
         if self._trace is not None:
-            self._trace[key] += time.monotonic() - t0
-            self._trace["n_" + key] += 1
+            with self._trace_lock:
+                self._trace[key] += time.monotonic() - t0
+                self._trace["n_" + key] += 1
 
     # -- compiled pieces ----------------------------------------------------
 
@@ -890,13 +929,26 @@ class CBEngine:
         if self._loop_thread is None:
             self._loop_thread = threading.Thread(target=self._loop, daemon=True)
             self._loop_thread.start()
+        if self._fetch_thread is None:
+            self._fetch_thread = threading.Thread(target=self._fetch_loop,
+                                                  daemon=True)
+            self._fetch_thread.start()
         return self
 
     def stop(self) -> None:
         self._stop.set()
         if self._loop_thread is not None:
             self._loop_thread.join(timeout=10.0)
-        self._emit_q.clear()
+        if self._fetch_thread is not None:
+            with self._fetch_cv:
+                self._fetch_cv.notify_all()
+            self._fetch_thread.join(timeout=10.0)
+        with self._fetch_cv:
+            self._fetch_epoch += 1  # orphan anything a hung get still holds
+            self._emit_q.clear()
+            self._fetched_q.clear()
+            self._fetch_exc = None
+        self._inflight_tok[:] = 0
         self._invalidate_dev_state()
         # every in-flight and queued request must still see a terminal line +
         # STREAM_END or its HTTP handler thread blocks forever
@@ -1028,7 +1080,15 @@ class CBEngine:
     def _recover(self) -> None:
         """After any jit failure the pools may have been donated to the dead
         call; fail everything and reallocate so serving can continue."""
-        self._emit_q.clear()
+        with self._fetch_cv:
+            # bump the epoch FIRST: results a still-running device_get lands
+            # after this point are dropped at emission (slot generations
+            # would drop most anyway; the epoch also covers mirrors)
+            self._fetch_epoch += 1
+            self._emit_q.clear()
+            self._fetched_q.clear()
+            self._fetch_exc = None
+        self._inflight_tok[:] = 0
         self._invalidate_dev_state()
         self._fail_all("engine error")
         with self._pool_lock:
@@ -1083,9 +1143,13 @@ class CBEngine:
                             [s is None for s in self._slots]))
                     if int(i) not in assigned]
             if not free:
-                if not wave and self._emit_q:
-                    # finished slots may be hiding behind undrained outputs
-                    self._drain_emit_q()
+                out = self._outstanding()
+                if not wave and out:
+                    # finished slots may be hiding behind undrained
+                    # outputs: land ONE more fetch batch and re-check —
+                    # a full barrier here would stall admission (holding
+                    # _pool_lock) for the whole run-ahead pipeline
+                    self._drain_emit_q(keep=out - 1)
                     continue
                 break
             req = self._pending[0]
@@ -1161,9 +1225,10 @@ class CBEngine:
         """Page allocation with the drain + cache-evict fallbacks; releases
         the caller's matched cache entries on failure."""
         pages = self.allocator.alloc(need)
-        if pages is None and self._emit_q:
-            # drain: finished slots return their pages
-            self._drain_emit_q()
+        while pages is None and self._outstanding():
+            # drain incrementally: finished slots return their pages, and
+            # often the oldest fetch batch already holds the finisher
+            self._drain_emit_q(keep=self._outstanding() - 1)
             pages = self.allocator.alloc(need)
         if pages is None and self.prefix_cache is not None:
             # pool pressure: evict unreferenced cached pages and retry
@@ -1245,7 +1310,7 @@ class CBEngine:
                 self._hist[slot] = list(req.input_ids)
             self._slot_gen[slot] += 1
             idxs.append((slot, int(self._slot_gen[slot])))
-        self._emit_q.append(("prefillb", (token, logp, done), idxs))
+        self._enqueue_output(("prefillb", (token, logp, done), idxs))
 
     def _prefill_request(self, slot: int, req: _Request, pages: list[int],
                          budget: int, matched_pages: list[int] | None = None,
@@ -1331,7 +1396,7 @@ class CBEngine:
         if self._hist is not None:
             self._hist[slot] = list(req.input_ids)
         self._slot_gen[slot] += 1
-        self._emit_q.append(("prefill", (token, logp, done),
+        self._enqueue_output(("prefill", (token, logp, done),
                              (slot, int(self._slot_gen[slot]))))
 
     # -- device-resident state + pipelined stepping --------------------------
@@ -1405,34 +1470,153 @@ class CBEngine:
                     buf[i, :n] = h[:n]
             self._dev_state["tok_buf"] = jnp.asarray(buf)
 
+
+    def _enqueue_output(self, entry) -> None:
+        """Queue a dispatch output for the fetcher thread (wakes it)."""
+        with self._fetch_cv:
+            self._emit_q.append(entry)
+            self._fetch_cv.notify_all()
+
+    def _outstanding(self) -> int:
+        """Dispatch outputs not yet emitted (queued + in device_get + landed)."""
+        with self._fetch_cv:
+            return (len(self._emit_q) + self._fetch_inflight
+                    + len(self._fetched_q))
+
+    def _fetch_loop(self) -> None:
+        """Fetcher thread: own the blocking device->host transfer. Grabs
+        every queued output in one batched ``device_get`` (a get per entry
+        would serialize a round trip each), then hands the host arrays back
+        for the loop thread to emit. ``device_get`` releases the GIL during
+        the transfer, so round trips overlap dispatching AND each other."""
+        cv = self._fetch_cv
+        while not self._stop.is_set():
+            with cv:
+                if not self._emit_q:
+                    cv.wait(timeout=0.05)
+                    continue
+                # oldest half-window only: a get blocks until its NEWEST
+                # entry finishes on device, so grabbing everything would
+                # stall each round trip behind just-dispatched compute —
+                # the older half is already done and returns in one RTT
+                # while the newer half computes
+                cap = max(1, self.pipeline_depth // 2)
+                batch = [self._emit_q.popleft()
+                         for _ in range(min(cap, len(self._emit_q)))]
+                self._fetch_inflight = len(batch)
+                epoch = self._fetch_epoch
+            t0 = time.monotonic()
+            try:
+                fetched = jax.device_get([e[1] for e in batch])
+            except Exception as exc:  # noqa: BLE001 — surface on the
+                # loop thread (next drain) where _recover can reset pools;
+                # true BaseExceptions (SystemExit et al) must NOT be
+                # forwarded: _loop only recovers from Exception
+                with cv:
+                    self._fetch_inflight = 0
+                    if epoch == self._fetch_epoch:
+                        self._fetch_exc = exc
+                    cv.notify_all()
+                continue
+            self._tmark("fetch", t0)
+            with cv:
+                self._fetched_q.extend(
+                    (epoch, e, a) for e, a in zip(batch, fetched))
+                self._fetch_inflight = 0
+                cv.notify_all()
+
     def _drain_emit_q(self, keep: int = 0) -> None:
-        """Fetch queued dispatch outputs FIFO and stream them out, bringing
-        the host mirrors up to date. ``keep`` leaves the newest entries
-        outstanding (pipeline depth)."""
-        n = len(self._emit_q) - keep
-        if n <= 0:
+        """Stream out every dispatch output the fetcher has landed, bringing
+        the host mirrors up to date; block until at most ``keep`` outputs
+        remain un-emitted. ``keep=0`` is the full barrier every dev-state
+        re-upload needs; ``keep=pipeline_depth`` is the steady-state call
+        that only throttles the loop when the device runs too far ahead."""
+        if self._fetch_thread is None:
+            # engine not started (unit tests drive internals directly):
+            # fetch the oldest beyond ``keep`` synchronously on this thread
+            self._fetch_sync(keep)
+        cv = self._fetch_cv
+        while True:
+            with cv:
+                ready = list(self._fetched_q)
+                self._fetched_q.clear()
+                exc, self._fetch_exc = self._fetch_exc, None
+                epoch = self._fetch_epoch
+            for ep, entry, arrs in ready:
+                if ep == epoch:
+                    self._emit_entry(entry, arrs)
+            if exc is not None:
+                raise exc
+            with cv:
+                if (len(self._emit_q) + self._fetch_inflight
+                        + len(self._fetched_q) <= keep):
+                    return
+            if self._stop.is_set():
+                # the fetcher exits on stop() even with entries queued;
+                # finish the drain synchronously so the loop thread can
+                # observe _stop and join instead of waiting out the timeout.
+                # FIFO: if the fetcher still owns an older in-flight batch,
+                # wait for it to land rather than fetching newer entries
+                # past it (out-of-order emission corrupts the mirrors); the
+                # queue grab happens under the SAME cv hold as the inflight
+                # check so the fetcher cannot pop a batch in between
+                with cv:
+                    if self._fetch_inflight:
+                        cv.wait(timeout=0.2)
+                        continue
+                    batch = list(self._emit_q)
+                    self._emit_q.clear()
+                    epoch = self._fetch_epoch
+                if batch:
+                    fetched = jax.device_get([e[1] for e in batch])
+                    with cv:
+                        self._fetched_q.extend(
+                            (epoch, e, a) for e, a in zip(batch, fetched))
+                continue
+            with cv:
+                if not self._fetched_q and (self._emit_q
+                                            or self._fetch_inflight):
+                    cv.wait(timeout=0.2)
+
+    def _fetch_sync(self, keep: int = 0) -> None:
+        """Unthreaded fallback: move queued outputs beyond ``keep`` (oldest
+        first) to _fetched_q — the pre-fetcher-thread drain semantics."""
+        with self._fetch_cv:
+            n = len(self._emit_q) - keep
+            batch = [self._emit_q.popleft() for _ in range(max(0, n))]
+            epoch = self._fetch_epoch
+        if not batch:
             return
-        entries = [self._emit_q.popleft() for _ in range(n)]
-        # ONE batched transfer for every outstanding output (a device_get
-        # per entry would serialize a tunnel round trip each)
         t0 = time.monotonic()
-        fetched = jax.device_get([e[1] for e in entries])
+        fetched = jax.device_get([e[1] for e in batch])
         self._tmark("fetch", t0)
-        for (kind, _payload, tail), arrs in zip(entries, fetched):
-            if kind == "step":
-                self._emit_fetched(*arrs, tail)
-            elif kind == "spec":
-                token, logp, done, emitted = arrs
-                self._emit_fetched(token, logp, done, tail, emitted=emitted)
-            elif kind == "prefillb":
-                # batched admission wave: one output row per real request
-                token, logp, done = arrs
-                for j, slot_gen in enumerate(tail):
-                    self._emit_prefill(int(token[j]), float(logp[j]),
-                                       bool(done[j]), slot_gen)
-            else:
-                token, logp, done = arrs
-                self._emit_prefill(int(token), float(logp), bool(done), tail)
+        with self._fetch_cv:
+            self._fetched_q.extend(
+                (epoch, e, a) for e, a in zip(batch, fetched))
+
+    def _emit_entry(self, entry, arrs) -> None:
+        kind, _payload, tail = entry[:3]
+        if kind in ("step", "spec"):
+            for slot, gen in tail:
+                # a finalized+reused slot zeroed its counter: stale
+                # decrements for the old request must not starve the new
+                if self._slot_gen[slot] == gen:
+                    self._inflight_tok[slot] = max(
+                        0, self._inflight_tok[slot] - entry[3])
+        if kind == "step":
+            self._emit_fetched(*arrs, tail)
+        elif kind == "spec":
+            token, logp, done, emitted = arrs
+            self._emit_fetched(token, logp, done, tail, emitted=emitted)
+        elif kind == "prefillb":
+            # batched admission wave: one output row per real request
+            token, logp, done = arrs
+            for j, slot_gen in enumerate(tail):
+                self._emit_prefill(int(token[j]), float(logp[j]),
+                                   bool(done[j]), slot_gen)
+        else:
+            token, logp, done = arrs
+            self._emit_prefill(int(token), float(logp), bool(done), tail)
 
     def _emit_prefill(self, t: int, lp: float, device_done: bool,
                       tail: tuple[int, int]) -> None:
@@ -1522,21 +1706,50 @@ class CBEngine:
         if any(info is not None and self._active[i]
                and info.req.abort is not None and info.req.abort.is_set()
                for i, info in enumerate(self._slots)):
-            self._drain_emit_q()
-            changed = False
+            # emit the abort terminal FIRST and bump the slot generation so
+            # queued/in-flight results for the aborted stream are dropped at
+            # emission — the client is released after one loop iteration,
+            # not after the whole run-ahead pipeline streams out
+            aborted: list[int] = []
             for i, info in enumerate(self._slots):
                 if info is None or not self._active[i]:
                     continue
                 if info.req.abort is not None and info.req.abort.is_set():
                     self._active[i] = False
+                    self._slot_gen[i] += 1
                     self._emit_abort(info.req, emit_line=True)
-                    self._finalize(i)
-                    changed = True
-            if changed:
-                self._invalidate_dev_state()
+                    aborted.append(i)
+            if aborted:
+                # full barrier BEFORE freeing pages: in-flight dispatches
+                # still write KV through the old device page table; pages
+                # may only return to the pool once nothing references them.
+                # finally: a raising drain goes to _recover, which rebuilds
+                # the pools — the aborted slots must still be finalized or
+                # their slots+pages leak (recover's _fail_all only sweeps
+                # mirror-ACTIVE slots, and these were just marked inactive)
+                try:
+                    self._drain_emit_q()
+                finally:
+                    for i in aborted:
+                        self._finalize(i)
+                    self._invalidate_dev_state()
 
         if not self._active.any():
             self._drain_emit_q()
+            return
+        # tail cutoff: when every mirror-active slot's remaining budget is
+        # already covered by dispatches in flight for that slot, another
+        # dispatch could only compute pad rows — park on the fetcher until
+        # a result lands instead. Exact for budget-bound streams (RL
+        # rollouts with fixed max_new_tokens); stop-token finishes may
+        # still run ahead a few dispatches (the device's early-out isn't
+        # host-visible yet).
+        rem = int(np.max((self._budgets - self._n_generated
+                          - self._inflight_tok)[self._active]))
+        if rem <= 0:
+            out = self._outstanding()
+            if out:
+                self._drain_emit_q(keep=out - 1)
             return
         use_filters = bool(np.any(
             (self._top_ps[self._active] < 1.0) | (self._top_ks[self._active] > 0)))
@@ -1557,11 +1770,14 @@ class CBEngine:
             st["top_ps"], st["top_ks"], st["stop_table"])
         self._tmark("step_dispatch", t0)
         self._pools = (kp, vp)
-        self._emit_q.append(("step", (token, logp, done),
+        self._inflight_tok[self._active] += self.steps_per_dispatch
+        self._enqueue_output(("step", (token, logp, done),
                              [(int(i), int(self._slot_gen[i]))
-                              for i in np.flatnonzero(self._active)]))
-        # keep a couple of dispatches outstanding: older outputs stream out
-        # while the device computes, hiding the tunnel round trip entirely
+                              for i in np.flatnonzero(self._active)],
+                             self.steps_per_dispatch))
+        # run ahead up to pipeline_depth dispatches: older outputs stream
+        # out of the fetcher while the device computes, hiding the fetch
+        # round trips entirely
         self._drain_emit_q(keep=self.pipeline_depth)
 
     def _spec_step_once(self, use_filters: bool) -> None:
@@ -1588,9 +1804,12 @@ class CBEngine:
         self._tmark("spec_dispatch", t0)
         self._pools = (kp, vp)
         self.spec_dispatches += 1
-        self._emit_q.append(("spec", (token, logp, done, emitted),
+        # each spec round emits >=1 token per still-active slot
+        self._inflight_tok[self._active] += self.spec_rounds
+        self._enqueue_output(("spec", (token, logp, done, emitted),
                              [(int(i), int(self._slot_gen[i]))
-                              for i in np.flatnonzero(self._active)]))
+                              for i in np.flatnonzero(self._active)],
+                             self.spec_rounds))
         self._drain_emit_q(keep=self.pipeline_depth)
 
     def _finalize(self, slot: int) -> None:
@@ -1605,6 +1824,7 @@ class CBEngine:
         self._last_tokens[slot] = self.pad_token_id
         self._n_generated[slot] = 0
         self._budgets[slot] = 0
+        self._inflight_tok[slot] = 0
         if self._hist is not None:
             self._hist[slot] = None
 
@@ -1637,7 +1857,11 @@ class CBEngine:
         toks = sum(c for t, c in self._tok_window if t >= horizon)
         t_old = min((t for t, _ in self._tok_window if t >= horizon), default=now)
         dt = now - t_old
-        self.last_gen_throughput = toks / dt if dt > 0 else 0.0
+        # a burst of emissions after a pipeline stall spans ~0 s; a rate
+        # over that sliver is meaningless (and once polluted the serving
+        # bench's peak metric) — only update over a meaningful span
+        if dt >= 0.2:
+            self.last_gen_throughput = toks / dt
 
     # -- convenience (tests / bench) ----------------------------------------
 

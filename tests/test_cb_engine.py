@@ -85,10 +85,15 @@ def test_mixed_sampling_admission(tiny):
 
 
 def test_abort_mid_generation(tiny):
-    cbe = _mk_engine(tiny)
-    cbe.start()
+    # budget must exceed the default run-ahead window
+    # (pipeline_depth * steps_per_dispatch tokens) or the stream can finish
+    # entirely in flight before the abort cuts in; the abort terminal must
+    # arrive promptly even with the whole window outstanding
+    cbe = _mk_engine(tiny, max_seq_len=512, num_pages=128)
+    cbe.pipeline_depth = 16  # pin: POLYRL_CB_PIPELINE must not resize the
+    cbe.start()              # run-ahead window past the 400-token budget
     ev = threading.Event()
-    sp = SamplingParams(temperature=0.0, max_new_tokens=100)
+    sp = SamplingParams(temperature=0.0, max_new_tokens=400)
     out = cbe.submit("abort-me", [5, 6, 7], sp, abort=ev)
     from polyrl_tpu.rollout.cb_engine import STREAM_END
     # read a couple tokens, then abort
@@ -198,6 +203,10 @@ def test_slot_reuse_stale_emit_guard(tiny):
     with cbe._pool_lock:
         cbe._admit()       # prefill A queued; budget=2 -> one decode step left
         cbe._step_once()   # step1: device-side done (n_gen hits budget)
+        # simulate a stop-token-style early device finish: the device is
+        # already done but the host mirror still sees remaining budget, so
+        # the run-ahead tail cutoff does not stop the next dispatch
+        cbe._budgets[0] = 100
         cbe._step_once()   # step2: host mirror lags -> STALE dispatch for slot 0
     assert len(cbe._emit_q) == 3
 
