@@ -675,6 +675,7 @@ def forward_paged_decode(
     seq_lens: jnp.ndarray,    # [S] int32 tokens already in cache (== positions)
     attn_fn=None,
     active: jnp.ndarray | None = None,  # [S] bool — mask KV writes
+    kv_write_fn=None,  # TP override (ops.paged_attention.make_tp_paged_kv_write)
 ) -> tuple[jnp.ndarray, tuple]:
     """One decode step for every slot at once: write the new token's KV into
     each slot's current page, then paged-attend over [0, seq_len]. Returns
@@ -685,9 +686,10 @@ def forward_paged_decode(
     slot's pages return to the allocator while its device page_table row is
     still stale, so an unmasked write would corrupt whichever request
     reuses those pages (one garbage KV token per later dispatch)."""
-    from polyrl_tpu.ops.paged_attention import paged_attention
+    from polyrl_tpu.ops.paged_attention import paged_attention, paged_kv_write
 
     attn_fn = attn_fn or paged_attention
+    kv_write_fn = kv_write_fn or paged_kv_write
     s = tokens.shape[0]
     hq, hkv, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim_
     page_size = pools[0][0].shape[2]
@@ -723,8 +725,12 @@ def forward_paged_decode(
             k = rms_norm(k, lp["k_norm"], cfg.rms_norm_eps)
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
-        k_pools[l] = _scatter_token_kv(k_pools[l], write_page, write_off, k[:, 0])
-        v_pools[l] = _scatter_token_kv(v_pools[l], write_page, write_off, v[:, 0])
+        # fused K+V Pallas write on TPU (XLA row-scatter elsewhere): the
+        # scatter lowers to a serialized per-row loop on TPU and was the
+        # dominant cost of the whole decode step (2 x n_layers x k fused
+        # steps of S*Hkv-row scatters per dispatch)
+        k_pools[l], v_pools[l] = kv_write_fn(
+            k_pools[l], v_pools[l], write_page, write_off, k[:, 0], v[:, 0])
         attn_out = attn_fn(q[:, 0], k_pools[l], v_pools[l], page_table,
                            attn_lens)  # [S, Hq, D]
         x = x + mm(attn_out.reshape(s, hq * hd), lp["wo"])

@@ -203,6 +203,100 @@ def paged_attention_lib(q, k_pool, v_pool, page_table, seq_lens, scale=None):
         pages_per_compute_block=ppcb)
 
 
+def _kv_write_kernel(page_ref, off_ref, kpool_ref, vpool_ref, kupd_ref,
+                     vupd_ref, kout_ref, vout_ref):
+    # the (page, off) target block arrives via the index maps; the body
+    # only copies one token's [Hkv, D] K and V rows into it
+    del page_ref, off_ref, kpool_ref, vpool_ref
+    kout_ref[:, 0, 0, :] = kupd_ref[0].astype(kout_ref.dtype)
+    vout_ref[:, 0, 0, :] = vupd_ref[0].astype(vout_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def paged_kv_write_pallas(k_pool, v_pool, write_page, write_off, k_upd,
+                          v_upd, interpret: bool = False):
+    """Write one token's K/V per slot into the paged pools, in place.
+
+    The XLA alternative (row scatter over [Hkv*N*ps, D], one row per
+    slot*head) lowers to a serialized per-row loop on TPU — measured as
+    the dominant cost of the CB decode step (2 pools x 28 layers x k fused
+    steps of ~500-row scatters per dispatch). Here the write is a Pallas
+    grid over slots: the scalar-prefetched (page, off) pair drives the
+    OUTPUT BlockSpec index map, so each grid step DMAs exactly one
+    [Hkv, 1, 1, D] block — the paged-pool analogue of the bucketed
+    engine's dynamic-update-slice, and the same shape every TPU serving
+    stack uses for its KV-cache update kernel. K and V are fused into one
+    call to halve grid overhead. ``input_output_aliases`` keeps the pools
+    in place (no copy); inactive slots are pre-routed to null page 0 by
+    the caller, so revisiting that block is benign (last write wins in the
+    sequential grid)."""
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    s = write_page.shape[0]
+    hkv, _n, _ps, d = k_pool.shape
+
+    pool_spec = pl.BlockSpec(
+        (hkv, 1, 1, d), lambda si, pg, of: (0, pg[si], of[si], 0))
+    # the aliased pool INPUTS are never read in the body: keep them in HBM
+    # (a blocked spec would DMA one unread [Hkv,1,1,D] block per pool per
+    # grid step — doubling the kernel's traffic)
+    pool_in_spec = pl.BlockSpec(memory_space=pltpu.MemorySpace.HBM)
+    upd_spec = pl.BlockSpec((1, hkv, d), lambda si, pg, of: (si, 0, 0))
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(s,),
+        in_specs=[pool_in_spec, pool_in_spec, upd_spec, upd_spec],
+        out_specs=[pool_spec, pool_spec],
+    )
+    return pl.pallas_call(
+        _kv_write_kernel,
+        out_shape=[jax.ShapeDtypeStruct(k_pool.shape, k_pool.dtype),
+                   jax.ShapeDtypeStruct(v_pool.shape, v_pool.dtype)],
+        grid_spec=grid_spec,
+        # operand indices count the scalar-prefetch args: 0=page 1=off
+        # 2=k_pool 3=v_pool (aliased onto outputs 0/1) 4=k_upd 5=v_upd
+        input_output_aliases={2: 0, 3: 1},
+        interpret=interpret,
+    )(write_page.astype(jnp.int32), write_off.astype(jnp.int32),
+      k_pool, v_pool, k_upd, v_upd)
+
+
+def paged_kv_write(k_pool, v_pool, write_page, write_off, k_upd, v_upd):
+    """Dispatch: Pallas write kernel on TPU, XLA row scatter elsewhere.
+    Override with POLYRL_KV_WRITE=scatter|pallas."""
+    impl = os.environ.get("POLYRL_KV_WRITE", "")
+    if impl != "scatter" and (impl == "pallas"
+                              or jax.default_backend() == "tpu"):
+        return paged_kv_write_pallas(
+            k_pool, v_pool, write_page, write_off, k_upd, v_upd,
+            interpret=jax.default_backend() != "tpu")
+    from polyrl_tpu.models.decoder import _scatter_token_kv
+
+    return (_scatter_token_kv(k_pool, write_page, write_off, k_upd),
+            _scatter_token_kv(v_pool, write_page, write_off, v_upd))
+
+
+def make_tp_paged_kv_write(mesh):
+    """Tensor-parallel wrapper for the paged K/V write: pools and updates
+    shard over tp on the KV-head dim (same split as the attention wrapper;
+    GSPMD cannot partition the Pallas custom call, and an unsharded write
+    would all-gather both pools per layer per step)."""
+    from jax.sharding import PartitionSpec as P
+
+    from polyrl_tpu.parallel.mesh import TP
+
+    def inner(k_pool, v_pool, page, off, k_upd, v_upd):
+        return paged_kv_write(k_pool, v_pool, page, off, k_upd, v_upd)
+
+    return jax.shard_map(
+        inner, mesh=mesh,
+        in_specs=(P(TP, None, None, None), P(TP, None, None, None),
+                  P(), P(), P(None, TP, None), P(None, TP, None)),
+        out_specs=(P(TP, None, None, None), P(TP, None, None, None)),
+        check_vma=False)
+
+
 def make_tp_paged_attention(mesh):
     """Tensor-parallel wrapper: paged attention sharded over the tp axis on
     the HEAD dim (q [S, Hq, D] and both pools [Hkv, N, ps, D] split by tp;

@@ -401,6 +401,7 @@ class CBEngine:
         if key not in self._step_fns:
             cfg, pad = self.cfg, self.pad_token_id
             paged_attn = self._tp_paged_attn()
+            kv_write = self._tp_kv_write()
 
             def step(params, kp, vp, rng, page_table, seq_lens, last_tokens,
                      n_generated, budgets, active, temps, top_ps, top_ks,
@@ -410,7 +411,7 @@ class CBEngine:
                     logits, (kp, vp) = decoder.forward_paged_decode(
                         params, cfg, last_tokens, seq_lens, (kp, vp),
                         page_table, seq_lens, active=active,
-                        attn_fn=paged_attn)
+                        attn_fn=paged_attn, kv_write_fn=kv_write)
                     rng, sub = jax.random.split(rng)
                     token, logp = sample_token_vec(
                         logits, sub, temps, top_ps, top_ks,
@@ -456,6 +457,7 @@ class CBEngine:
         if key not in self._step_fns:
             cfg, pad = self.cfg, self.pad_token_id
             paged_attn = self._tp_paged_attn()
+            kv_write = self._tp_kv_write()
             page_size = self.page_size
 
             def spec(params, kp, vp, rng, tok_buf, page_table, seq_lens,
@@ -489,7 +491,7 @@ class CBEngine:
                         params, cfg, tokens_in.reshape(s * m),
                         pos.reshape(s * m), (kp, vp), pt_rep,
                         pos.reshape(s * m), active=okf.reshape(s * m),
-                        attn_fn=paged_attn)
+                        attn_fn=paged_attn, kv_write_fn=kv_write)
                     logits = logits.reshape(s, m, -1)
                     rng, sub = jax.random.split(rng)
                     toks, logps, n_acc = spec_verify_sample_vec(
@@ -554,6 +556,15 @@ class CBEngine:
         from polyrl_tpu.ops.paged_attention import make_tp_paged_attention
 
         return make_tp_paged_attention(self.mesh)
+
+    def _tp_kv_write(self):
+        """Same constraint as _tp_paged_attn for the Pallas K/V write
+        kernel; None under no mesh -> forward_paged_decode's default."""
+        if self.mesh is None or self.mesh.shape.get("tp", 1) <= 1:
+            return None
+        from polyrl_tpu.ops.paged_attention import make_tp_paged_kv_write
+
+        return make_tp_paged_kv_write(self.mesh)
 
     def _insert_slot_state(self, st: dict, slot, prompt_len, token, done,
                            budget, temp, top_p, top_k, stop_row, row):

@@ -83,3 +83,63 @@ def test_bf16_pools():
     out32 = paged_attention_ref(q, kp, vp, table, lens)
     np.testing.assert_allclose(np.asarray(out16, np.float32), np.asarray(out32),
                                rtol=0.1, atol=0.1)
+
+
+@pytest.mark.parametrize("dtype", [np.float32, jnp.bfloat16])
+def test_kv_write_pallas_matches_scatter(dtype):
+    """The fused K+V Pallas write (interpret mode here; the TPU decode hot
+    path) must be element-exact vs the XLA row-scatter oracle, including
+    multiple inactive slots all routed to the null page 0."""
+    from polyrl_tpu.models.decoder import _scatter_token_kv
+    from polyrl_tpu.ops.paged_attention import paged_kv_write_pallas
+
+    rng = np.random.default_rng(7)
+    hkv, n_pool, d, s = 2, 16, 32, 5
+    k_pool = jnp.asarray(rng.standard_normal((hkv, n_pool, PAGE, d)), dtype)
+    v_pool = jnp.asarray(rng.standard_normal((hkv, n_pool, PAGE, d)), dtype)
+    k_upd = jnp.asarray(rng.standard_normal((s, hkv, d)), dtype)
+    v_upd = jnp.asarray(rng.standard_normal((s, hkv, d)), dtype)
+    # slots 3+4 inactive -> caller routes both to (page 0, off 0)
+    page = jnp.asarray([3, 9, 3, 0, 0], jnp.int32)
+    off = jnp.asarray([0, 7, 5, 0, 0], jnp.int32)
+
+    ko, vo = paged_kv_write_pallas(k_pool, v_pool, page, off, k_upd, v_upd,
+                                   interpret=True)
+    k_ref = _scatter_token_kv(k_pool, page, off, k_upd)
+    v_ref = _scatter_token_kv(v_pool, page, off, v_upd)
+    np.testing.assert_array_equal(np.asarray(ko, np.float32),
+                                  np.asarray(k_ref, np.float32))
+    np.testing.assert_array_equal(np.asarray(vo, np.float32),
+                                  np.asarray(v_ref, np.float32))
+
+
+def test_kv_write_tp_shard_map_matches_scatter():
+    """TP wrapper: pools + updates sharded over tp on the KV-head dim must
+    produce the identical pool contents (CPU mesh, scatter impl inside the
+    shard_map via POLYRL_KV_WRITE passthrough default on cpu)."""
+    from jax.sharding import Mesh
+
+    from polyrl_tpu.models.decoder import _scatter_token_kv
+    from polyrl_tpu.ops.paged_attention import make_tp_paged_kv_write
+
+    rng = np.random.default_rng(11)
+    hkv, n_pool, d, s = 4, 8, 16, 3
+    k_pool = jnp.asarray(rng.standard_normal((hkv, n_pool, PAGE, d)),
+                         jnp.float32)
+    v_pool = jnp.asarray(rng.standard_normal((hkv, n_pool, PAGE, d)),
+                         jnp.float32)
+    k_upd = jnp.asarray(rng.standard_normal((s, hkv, d)), jnp.float32)
+    v_upd = jnp.asarray(rng.standard_normal((s, hkv, d)), jnp.float32)
+    page = jnp.asarray([2, 5, 0], jnp.int32)
+    off = jnp.asarray([1, 7, 0], jnp.int32)
+
+    mesh = Mesh(np.array(jax.devices()[:2]).reshape(1, 1, 2),
+                ("dp", "fsdp", "tp"))
+    fn = make_tp_paged_kv_write(mesh)
+    ko, vo = jax.jit(fn)(k_pool, v_pool, page, off, k_upd, v_upd)
+    np.testing.assert_allclose(
+        np.asarray(ko), np.asarray(_scatter_token_kv(k_pool, page, off,
+                                                     k_upd)), atol=0)
+    np.testing.assert_allclose(
+        np.asarray(vo), np.asarray(_scatter_token_kv(v_pool, page, off,
+                                                     v_upd)), atol=0)
