@@ -87,6 +87,10 @@ class RolloutServer:
         self.weight_template = None
         self.weight_preprocess = None
         self.weight_apply = None
+        # a streamed round's clock starts BEFORE the trainer's pack, so the
+        # receive wait gets the combined pack+wire budget (matches the
+        # sender's stream_push_timeout_s)
+        self.weight_sync_timeout_s = 3600.0
         self._weight_lock = threading.Lock()
         self._loop_thread: threading.Thread | None = None
 
@@ -427,12 +431,34 @@ class RolloutServer:
             self.engine.weight_version = version
             return True, ""
         try:
-            from polyrl_tpu.transfer.layout import unflatten_like, unpack_params
+            from polyrl_tpu.transfer.layout import (
+                make_incremental_installer, unflatten_like, unpack_params,
+            )
 
-            self.receiver.wait_for_version(version, timeout=600.0)
-            named = unpack_params(self.receiver.buffer, self.receiver.layout)
             template = (self.weight_template if self.weight_template
                         is not None else self.engine.params)
+            if self.weight_apply is None and self.weight_preprocess is None:
+                # full-tree bf16 path: upload each tensor AS ITS BYTES LAND
+                # (wire || device_put — the receive-side half of the
+                # streaming sync pipeline). Delta/int8 installs transform
+                # the assembled tree, so they keep the post-wire path.
+                # dtype/sharding come from the LIVE tree (template may be
+                # abstract ShapeDtypeStructs), matching the serial path's
+                # tree_map over engine.params
+                install, device_named = make_incremental_installer(
+                    self.engine.params)
+                self.receiver.wait_for_version(
+                    version, timeout=self.weight_sync_timeout_s,
+                    on_tensor=install)
+                new_params = unflatten_like(template, device_named)
+                with self._weight_lock:  # not mid-batch
+                    self.engine.params = new_params
+                    self.engine.weight_version = version
+                    self._flush_engine_prefix_cache()
+                return True, ""
+            self.receiver.wait_for_version(
+                version, timeout=self.weight_sync_timeout_s)
+            named = unpack_params(self.receiver.buffer, self.receiver.layout)
             new_params = unflatten_like(template, named)
             if self.weight_apply is not None:
                 # delta sync: the received tree is NOT full params (e.g.

@@ -88,6 +88,7 @@ class ReceiverAgent:
         self.advertise_host = advertise_host or "127.0.0.1"
         self.version = -1
         self.error: str | None = None
+        self._armed_version = -1  # version of the round currently landing
         self._version_cv = threading.Condition()
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
@@ -116,6 +117,9 @@ class ReceiverAgent:
                         if msg is None:
                             continue
                         if msg.get("event") == "prepare":
+                            with self._version_cv:
+                                self._armed_version = int(
+                                    msg.get("version", -1))
                             self.sockets.arm(int(msg["round"]))
                             _send_json(s, {"event": "ready",
                                            "instance": self.instance_endpoint})
@@ -141,10 +145,41 @@ class ReceiverAgent:
                 time.sleep(backoff)
                 backoff = min(backoff * 2, 5.0)
 
-    def wait_for_version(self, version: int, timeout: float = 600.0) -> None:
+    def wait_for_version(self, version: int, timeout: float = 600.0,
+                         on_tensor=None) -> None:
         """Block until weights of at least ``version`` are in the buffer
-        (the reference's 'receive_weights' wait, receiver_agent.py:257-268)."""
+        (the reference's 'receive_weights' wait, receiver_agent.py:257-268).
+
+        ``on_tensor(entry, np_view)``: incremental install hook — invoked
+        IN LAYOUT ORDER for each tensor whose bytes have fully landed,
+        while later tensors are still on the wire (overlaps the wire with
+        the device upload; reference overlap: sender_agent.py:567-647).
+        Landed bytes are final (streams send monotonically from a stable
+        snapshot), so a completed tensor never changes within a round. If
+        a retry/newer round supersedes the one being tailed, every tensor
+        is re-emitted from the final buffer — the consumer must treat
+        emissions as idempotent upserts by name."""
         deadline = time.monotonic() + timeout
+        emitted = 0
+        tail_round = None
+        from .layout import covered_entries
+
+        def emit_landed() -> None:
+            nonlocal emitted, tail_round
+            if on_tensor is None:
+                return
+            with self._version_cv:
+                armed = self._armed_version
+            if armed != version:
+                return
+            rnd = self.sockets._round
+            if rnd != tail_round:
+                tail_round, emitted = rnd, 0  # retry round: start over
+            for e in covered_entries(self.layout, self.sockets.coverage(),
+                                     emitted):
+                on_tensor(e, self.buffer[e.offset : e.offset + e.nbytes])
+                emitted += 1
+
         with self._version_cv:
             while self.version < version:
                 if self.error is not None:
@@ -154,7 +189,23 @@ class ReceiverAgent:
                 if left <= 0:
                     raise TimeoutError(
                         f"weights v{version} not received (have v{self.version})")
-                self._version_cv.wait(min(left, 1.0))
+                if on_tensor is not None:
+                    self._version_cv.release()
+                    try:
+                        emit_landed()
+                    finally:
+                        self._version_cv.acquire()
+                    self._version_cv.wait(min(left, 0.05))
+                else:
+                    self._version_cv.wait(min(left, 1.0))
+            final = self.version
+        if on_tensor is not None:
+            # completion: emit the tail; if a newer version landed than the
+            # round we tailed (or we tailed nothing), re-emit everything
+            if final != version or tail_round is None:
+                emitted = 0
+            for e in self.layout.entries[emitted:]:
+                on_tensor(e, self.buffer[e.offset : e.offset + e.nbytes])
 
     def stop(self) -> None:
         self._stop.set()
@@ -210,6 +261,12 @@ class SenderAgent:
         self._cv = threading.Condition()
         self._inflight = 0
         self._packing = False
+        self._watermark = None  # streaming push: gates sends behind the pack
+        self._poisoned_version = -1  # streamed pack died: never push this
+        # serial rounds start the clock after the pack; a streamed round's
+        # wire trails the pack, so it gets the combined budget
+        self.push_timeout_s = 600.0
+        self.stream_push_timeout_s = 3600.0
         self._round_counter = 0  # unique per push attempt (stale-stream guard)
         self._server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -247,9 +304,36 @@ class SenderAgent:
             while self._inflight > 0:
                 self._cv.wait()
             self.version = version if version is not None else self.version + 1
+            self._watermark = None
             v = self.version
         self._cmds.put("update_weights")
         return v
+
+    def signal_update_streaming(self, watermark,
+                                version: int | None = None) -> int:
+        """Streaming push: announce the version BEFORE packing; sends are
+        gated behind ``watermark`` while the caller packs in place into
+        ``self.buffer`` (the watermark orders buffer access: senders read
+        only below it, the packer writes only above it). The reference's
+        in-round sender pipeline (sender_agent.py:567-647)."""
+        with self._cv:
+            while self._inflight > 0:
+                self._cv.wait()
+            self.version = version if version is not None else self.version + 1
+            self._watermark = watermark
+            v = self.version
+        self._cmds.put("update_weights")
+        return v
+
+    def mark_push_failed(self, version: int) -> None:
+        """A streamed pack died mid-round: the buffer holds garbage for
+        ``version``. Poison it so the poll loop stops re-pushing it every
+        ``poll_s`` (each retry would fail at the watermark and spam the
+        manager with aborts); the next successful signal/swap resumes."""
+        with self._cv:
+            self._poisoned_version = version
+        log.error("weight push v%d poisoned (pack failed); waiting for a "
+                  "new update", version)
 
     def swap_buffer(self, new_buffer: np.ndarray, version: int) -> np.ndarray:
         """Atomically install a freshly packed buffer; returns the old one
@@ -259,6 +343,7 @@ class SenderAgent:
                 self._cv.wait()
             old, self.buffer = self.buffer, new_buffer
             self.version = version
+            self._watermark = None
         self._cmds.put("update_weights")
         return old
 
@@ -372,13 +457,17 @@ class SenderAgent:
                 self._cv.wait()
             version = self.version
             buffer = self.buffer
+            watermark = self._watermark
+            if version == self._poisoned_version:
+                return  # failed streamed pack: nothing valid to push
             self._inflight += 1
         try:
             stale = self._stale_instances(version)
             if not stale:
                 return
-            threads = [threading.Thread(target=self._push_instance,
-                                        args=(i, version, buffer), daemon=True)
+            threads = [threading.Thread(
+                           target=self._push_instance,
+                           args=(i, version, buffer, watermark), daemon=True)
                        for i in stale]
             for t in threads:
                 t.start()
@@ -402,16 +491,16 @@ class SenderAgent:
                 pass
 
     def _push_instance(self, instance: str, version: int,
-                       buffer: np.ndarray) -> None:
+                       buffer: np.ndarray, watermark=None) -> None:
         reg = self._wait_registration(instance)
         if reg is None:
             log.error("no receiver registration for %s; skipping push", instance)
             self._abort_on_manager(instance)
             return
-        self._push_one(reg, version, buffer)
+        self._push_one(reg, version, buffer, watermark)
 
     def _push_one(self, reg: _Registration, version: int,
-                  buffer: np.ndarray) -> None:
+                  buffer: np.ndarray, watermark=None) -> None:
         with self._cv:
             self._round_counter += 1
             round_id = self._round_counter
@@ -424,8 +513,10 @@ class SenderAgent:
                     raise TimeoutError("receiver did not arm listeners")
                 t0 = time.monotonic()
                 batch = self.engine.transfer_submit_write(
-                    reg.host, reg.ports, buffer, round_id=round_id)
-                batch.result(timeout=600.0)
+                    reg.host, reg.ports, buffer, round_id=round_id,
+                    watermark=watermark)
+                batch.result(timeout=self.push_timeout_s if watermark is None
+                             else self.stream_push_timeout_s)
                 dt = time.monotonic() - t0
                 _send_json(reg.sock, {"event": "transfer_done",
                                       "status": "success", "version": version})
@@ -492,6 +583,10 @@ class SenderGroup:
     def buffer(self) -> np.ndarray:
         return self.senders[0].buffer
 
+    def mark_push_failed(self, version: int) -> None:
+        for s in self.senders:
+            s.mark_push_failed(version)
+
     def start(self) -> None:
         for s in self.senders:
             s.start()
@@ -505,6 +600,16 @@ class SenderGroup:
         for s in self.senders[1:]:
             s.signal_update(v)
         return v
+
+    def mark_push_failed(self, version: int) -> None:
+        """A streamed pack died mid-round: the buffer holds garbage for
+        ``version``. Poison it so the poll loop stops re-pushing it every
+        ``poll_s`` (each retry would fail at the watermark and spam the
+        manager with aborts); the next successful signal/swap resumes."""
+        with self._cv:
+            self._poisoned_version = version
+        log.error("weight push v%d poisoned (pack failed); waiting for a "
+                  "new update", version)
 
     def swap_buffer(self, new_buffer: np.ndarray, version: int) -> np.ndarray:
         old = self.senders[0].swap_buffer(new_buffer, version)

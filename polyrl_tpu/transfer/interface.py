@@ -35,9 +35,10 @@ class TransferInterface:
                  sender_groups: int = 1, sender_nic_cidr: str = "",
                  groups_per_sender: int = 1):
         self.layout: ParamLayout = build_layout(params_template)
-        # double buffer: pack into _back while the sender pushes from its
-        # front buffer; only the pointer swap synchronizes
-        self._back = alloc_buffer(self.layout)
+        # serial mode double-buffers: pack into _back while the sender
+        # pushes from its front buffer (lazy — the default streamed mode
+        # packs in place and never needs the second copy of the weights)
+        self._back: np.ndarray | None = None
         front = alloc_buffer(self.layout)
         if sender_groups > 1:
             # multi-NIC fan-out: one sender agent per interface (CIDR-picked
@@ -62,26 +63,58 @@ class TransferInterface:
             manager_client.update_weight_senders(
                 endpoints, groups_per_sender=groups_per_sender)
 
-    def update_weights_with_agent(self, params: Any) -> int:
-        """Push new weights: pack (overlapped) -> version bump -> swap.
+    def update_weights_with_agent(self, params: Any,
+                                  streaming: bool = True) -> int:
+        """Push new weights. Two modes:
 
-        The pack lands in the back buffer and overlaps any in-flight push
-        round; the manager version bump drains the active pool
-        (fsdp_interface.py:80-95); the atomic swap installs the new
-        (buffer, version) pair — the sender's poll loop snapshots both
-        together, and the manager only re-activates instances that reach the
-        CURRENT version, so a racing old-version push can never leave an
-        instance serving stale weights.
+        - ``streaming`` (default): version bump FIRST, then pack in place
+          while sender streams trail the pack watermark — pack, wire, and
+          (with a receiver-side ``on_tensor`` installer) the device upload
+          all overlap inside the one round. This is what the <5 s
+          trainer->rollout sync latency KPI measures (reference in-round
+          pipeline: sender_agent.py:567-647).
+        - serial: pack into the back buffer (overlapping any in-flight
+          PREVIOUS round), then swap. Kept for multi-NIC sender groups
+          (each group streams a different NIC; one shared watermark would
+          serialize them on the slowest pack reader).
+
+        Either way the manager version bump drains the active pool
+        (fsdp_interface.py:80-95) and only re-activates instances that
+        reach the CURRENT version, so a racing old-version push can never
+        leave an instance serving stale weights.
         """
         t0 = time.monotonic()
-        pack_params(params, self.layout, self._back)
-        if self.manager is not None:
-            version = self.manager.update_weight_version()
+        if streaming and isinstance(self.sender, SenderAgent):
+            from .layout import pack_params_streaming
+            from .tcp_engine import Watermark
+
+            if self.manager is not None:
+                version = self.manager.update_weight_version()
+            else:
+                version = self.sender.version + 1
+            wm = Watermark(self.layout.total_bytes)
+            # waits for in-flight rounds, then arms (buffer, version, wm)
+            self.sender.signal_update_streaming(wm, version)
+            try:
+                pack_params_streaming(params, self.layout,
+                                      self.sender.buffer, wm.advance)
+            except BaseException as exc:
+                wm.fail(str(exc))  # unblock gated streams -> round fails
+                # and stop the poll loop from re-pushing the garbage round
+                self.sender.mark_push_failed(version)
+                raise
+            wm.finish()
         else:
-            version = self.sender.version + 1
-        self._back = self.sender.swap_buffer(self._back, version)
+            if self._back is None:
+                self._back = alloc_buffer(self.layout)
+            pack_params(params, self.layout, self._back)
+            if self.manager is not None:
+                version = self.manager.update_weight_version()
+            else:
+                version = self.sender.version + 1
+            self._back = self.sender.swap_buffer(self._back, version)
         log.info("packed weights v%d (%.0f MB) in %.2fs", version,
-                 self._back.nbytes / 1e6, time.monotonic() - t0)
+                 self.layout.total_bytes / 1e6, time.monotonic() - t0)
         return version
 
     def close(self) -> None:

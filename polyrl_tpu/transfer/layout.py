@@ -116,6 +116,100 @@ def pack_params(params: Any, layout: ParamLayout, buffer: np.ndarray) -> None:
         view[:] = arr.reshape(-1)
 
 
+def pack_params_streaming(params: Any, layout: ParamLayout,
+                          buffer: np.ndarray, progress,
+                          group_bytes: int = 64 << 20) -> None:
+    """Pack in layout order, advancing ``progress(high_water_byte)`` after
+    each ~``group_bytes`` group so sender streams can trail the packer
+    (one push round overlaps pack and wire; pack_params gates the whole
+    wire on the full device->host gather instead).
+
+    ``copy_to_host_async`` is issued for every leaf up front, so the
+    per-group ``device_get`` drains transfers that are already in flight —
+    the D2H path stays bandwidth-bound, not round-trip-bound."""
+    leaves = jax.tree_util.tree_flatten_with_path(params)[0]
+    by_name = {_path_str(p): leaf for p, leaf in leaves}
+    for leaf in by_name.values():
+        copy_async = getattr(leaf, "copy_to_host_async", None)
+        if copy_async is not None:
+            copy_async()
+    group: list[Entry] = []
+    size = 0
+
+    def flush() -> None:
+        nonlocal group, size
+        if not group:
+            return
+        host = jax.device_get([by_name[e.name] for e in group])
+        for e, arr in zip(group, host):
+            view = buffer[e.offset : e.offset + e.nbytes].view(
+                _np_dtype(e.dtype))
+            view[:] = np.asarray(arr).reshape(-1)
+        progress(group[-1].offset + group[-1].nbytes)
+        group, size = [], 0
+
+    for e in layout.entries:
+        group.append(e)
+        size += e.nbytes
+        if size >= group_bytes:
+            flush()
+    flush()
+    progress(layout.total_bytes)
+
+
+def covered_entries(layout: ParamLayout, coverage, start_idx: int = 0):
+    """Entries from ``start_idx`` whose bytes are fully landed, given
+    receive-side ``coverage`` = sorted (range_offset, bytes_landed) pairs
+    (ReceiverSockets.coverage()). Stops at the first incomplete entry so
+    callers emit tensors strictly in layout order."""
+    # landed prefixes of contiguous stream ranges: merge adjacent so an
+    # entry spanning a range boundary is recognised once both sides land
+    merged: list[list[int]] = []
+    for off, got in coverage:
+        if got <= 0:
+            continue
+        if merged and off <= merged[-1][1]:
+            merged[-1][1] = max(merged[-1][1], off + got)
+        else:
+            merged.append([off, off + got])
+    out = []
+    i = 0
+    for e in layout.entries[start_idx:]:
+        lo, hi = e.offset, e.offset + e.nbytes
+        while i < len(merged) and merged[i][1] <= lo:
+            i += 1
+        if i < len(merged) and merged[i][0] <= lo and hi <= merged[i][1]:
+            out.append(e)
+        else:
+            break
+    return out
+
+
+def make_incremental_installer(template: Any):
+    """Build (install_fn, device_named) for a streaming weight install:
+    ``install_fn(entry, raw_bytes)`` device_puts one landed tensor with the
+    template leaf's dtype — and its sharding when the leaf is a committed
+    device array. ONE implementation shared by the rollout server's
+    update_weights_from_agent and bench_weight_sync, so the bench measures
+    exactly the production install path."""
+    tmpl = {_path_str(p): leaf for p, leaf in
+            jax.tree_util.tree_flatten_with_path(template)[0]}
+    device_named: dict[str, Any] = {}
+
+    def install(entry: Entry, raw) -> None:
+        old = tmpl[entry.name]
+        host = np.asarray(raw).view(_np_dtype(entry.dtype)).reshape(
+            entry.shape)
+        sharding = getattr(old, "sharding", None)
+        if sharding is not None:
+            device_named[entry.name] = jax.device_put(
+                host.astype(old.dtype), sharding)
+        else:
+            device_named[entry.name] = jax.device_put(host.astype(old.dtype))
+
+    return install, device_named
+
+
 def unpack_params(buffer: np.ndarray, layout: ParamLayout) -> dict[str, np.ndarray]:
     """Zero-copy views into the buffer, name -> ndarray."""
     out = {}

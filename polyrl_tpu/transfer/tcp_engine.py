@@ -13,6 +13,7 @@ from __future__ import annotations
 import socket
 import struct
 import threading
+import time
 from concurrent.futures import Future, ThreadPoolExecutor
 from dataclasses import dataclass, field
 
@@ -28,6 +29,53 @@ def _tune(sock: socket.socket) -> None:
         sock.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF, SOCK_BUF)
     except OSError:
         pass
+
+
+class Watermark:
+    """Progress gate for streaming a buffer that is still being packed.
+
+    The packer advances the high-water mark as bytes [0, value) become
+    valid; sender streams block before sending past it. This is what
+    overlaps pack -> wire -> install inside ONE push round (the reference's
+    sender pipeline, sender_agent.py:567-647) — the double-buffer only
+    overlaps a pack with the PREVIOUS round."""
+
+    def __init__(self, total: int):
+        self.total = int(total)
+        self._value = 0
+        self._failed: str | None = None
+        self._cv = threading.Condition()
+
+    @property
+    def value(self) -> int:
+        with self._cv:
+            return self._value
+
+    def advance(self, new_value: int) -> None:
+        with self._cv:
+            if new_value > self._value:
+                self._value = new_value
+                self._cv.notify_all()
+
+    def finish(self) -> None:
+        self.advance(self.total)
+
+    def fail(self, msg: str) -> None:
+        with self._cv:
+            self._failed = msg or "pack failed"
+            self._cv.notify_all()
+
+    def wait_until(self, target: int, timeout: float = 600.0) -> None:
+        deadline = time.monotonic() + timeout
+        with self._cv:
+            while self._value < target and self._failed is None:
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    raise TimeoutError(
+                        f"watermark stalled at {self._value}/{target}")
+                self._cv.wait(min(left, 1.0))
+            if self._failed is not None:
+                raise ConnectionError(f"streamed pack failed: {self._failed}")
 
 
 def split_ranges(total: int, n: int) -> list[tuple[int, int]]:
@@ -60,6 +108,7 @@ class ReceiverSockets:
         self._completed = 0
         self._expected: int | None = None
         self._round = -1
+        self._progress: dict[int, int] = {}  # range offset -> bytes landed
         self._lock = threading.Lock()
         self._closed = False
         self.ports: list[int] = []
@@ -84,6 +133,7 @@ class ReceiverSockets:
             self._round = round_id
             self._completed = 0
             self._expected: int | None = None
+            self._progress = {}
             self._errors.clear()
             self._done.clear()
 
@@ -115,6 +165,9 @@ class ReceiverSockets:
                         if n == 0:
                             raise ConnectionError(f"eof at {got}/{length}")
                         got += n
+                        with self._lock:
+                            if round_id == self._round:
+                                self._progress[offset] = got
                     with self._lock:
                         if round_id != self._round:
                             continue
@@ -129,6 +182,12 @@ class ReceiverSockets:
                     if round_id == self._round:
                         self._errors.append(str(exc))
                         self._done.set()
+
+    def coverage(self) -> list[tuple[int, int]]:
+        """Snapshot of (range_offset, bytes_landed) for the armed round —
+        the receive-side watermark an incremental installer polls."""
+        with self._lock:
+            return sorted(self._progress.items())
 
     def wait(self, timeout: float | None = None) -> None:
         if not self._done.wait(timeout):
@@ -174,8 +233,12 @@ class TcpTransferEngine:
 
     def _send_range(self, host: str, port: int, mv: memoryview,
                     round_id: int, offset: int, length: int,
-                    nstreams: int) -> None:
+                    nstreams: int, watermark: "Watermark | None" = None) -> None:
         src = (self.bind_host, 0) if self.bind_host else None
+        # smaller chunks under a watermark: the gate advances per packed
+        # tensor group, and a 64 MB chunk would add that much latency to
+        # every gate crossing
+        chunk = SEND_CHUNK if watermark is None else SOCK_BUF
         with socket.create_connection((host, port), timeout=60.0,
                                       source_address=src) as s:
             _tune(s)
@@ -183,19 +246,26 @@ class TcpTransferEngine:
             end = offset + length
             pos = offset
             while pos < end:
-                s.sendall(mv[pos : min(pos + SEND_CHUNK, end)])
-                pos = min(pos + SEND_CHUNK, end)
+                nxt = min(pos + chunk, end)
+                if watermark is not None:
+                    watermark.wait_until(nxt)
+                s.sendall(mv[pos:nxt])
+                pos = nxt
 
     def transfer_submit_write(self, host: str, ports: list[int], buffer,
-                              round_id: int = 0) -> TransferBatch:
-        """Split ``buffer`` across ``ports`` and send concurrently."""
+                              round_id: int = 0,
+                              watermark: "Watermark | None" = None,
+                              ) -> TransferBatch:
+        """Split ``buffer`` across ``ports`` and send concurrently; with a
+        ``watermark`` each stream trails the packer instead of requiring a
+        fully packed buffer."""
         mv = memoryview(buffer).cast("B")
         ranges = split_ranges(len(mv), len(ports))
         batch = TransferBatch()
         for (off, ln), port in zip(ranges, ports):
             batch.futures.append(self._pool.submit(
                 self._send_range, host, port, mv, round_id, off, ln,
-                len(ranges)))
+                len(ranges), watermark))
         return batch
 
     def shutdown(self) -> None:

@@ -335,3 +335,126 @@ def test_transfer_interface_sender_groups_with_manager(manager):
         assert st is not None  # manager accepted the PUT (no exception)
     finally:
         iface.close()
+
+
+# -- streaming (in-round pack || wire || install overlap) --------------------
+
+
+def test_covered_entries_prefix_logic():
+    from polyrl_tpu.transfer.layout import covered_entries
+
+    params = small_params(0)
+    layout = build_layout(params)
+    total = layout.total_bytes
+    # nothing landed
+    assert covered_entries(layout, []) == []
+    # everything landed in one range
+    assert [e.name for e in covered_entries(layout, [(0, total)])] == [
+        e.name for e in layout.entries]
+    # partial prefix: only entries fully under the watermark (order kept)
+    second = layout.entries[1]
+    cov = [(0, second.offset + second.nbytes - 1)]  # 1 byte short
+    names = [e.name for e in covered_entries(layout, cov)]
+    assert names == [layout.entries[0].name]
+    # spanning a stream-range boundary: both halves must land
+    mid = layout.entries[2].offset + 3
+    assert [e.name for e in covered_entries(
+        layout, [(0, mid), (mid, 0)])][:2] == [
+        layout.entries[0].name, layout.entries[1].name]
+    full = [(0, mid), (mid, total - mid)]
+    assert len(covered_entries(layout, full)) == len(layout.entries)
+    # start_idx resumes after already-emitted entries
+    assert covered_entries(layout, full, start_idx=2) == list(
+        layout.entries[2:])
+
+
+def test_pack_params_streaming_matches_pack():
+    from polyrl_tpu.transfer.layout import pack_params_streaming
+
+    params = small_params(3)
+    layout = build_layout(params)
+    ref = alloc_buffer(layout)
+    pack_params(params, layout, ref)
+    buf = alloc_buffer(layout)
+    marks = []
+    # tiny group size forces many groups -> monotonic watermark per group
+    pack_params_streaming(params, layout, buf, marks.append, group_bytes=64)
+    np.testing.assert_array_equal(buf, ref)
+    assert marks == sorted(marks) and marks[-1] == layout.total_bytes
+    assert len(marks) > 2
+
+
+def test_streaming_push_with_incremental_install():
+    """signal_update_streaming: the pack trails behind gated sender streams
+    and the receiver emits tensors in layout order as their bytes land;
+    values must equal a serial pack+push."""
+    from polyrl_tpu.transfer.layout import pack_params_streaming
+    from polyrl_tpu.transfer.tcp_engine import Watermark
+
+    params = small_params(5)
+    layout = build_layout(params)
+    buf = alloc_buffer(layout)
+    sender = SenderAgent(buf, manager_client=None, listen_host="127.0.0.1",
+                         num_streams=2, poll_s=0.05, advertise_host="127.0.0.1")
+    sender.start()
+    rx = ReceiverAgent(layout, "inst-s", sender.endpoint, num_streams=2,
+                       listen_host="127.0.0.1", advertise_host="127.0.0.1")
+    rx.start()
+    emitted: list[tuple[str, np.ndarray]] = []
+    try:
+        wm = Watermark(layout.total_bytes)
+        v = sender.signal_update_streaming(wm)
+
+        def slow_progress(n):
+            time.sleep(0.02)  # pack slower than the wire: streams must gate
+            wm.advance(n)
+
+        packer = threading.Thread(
+            target=pack_params_streaming,
+            args=(params, layout, buf, slow_progress),
+            kwargs={"group_bytes": 64}, daemon=True)
+        packer.start()
+        rx.wait_for_version(
+            v, timeout=30.0,
+            on_tensor=lambda e, raw: emitted.append((e.name, raw.copy())))
+        packer.join(timeout=10.0)
+        wm.finish()
+        names = [n for n, _ in emitted]
+        assert names == [e.name for e in layout.entries]  # order + complete
+        got = unflatten_like(params, unpack_params(rx.buffer, rx.layout))
+        assert_tree_equal(params, got)
+        by = layout.by_name()
+        for name, raw in emitted:
+            e = by[name]
+            np.testing.assert_array_equal(
+                raw, np.asarray(rx.buffer[e.offset:e.offset + e.nbytes]))
+    finally:
+        rx.stop()
+        sender.stop()
+
+
+def test_streaming_interface_update():
+    """TransferInterface streaming mode end-to-end (no manager)."""
+    from polyrl_tpu.transfer.interface import TransferInterface
+
+    params = small_params(7)
+    iface = TransferInterface(params, manager_client=None, num_streams=2,
+                              poll_s=0.05, advertise_host="127.0.0.1")
+    rx = ReceiverAgent(iface.layout, "inst-i", iface.sender.endpoint,
+                       num_streams=2, listen_host="127.0.0.1",
+                       advertise_host="127.0.0.1")
+    rx.start()
+    try:
+        v = iface.update_weights_with_agent(params, streaming=True)
+        rx.wait_for_version(v, timeout=30.0)
+        got = unflatten_like(params, unpack_params(rx.buffer, rx.layout))
+        assert_tree_equal(params, got)
+        # a second streaming round reuses the same buffer safely
+        params2 = small_params(8)
+        v2 = iface.update_weights_with_agent(params2, streaming=True)
+        rx.wait_for_version(v2, timeout=30.0)
+        got2 = unflatten_like(params2, unpack_params(rx.buffer, rx.layout))
+        assert_tree_equal(params2, got2)
+    finally:
+        rx.stop()
+        iface.close()
