@@ -469,12 +469,18 @@ def bench_weight_sync(params):
         sender.stop()
 
 
-def bench_8b_int8(cfg, batch=64, prompt_len=128, new_tokens=128):
+def bench_8b_int8(cfg, batch=None, prompt_len=128, new_tokens=128):
     """8B decode on ONE chip via int8 weight-only quantization
     (models/quant.py): matmul weights int8 + bf16 embed ≈ 8.6 GiB, fits a
     16 GiB chip. Measured on the production CB paged serving engine. The
     bf16 8B tree never materializes — params are random-initialized
-    directly in quantized form leaf-by-leaf on device."""
+    directly in quantized form leaf-by-leaf on device.
+
+    ``batch`` (POLYRL_BENCH_8B_BATCH): decode slots = tokens amortizing
+    each full weight read; ~8.6 GiB weights + ~34 MB KV/slot at 256 seq
+    leaves room for 128+ slots in 15.75 GiB HBM."""
+    if batch is None:
+        batch = int(os.environ.get("POLYRL_BENCH_8B_BATCH", "64"))
     import jax
     import jax.numpy as jnp
     import numpy as np

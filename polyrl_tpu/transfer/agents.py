@@ -89,6 +89,11 @@ class ReceiverAgent:
         self.version = -1
         self.error: str | None = None
         self._armed_version = -1  # version of the round currently landing
+        # held around every on_tensor emission batch (and the completion
+        # tail): the prepare handler takes it before arming the NEXT round,
+        # so a new push can never overwrite buffer bytes an installer is
+        # still reading (torn-tensor guard for back-to-back syncs)
+        self._install_lock = threading.Lock()
         self._version_cv = threading.Condition()
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
@@ -117,10 +122,15 @@ class ReceiverAgent:
                         if msg is None:
                             continue
                         if msg.get("event") == "prepare":
-                            with self._version_cv:
-                                self._armed_version = int(
-                                    msg.get("version", -1))
-                            self.sockets.arm(int(msg["round"]))
+                            # serialize behind a mid-flight incremental
+                            # install: its buffer reads must finish before
+                            # this round's bytes land over them (sender
+                            # retries if "ready" is delayed past its gate)
+                            with self._install_lock:
+                                with self._version_cv:
+                                    self._armed_version = int(
+                                        msg.get("version", -1))
+                                self.sockets.arm(int(msg["round"]))
                             _send_json(s, {"event": "ready",
                                            "instance": self.instance_endpoint})
                         elif msg.get("event") == "transfer_done":
@@ -172,16 +182,19 @@ class ReceiverAgent:
                 armed = self._armed_version
             if armed != version:
                 return
-            rnd = self.sockets._round
-            if rnd != tail_round:
-                tail_round, emitted = rnd, 0  # retry round: start over
-            for e in covered_entries(self.layout, self.sockets.coverage(),
-                                     emitted):
-                on_tensor(e, self.buffer[e.offset : e.offset + e.nbytes])
-                emitted += 1
+            with self._install_lock:
+                rnd = self.sockets._round
+                if rnd != tail_round:
+                    tail_round, emitted = rnd, 0  # retry round: start over
+                for e in covered_entries(self.layout,
+                                         self.sockets.coverage(), emitted):
+                    on_tensor(e, self.buffer[e.offset : e.offset + e.nbytes])
+                    emitted += 1
 
         with self._version_cv:
             while self.version < version:
+                if self._stop.is_set():
+                    raise ConnectionError("receiver stopped")
                 if self.error is not None:
                     raise ConnectionError(
                         f"receiver registration rejected: {self.error}")
@@ -201,14 +214,19 @@ class ReceiverAgent:
             final = self.version
         if on_tensor is not None:
             # completion: emit the tail; if a newer version landed than the
-            # round we tailed (or we tailed nothing), re-emit everything
-            if final != version or tail_round is None:
-                emitted = 0
-            for e in self.layout.entries[emitted:]:
-                on_tensor(e, self.buffer[e.offset : e.offset + e.nbytes])
+            # round we tailed (or we tailed nothing), re-emit everything.
+            # Under the install lock: the NEXT round's prepare blocks until
+            # these buffer reads are done (torn-tensor guard)
+            with self._install_lock:
+                if final != version or tail_round is None:
+                    emitted = 0
+                for e in self.layout.entries[emitted:]:
+                    on_tensor(e, self.buffer[e.offset : e.offset + e.nbytes])
 
     def stop(self) -> None:
         self._stop.set()
+        with self._version_cv:
+            self._version_cv.notify_all()  # break waiting installers out
         self.sockets.close()
         if self._thread:
             self._thread.join(timeout=5.0)
@@ -600,16 +618,6 @@ class SenderGroup:
         for s in self.senders[1:]:
             s.signal_update(v)
         return v
-
-    def mark_push_failed(self, version: int) -> None:
-        """A streamed pack died mid-round: the buffer holds garbage for
-        ``version``. Poison it so the poll loop stops re-pushing it every
-        ``poll_s`` (each retry would fail at the watermark and spam the
-        manager with aborts); the next successful signal/swap resumes."""
-        with self._cv:
-            self._poisoned_version = version
-        log.error("weight push v%d poisoned (pack failed); waiting for a "
-                  "new update", version)
 
     def swap_buffer(self, new_buffer: np.ndarray, version: int) -> np.ndarray:
         old = self.senders[0].swap_buffer(new_buffer, version)

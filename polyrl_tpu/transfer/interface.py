@@ -21,6 +21,8 @@ import logging
 import time
 from typing import Any
 
+import numpy as np
+
 from .agents import SenderAgent, SenderGroup
 from .layout import ParamLayout, alloc_buffer, build_layout, pack_params
 from .nic import pick_sender_ips

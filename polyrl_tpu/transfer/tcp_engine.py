@@ -65,7 +65,9 @@ class Watermark:
             self._failed = msg or "pack failed"
             self._cv.notify_all()
 
-    def wait_until(self, target: int, timeout: float = 600.0) -> None:
+    def wait_until(self, target: int, timeout: float = 3600.0) -> None:
+        # default budget matches the sender's stream_push_timeout_s: the
+        # gate spans pack progress, which shares the combined round clock
         deadline = time.monotonic() + timeout
         with self._cv:
             while self._value < target and self._failed is None:

@@ -29,11 +29,14 @@ DEFAULT_GRID = {
     "steps_per_dispatch": [4, 8, 16],
     "max_slots": [64, 128, 256],
     "page_size": [64],
+    # run-ahead window for the fetcher-thread pipeline (cb_engine):
+    # ~2*ceil(fetch RTT / dispatch compute) hides the result round trip
+    "pipeline_depth": [8, 16, 32],
 }
 
 
 def run_point(cfg, params, batch, prompt_len, new_tokens, *, max_slots,
-              page_size, steps_per_dispatch) -> dict:
+              page_size, steps_per_dispatch, pipeline_depth=None) -> dict:
     """One grid point: engine construction + warmup come from bench.py's
     shared helpers, so a best_point here reproduces in bench_cb (the only
     intentional difference: this measures the DIRECT path — knobs under
@@ -47,6 +50,8 @@ def run_point(cfg, params, batch, prompt_len, new_tokens, *, max_slots,
     engine = make_cb_engine(cfg, params, prompt_len, new_tokens,
                             max_slots=max_slots, page_size=page_size,
                             steps_per_dispatch=steps_per_dispatch, trace=True)
+    if pipeline_depth is not None:
+        engine.pipeline_depth = pipeline_depth
     try:
         rng = np.random.default_rng(7)
         prompts = [rng.integers(1, cfg.vocab_size, prompt_len).tolist()
