@@ -180,7 +180,7 @@ class ReceiverAgent:
                 return
             with self._version_cv:
                 armed = self._armed_version
-            if armed != version:
+            if armed != target:  # only tail the round we are waiting on
                 return
             with self._install_lock:
                 rnd = self.sockets._round
@@ -191,37 +191,52 @@ class ReceiverAgent:
                     on_tensor(e, self.buffer[e.offset : e.offset + e.nbytes])
                     emitted += 1
 
-        with self._version_cv:
-            while self.version < version:
-                if self._stop.is_set():
-                    raise ConnectionError("receiver stopped")
-                if self.error is not None:
-                    raise ConnectionError(
-                        f"receiver registration rejected: {self.error}")
-                left = deadline - time.monotonic()
-                if left <= 0:
-                    raise TimeoutError(
-                        f"weights v{version} not received (have v{self.version})")
-                if on_tensor is not None:
-                    self._version_cv.release()
-                    try:
-                        emit_landed()
-                    finally:
-                        self._version_cv.acquire()
-                    self._version_cv.wait(min(left, 0.05))
-                else:
-                    self._version_cv.wait(min(left, 1.0))
-            final = self.version
-        if on_tensor is not None:
-            # completion: emit the tail; if a newer version landed than the
-            # round we tailed (or we tailed nothing), re-emit everything.
-            # Under the install lock: the NEXT round's prepare blocks until
-            # these buffer reads are done (torn-tensor guard)
+        target = version
+        while True:
+            with self._version_cv:
+                while self.version < target:
+                    if self._stop.is_set():
+                        raise ConnectionError("receiver stopped")
+                    if self.error is not None:
+                        raise ConnectionError(
+                            f"receiver registration rejected: {self.error}")
+                    left = deadline - time.monotonic()
+                    if left <= 0:
+                        raise TimeoutError(
+                            f"weights v{target} not received "
+                            f"(have v{self.version})")
+                    if on_tensor is not None:
+                        self._version_cv.release()
+                        try:
+                            emit_landed()
+                        finally:
+                            self._version_cv.acquire()
+                        self._version_cv.wait(min(left, 0.05))
+                    else:
+                        self._version_cv.wait(min(left, 1.0))
+                final = self.version
+            if on_tensor is None:
+                return
+            # completion tail, under the install lock (the NEXT round's
+            # prepare blocks until these buffer reads are done)
             with self._install_lock:
-                if final != version or tail_round is None:
+                with self._version_cv:
+                    armed = self._armed_version
+                    cur = self.version
+                if armed > cur:
+                    # a SUPERSEDING round armed before we got here: its
+                    # streams are landing over the buffer right now, so the
+                    # bytes are not ours to read — install that round
+                    # instead once it completes (still "at least version")
+                    target = armed
+                    emitted, tail_round = 0, None
+                    continue
+                if final != target or tail_round is None \
+                        or self.sockets._round != tail_round:
                     emitted = 0
                 for e in self.layout.entries[emitted:]:
                     on_tensor(e, self.buffer[e.offset : e.offset + e.nbytes])
+            return
 
     def stop(self) -> None:
         self._stop.set()

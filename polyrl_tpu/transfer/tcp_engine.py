@@ -111,6 +111,7 @@ class ReceiverSockets:
         self._expected: int | None = None
         self._round = -1
         self._progress: dict[int, int] = {}  # range offset -> bytes landed
+        self._conns: dict[int, list] = {}  # round -> live data connections
         self._lock = threading.Lock()
         self._closed = False
         self.ports: list[int] = []
@@ -138,6 +139,17 @@ class ReceiverSockets:
             self._progress = {}
             self._errors.clear()
             self._done.clear()
+            # force-close dangling streams from older rounds: their header
+            # passed the round check back then, so their recv loops would
+            # keep writing stale bytes into the buffer UNDER the new round
+            stale = [c for r, conns in self._conns.items()
+                     if r != round_id for c in conns]
+            self._conns = {round_id: self._conns.get(round_id, [])}
+        for c in stale:
+            try:
+                c.close()
+            except OSError:
+                pass
 
     def _serve_loop(self, listener: socket.socket) -> None:
         while not self._closed:
@@ -160,6 +172,7 @@ class ReceiverSockets:
                         if round_id != self._round:
                             continue  # stale stream from an aborted round
                         self._expected = nstreams
+                        self._conns.setdefault(round_id, []).append(conn)
                     view = self._mv[offset : offset + length]
                     got = 0
                     while got < length:

@@ -550,3 +550,37 @@ def test_chunked_prefill_aborts_on_weight_swap():
         _time.sleep(0.05)
     engine.stop()
     assert engine.allocator.free_count == free0
+
+
+def test_fetcher_failure_recovers_and_serving_continues(tiny):
+    """A device_get failure surfaced by the fetcher thread must route
+    through _recover (fail in-flight requests, rebuild pools) and leave the
+    engine serving new requests — a dead loop thread wedges every connected
+    HTTP handler."""
+    from polyrl_tpu.rollout.cb_engine import STREAM_END
+
+    cbe = _mk_engine(tiny, max_seq_len=512, num_pages=128)
+    cbe.start()
+    sp = SamplingParams(temperature=0.0, max_new_tokens=300, stop_token_ids=())
+    qa = cbe.submit("victim", [5, 3, 9], sp)
+    first = qa.get(timeout=60)
+    assert first["token_ids"]
+    # inject a poisoned-backend failure exactly where the fetcher reports
+    # one; the loop's next drain re-raises it -> _recover
+    with cbe._fetch_cv:
+        cbe._fetch_exc = RuntimeError("injected device_get failure")
+        cbe._fetch_cv.notify_all()
+    failed = False
+    while True:
+        item = qa.get(timeout=60)
+        if item is STREAM_END:
+            break
+        if item.get("finish_reason") in ("error", "abort"):
+            failed = True
+    assert failed, "victim request should have been failed by _recover"
+    # engine must still serve after the recovery
+    out = cbe.generate([[7, 1, 4]], SamplingParams(
+        temperature=0.0, max_new_tokens=8, stop_token_ids=()), timeout=60.0)
+    assert len(out[0]["token_ids"]) == 8
+    cbe.stop()
+    assert all(s is None for s in cbe._slots)

@@ -458,3 +458,54 @@ def test_streaming_interface_update():
     finally:
         rx.stop()
         iface.close()
+
+
+def test_back_to_back_streaming_installs_are_never_torn():
+    """A second push arriving while an incremental installer is still
+    emitting must never produce a mixed-version tree: the tail re-checks
+    the armed round under the install lock and, when superseded, waits for
+    the newer round and re-emits everything from its completed buffer."""
+    from polyrl_tpu.transfer.interface import TransferInterface
+
+    p1 = small_params(21)
+    p2 = small_params(22)
+    iface = TransferInterface(p1, manager_client=None, num_streams=2,
+                              poll_s=0.02, advertise_host="127.0.0.1")
+    rx = ReceiverAgent(iface.layout, "inst-bb", iface.sender.endpoint,
+                       num_streams=2, listen_host="127.0.0.1",
+                       advertise_host="127.0.0.1")
+    rx.start()
+    emitted: dict[str, np.ndarray] = {}
+
+    def slow_install(e, raw):
+        time.sleep(0.01)  # slow device_put: the v2 push overtakes the tail
+        emitted[e.name] = np.asarray(raw).copy()
+
+    try:
+        v1 = iface.update_weights_with_agent(p1, streaming=True)
+        waiter = threading.Thread(
+            target=rx.wait_for_version, args=(v1,),
+            kwargs={"timeout": 30.0, "on_tensor": slow_install}, daemon=True)
+        waiter.start()
+        v2 = iface.update_weights_with_agent(p2, streaming=True)
+        waiter.join(timeout=30.0)
+        assert not waiter.is_alive()
+        rx.wait_for_version(v2, timeout=30.0)
+        assert set(emitted) == {e.name for e in iface.layout.entries}
+        # every emitted tensor must match ONE consistent version end-to-end
+        by = iface.layout.by_name()
+
+        def tree_bytes(params):
+            buf = alloc_buffer(iface.layout)
+            pack_params(params, iface.layout, buf)
+            return {e.name: np.asarray(
+                buf[e.offset:e.offset + e.nbytes]) for e in iface.layout.entries}
+
+        t1, t2 = tree_bytes(p1), tree_bytes(p2)
+        match1 = all(np.array_equal(emitted[n], t1[n]) for n in emitted)
+        match2 = all(np.array_equal(emitted[n], t2[n]) for n in emitted)
+        assert match1 or match2, "installer emitted a torn mixed-version tree"
+        del by
+    finally:
+        rx.stop()
+        iface.close()
