@@ -203,13 +203,27 @@ def paged_attention_lib(q, k_pool, v_pool, page_table, seq_lens, scale=None):
         pages_per_compute_block=ppcb)
 
 
-def _kv_write_kernel(page_ref, off_ref, kpool_ref, vpool_ref, kupd_ref,
-                     vupd_ref, kout_ref, vout_ref):
-    # the (page, off) target block arrives via the index maps; the body
-    # only copies one token's [Hkv, D] K and V rows into it
-    del page_ref, off_ref, kpool_ref, vpool_ref
-    kout_ref[:, 0, 0, :] = kupd_ref[0].astype(kout_ref.dtype)
-    vout_ref[:, 0, 0, :] = vupd_ref[0].astype(vout_ref.dtype)
+def _kv_write_kernel(page_ref, off_ref,  # scalar prefetch
+                     kpool_ref, vpool_ref, kupd_ref, vupd_ref,
+                     kout_ref, vout_ref, sem_k, sem_v):
+    """One program per slot: two explicit DMAs copy the slot's [Hkv, D]
+    K/V rows into pool[:, page, off, :]. Every operand stays in HBM and
+    the DMA engine handles the strided destination, so Mosaic's block
+    tiling rules (which reject sublane-1 output blocks on real chips —
+    see _paged_attn_kernel's history note) never apply."""
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    del kpool_ref, vpool_ref  # aliased onto the outputs; never read
+    s = pl.program_id(0)
+    pg = page_ref[s]
+    of = off_ref[s]
+    ck = pltpu.make_async_copy(kupd_ref.at[s], kout_ref.at[:, pg, of], sem_k)
+    cv = pltpu.make_async_copy(vupd_ref.at[s], vout_ref.at[:, pg, of], sem_v)
+    ck.start()
+    cv.start()
+    ck.wait()
+    cv.wait()
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
@@ -220,34 +234,26 @@ def paged_kv_write_pallas(k_pool, v_pool, write_page, write_off, k_upd,
     The XLA alternative (row scatter over [Hkv*N*ps, D], one row per
     slot*head) lowers to a serialized per-row loop on TPU — measured as
     the dominant cost of the CB decode step (2 pools x 28 layers x k fused
-    steps of ~500-row scatters per dispatch). Here the write is a Pallas
-    grid over slots: the scalar-prefetched (page, off) pair drives the
-    OUTPUT BlockSpec index map, so each grid step DMAs exactly one
-    [Hkv, 1, 1, D] block — the paged-pool analogue of the bucketed
-    engine's dynamic-update-slice, and the same shape every TPU serving
-    stack uses for its KV-cache update kernel. K and V are fused into one
-    call to halve grid overhead. ``input_output_aliases`` keeps the pools
-    in place (no copy); inactive slots are pre-routed to null page 0 by
-    the caller, so revisiting that block is benign (last write wins in the
-    sequential grid)."""
+    steps of ~500-row scatters per dispatch). Here a Pallas grid over
+    slots issues one explicit HBM->HBM DMA per pool with the
+    scalar-prefetched (page, off) target — the paged-pool analogue of the
+    bucketed engine's dynamic-update-slice, and the same manual-DMA shape
+    TPU serving stacks use for their KV-cache update kernels. K and V are
+    fused into one call to halve grid overhead. ``input_output_aliases``
+    keeps the pools in place (no copy); inactive slots are pre-routed to
+    null page 0 by the caller, so revisiting that row is benign (the grid
+    is sequential: last write wins)."""
     import jax.experimental.pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     s = write_page.shape[0]
-    hkv, _n, _ps, d = k_pool.shape
-
-    pool_spec = pl.BlockSpec(
-        (hkv, 1, 1, d), lambda si, pg, of: (0, pg[si], of[si], 0))
-    # the aliased pool INPUTS are never read in the body: keep them in HBM
-    # (a blocked spec would DMA one unread [Hkv,1,1,D] block per pool per
-    # grid step — doubling the kernel's traffic)
-    pool_in_spec = pl.BlockSpec(memory_space=pltpu.MemorySpace.HBM)
-    upd_spec = pl.BlockSpec((1, hkv, d), lambda si, pg, of: (si, 0, 0))
+    hbm = pl.BlockSpec(memory_space=pltpu.MemorySpace.HBM)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(s,),
-        in_specs=[pool_in_spec, pool_in_spec, upd_spec, upd_spec],
-        out_specs=[pool_spec, pool_spec],
+        in_specs=[hbm, hbm, hbm, hbm],
+        out_specs=[hbm, hbm],
+        scratch_shapes=[pltpu.SemaphoreType.DMA, pltpu.SemaphoreType.DMA],
     )
     return pl.pallas_call(
         _kv_write_kernel,
@@ -258,16 +264,57 @@ def paged_kv_write_pallas(k_pool, v_pool, write_page, write_off, k_upd,
         # 2=k_pool 3=v_pool (aliased onto outputs 0/1) 4=k_upd 5=v_upd
         input_output_aliases={2: 0, 3: 1},
         interpret=interpret,
+        # DMA targets depend on scalar-prefetched indices, never on other
+        # grid steps' work; "arbitrary" keeps Mosaic from reordering
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",)),
     )(write_page.astype(jnp.int32), write_off.astype(jnp.int32),
-      k_pool, v_pool, k_upd, v_upd)
+      k_pool, v_pool, k_upd.astype(k_pool.dtype), v_upd.astype(v_pool.dtype))
+
+
+_KV_WRITE_PROBE: dict = {}
+
+
+def _pallas_kv_write_supported(hkv: int, page_size: int, d: int,
+                               pool_dt, upd_dt) -> bool:
+    """Eager compile+run probe of the write kernel on the active backend,
+    cached per (block-shape, dtype) signature — Mosaic tiling legality
+    depends on the BLOCK dims and dtypes, not on pool/grid size, so a tiny
+    2-page specimen with the caller's real Hkv/page/D/dtypes decides. A
+    lowering rejection must degrade to the (slow but correct) XLA scatter,
+    not error every decode dispatch of a serving process. Runs on concrete
+    arrays, so it is safe to trigger from inside a trace of the step fn."""
+    key = (hkv, page_size, d, str(pool_dt), str(upd_dt))
+    if key not in _KV_WRITE_PROBE:
+        try:
+            kp = jnp.zeros((hkv, 2, page_size, d), pool_dt)
+            up = jnp.ones((3, hkv, d), upd_dt)
+            idx = jnp.zeros((3,), jnp.int32)
+            out = paged_kv_write_pallas(kp, kp, idx, idx, up, up)
+            jax.block_until_ready(out)
+            _KV_WRITE_PROBE[key] = True
+        except Exception as exc:  # noqa: BLE001 — any lowering/runtime
+            # failure routes every caller to the scatter path
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "pallas kv-write kernel unavailable for %s on %s (%s); "
+                "falling back to XLA scatter", key, jax.default_backend(),
+                str(exc)[:200])
+            _KV_WRITE_PROBE[key] = False
+    return _KV_WRITE_PROBE[key]
 
 
 def paged_kv_write(k_pool, v_pool, write_page, write_off, k_upd, v_upd):
     """Dispatch: Pallas write kernel on TPU, XLA row scatter elsewhere.
     Override with POLYRL_KV_WRITE=scatter|pallas."""
     impl = os.environ.get("POLYRL_KV_WRITE", "")
-    if impl != "scatter" and (impl == "pallas"
-                              or jax.default_backend() == "tpu"):
+    if impl != "scatter" and (
+            impl == "pallas"
+            or (jax.default_backend() == "tpu"
+                and _pallas_kv_write_supported(
+                    k_pool.shape[0], k_pool.shape[2], k_pool.shape[3],
+                    k_pool.dtype, k_upd.dtype))):
         return paged_kv_write_pallas(
             k_pool, v_pool, write_page, write_off, k_upd, v_upd,
             interpret=jax.default_backend() != "tpu")

@@ -147,7 +147,11 @@ class ReceiverSockets:
             self._conns = {round_id: self._conns.get(round_id, [])}
         for c in stale:
             try:
-                c.close()
+                # shutdown (NOT close) wakes a recv_into blocked in the
+                # kernel; the owning serve thread's `with conn:` does the
+                # close — closing here would free the fd number for a new
+                # accept while the serve thread could still recv on it
+                c.shutdown(socket.SHUT_RDWR)
             except OSError:
                 pass
 

@@ -493,7 +493,6 @@ def test_back_to_back_streaming_installs_are_never_torn():
         rx.wait_for_version(v2, timeout=30.0)
         assert set(emitted) == {e.name for e in iface.layout.entries}
         # every emitted tensor must match ONE consistent version end-to-end
-        by = iface.layout.by_name()
 
         def tree_bytes(params):
             buf = alloc_buffer(iface.layout)
@@ -505,7 +504,6 @@ def test_back_to_back_streaming_installs_are_never_torn():
         match1 = all(np.array_equal(emitted[n], t1[n]) for n in emitted)
         match2 = all(np.array_equal(emitted[n], t2[n]) for n in emitted)
         assert match1 or match2, "installer emitted a torn mixed-version tree"
-        del by
     finally:
         rx.stop()
         iface.close()
