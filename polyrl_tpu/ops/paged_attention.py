@@ -284,13 +284,17 @@ def _pallas_kv_write_supported(hkv: int, page_size: int, d: int,
     lowering rejection must degrade to the (slow but correct) XLA scatter,
     not error every decode dispatch of a serving process. Runs on concrete
     arrays, so it is safe to trigger from inside a trace of the step fn."""
-    key = (hkv, page_size, d, str(pool_dt), str(upd_dt))
+    del upd_dt  # the wrapper casts updates to pool_dt before the kernel,
+    # so lowering cannot depend on it — keying on it would re-pay a ~30 s
+    # tunnel probe compile for an identical kernel
+    key = (hkv, page_size, d, str(pool_dt))
     if key not in _KV_WRITE_PROBE:
         try:
             kp = jnp.zeros((hkv, 2, page_size, d), pool_dt)
-            up = jnp.ones((3, hkv, d), upd_dt)
+            vp = jnp.zeros((hkv, 2, page_size, d), pool_dt)
+            up = jnp.ones((3, hkv, d), pool_dt)
             idx = jnp.zeros((3,), jnp.int32)
-            out = paged_kv_write_pallas(kp, kp, idx, idx, up, up)
+            out = paged_kv_write_pallas(kp, vp, idx, idx, up, up)
             jax.block_until_ready(out)
             _KV_WRITE_PROBE[key] = True
         except Exception as exc:  # noqa: BLE001 — any lowering/runtime
