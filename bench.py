@@ -56,6 +56,8 @@ def _note(name: str, result) -> None:
 
 
 def _load_state() -> dict:
+    # NOTE: cross-build staleness needs no guard here — parent_main removes
+    # STATE_PATH at every invocation (state is per-invocation resume only)
     try:
         with open(STATE_PATH) as f:
             return json.load(f)
