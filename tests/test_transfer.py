@@ -507,3 +507,50 @@ def test_back_to_back_streaming_installs_are_never_torn():
     finally:
         rx.stop()
         iface.close()
+
+
+def test_streaming_push_fans_out_to_multiple_receivers():
+    """One streamed round, two registered receivers: both instances' stream
+    sets trail the SAME pack watermark concurrently and both land the full
+    buffer (the sender pushes per-instance in parallel threads)."""
+    from polyrl_tpu.transfer.layout import pack_params_streaming
+    from polyrl_tpu.transfer.tcp_engine import Watermark
+
+    params = small_params(31)
+    layout = build_layout(params)
+    buf = alloc_buffer(layout)
+    sender = SenderAgent(buf, manager_client=None, listen_host="127.0.0.1",
+                         num_streams=2, poll_s=0.05, advertise_host="127.0.0.1")
+    sender.start()
+    rxs = [ReceiverAgent(layout, f"inst-m{i}", sender.endpoint, num_streams=2,
+                         listen_host="127.0.0.1", advertise_host="127.0.0.1")
+           for i in range(2)]
+    for rx in rxs:
+        rx.start()
+    try:
+        time.sleep(0.3)  # both registrations land
+        wm = Watermark(layout.total_bytes)
+        v = sender.signal_update_streaming(wm)
+
+        def slow_progress(n):
+            time.sleep(0.02)  # pack slower than the wire: BOTH instances'
+            wm.advance(n)     # gated streams must trail the same watermark
+
+        packer = threading.Thread(
+            target=pack_params_streaming,
+            args=(params, layout, buf, slow_progress),
+            kwargs={"group_bytes": 64}, daemon=True)
+        packer.start()
+        for rx in rxs:
+            rx.wait_for_version(v, timeout=30.0)
+        packer.join(timeout=10.0)
+        assert not packer.is_alive()
+        wm.finish()
+        for rx in rxs:
+            rx.wait_for_version(v, timeout=30.0)
+            got = unflatten_like(params, unpack_params(rx.buffer, rx.layout))
+            assert_tree_equal(params, got)
+    finally:
+        for rx in rxs:
+            rx.stop()
+        sender.stop()
