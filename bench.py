@@ -488,26 +488,23 @@ def bench_8b_int8(cfg, batch=None, prompt_len=128, new_tokens=128):
     if batch is None:
         env = os.environ.get("POLYRL_BENCH_8B_BATCH", "")
         candidates = [int(env)] if env else [128, 64]
-        last_msg = ""
-        for b in candidates:
+        for b in candidates[:-1]:
             try:
                 return bench_8b_int8(cfg, batch=b, prompt_len=prompt_len,
                                      new_tokens=new_tokens)
             except Exception as exc:  # noqa: BLE001 — classify below
                 msg = str(exc)
-                oom = ("RESOURCE_EXHAUSTED" in msg or "OOM" in msg
-                       or "out of memory" in msg.lower())
-                if not oom or b == candidates[-1]:
+                if not ("RESOURCE_EXHAUSTED" in msg or "OOM" in msg
+                        or "out of memory" in msg.lower()):
                     raise  # only a deterministic OOM warrants the retry
-                # keep ONLY the message: holding the exception (and its
-                # traceback frames) would pin the failed attempt's ~8.6 GiB
-                # of device params across the narrower retry
-                last_msg = msg[:200]
-                _note("8b_int8", {"batch": b, "error": last_msg,
+                _note("8b_int8", {"batch": b, "error": msg[:200],
                                   "retrying_narrower": True})
-                del exc
-                gc.collect()
-        raise RuntimeError(f"8b int8 failed at every batch: {last_msg}")
+            # AFTER the except block: the handled exception's traceback
+            # frames (pinning the failed attempt's ~8.6 GiB of device
+            # params) are only released once the block exits
+            gc.collect()
+        return bench_8b_int8(cfg, batch=candidates[-1],
+                             prompt_len=prompt_len, new_tokens=new_tokens)
     import jax
     import jax.numpy as jnp
     import numpy as np

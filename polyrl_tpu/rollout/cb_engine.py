@@ -69,11 +69,14 @@ MAX_STOP_TOKENS = 8
 def device_ngram_propose(tok_buf: jnp.ndarray, hist_len: jnp.ndarray,
                          n_draft: int) -> jnp.ndarray:
     """Vectorized prompt-lookup proposal on device: for each slot, find the
-    LATEST earlier occurrence of the history's final bigram in
-    ``tok_buf[s, :hist_len[s]]`` and propose the ``n_draft`` tokens that
-    followed it; no match (or history < 3) repeats the last token.
-    Rejection sampling keeps ANY proposal distribution-exact — a bad guess
-    only wastes verify FLOPs. O(S·L) compares; jit-safe static shapes.
+    LATEST earlier occurrence of the history's final TRIGRAM in
+    ``tok_buf[s, :hist_len[s]]`` — falling back to the final bigram, then
+    to repeating the last token — and propose the ``n_draft`` tokens that
+    followed the match. Longer context matches are what make prompt-lookup
+    precise on repetitive text (a repeated bigram often continues
+    differently; a repeated trigram rarely does). Rejection sampling keeps
+    ANY proposal distribution-exact — a bad guess only wastes verify
+    FLOPs. O(S·L) compares; jit-safe static shapes.
 
     tok_buf: [S, L] int32 (prompt + generated, front-filled)
     hist_len: [S] int32 valid-prefix lengths
@@ -83,15 +86,28 @@ def device_ngram_propose(tok_buf: jnp.ndarray, hist_len: jnp.ndarray,
     rows = jnp.arange(s)
     t_last = tok_buf[rows, jnp.clip(hist_len - 1, 0, length - 1)]
     t_prev = tok_buf[rows, jnp.clip(hist_len - 2, 0, length - 1)]
-    idx = jnp.arange(length - 1)
+    t_prev2 = tok_buf[rows, jnp.clip(hist_len - 3, 0, length - 1)]
+    idx2 = jnp.arange(length - 1)
     # bigram match at p: buf[p] == t_prev and buf[p+1] == t_last, with the
     # matched bigram strictly before the final one (p+1 < hist_len-1)
-    match = ((tok_buf[:, :-1] == t_prev[:, None])
-             & (tok_buf[:, 1:] == t_last[:, None])
-             & (idx[None] + 1 < (hist_len - 1)[:, None]))
-    p = jnp.max(jnp.where(match, idx[None], -1), axis=1)          # latest
-    found = (p >= 0) & (hist_len >= 3)
-    gather = jnp.clip(p[:, None] + 2 + jnp.arange(n_draft)[None], 0,
+    m2 = ((tok_buf[:, :-1] == t_prev[:, None])
+          & (tok_buf[:, 1:] == t_last[:, None])
+          & (idx2[None] + 1 < (hist_len - 1)[:, None]))
+    p2 = jnp.max(jnp.where(m2, idx2[None], -1), axis=1)           # latest
+    found2 = (p2 >= 0) & (hist_len >= 3)
+    # trigram match at p: buf[p:p+3] == (t_prev2, t_prev, t_last), matched
+    # strictly before the final trigram (p+2 < hist_len-1)
+    idx3 = jnp.arange(length - 2)
+    m3 = ((tok_buf[:, :-2] == t_prev2[:, None])
+          & (tok_buf[:, 1:-1] == t_prev[:, None])
+          & (tok_buf[:, 2:] == t_last[:, None])
+          & (idx3[None] + 2 < (hist_len - 1)[:, None]))
+    p3 = jnp.max(jnp.where(m3, idx3[None], -1), axis=1)
+    found3 = (p3 >= 0) & (hist_len >= 4)
+    # continuation starts right after whichever match won
+    start = jnp.where(found3, p3 + 3, p2 + 2)
+    found = found3 | found2
+    gather = jnp.clip(start[:, None] + jnp.arange(n_draft)[None], 0,
                       length - 1)
     cont = jnp.take_along_axis(tok_buf, gather, axis=1)
     # past-the-history continuation positions fall back to the last token
@@ -287,8 +303,9 @@ class CBEngine:
         self._chunk_jobs: collections.deque = collections.deque()
         # prompt-lookup speculative decoding (opt-in): each decode dispatch
         # runs spec_rounds fused speculation rounds; every round proposes
-        # spec_tokens draft tokens per slot by DEVICE-side ngram lookup in
-        # a device token buffer, verifies them all in ONE forward, and
+        # spec_tokens draft tokens per slot by DEVICE-side n-gram lookup
+        # (trigram-preferred, bigram fallback) in a device token buffer,
+        # verifies them all in ONE forward, and
         # distribution-exact rejection sampling (spec_verify_sample_vec)
         # emits the accepted prefix + 1 — up to spec_tokens+1 tokens per
         # weight read instead of 1. Fully device-resident (proposals, the
@@ -442,7 +459,7 @@ class CBEngine:
     def _get_spec_step(self, use_filters: bool, m: int, rounds: int):
         """``rounds`` fused speculation rounds per dispatch, fully
         device-resident. Each round: propose m-1 draft tokens per slot via
-        bigram lookup in the device token buffer
+        n-gram lookup (trigram preferred) in the device token buffer
         (:func:`device_ngram_propose`), verify all m (the newest real token
         + drafts) in ONE forward, rejection-sample the accepted prefix + 1,
         and write the emitted tokens back into the buffer for the next

@@ -174,21 +174,27 @@ def test_spec_sampled_run_is_healthy():
 
 
 def test_device_ngram_proposer():
-    """The in-jit bigram prompt-lookup: latest-match continuation,
-    self-match exclusion, past-history fallback, short-history fallback."""
+    """The in-jit prompt-lookup: trigram-preferred latest-match
+    continuation with bigram fallback, self-match exclusion, past-history
+    fallback, short-history fallback."""
     from polyrl_tpu.rollout.cb_engine import device_ngram_propose
 
-    buf = np.zeros((4, 16), np.int32)
-    buf[0, :8] = [1, 2, 3, 9, 9, 1, 2, 3]  # final bigram (2,3) at pos 1
+    buf = np.zeros((5, 16), np.int32)
+    buf[0, :8] = [1, 2, 3, 9, 9, 1, 2, 3]  # trigram (1,2,3) at pos 0
     buf[1, :4] = [4, 5, 6, 7]              # bigram (6,7) never seen before
     buf[2, :1] = [8]                       # history too short
     buf[3, :4] = [5, 6, 5, 6]              # match at 0; cont runs past hist
+    # the LATER bigram (2,3) match at pos 5 continues with 9, but the
+    # trigram (1,2,3) at pos 0 continues with 5 — precision demands the
+    # longer context win
+    buf[4, :11] = [1, 2, 3, 5, 7, 2, 3, 9, 1, 2, 3]
     out = np.asarray(device_ngram_propose(
-        jnp.asarray(buf), jnp.asarray([8, 4, 1, 4], jnp.int32), 4))
+        jnp.asarray(buf), jnp.asarray([8, 4, 1, 4, 11], jnp.int32), 4))
     assert out[0].tolist() == [9, 9, 1, 2]
     assert out[1].tolist() == [7, 7, 7, 7]
     assert out[2].tolist() == [8, 8, 8, 8]
     assert out[3].tolist() == [5, 6, 6, 6]  # in-hist cont then last-token
+    assert out[4].tolist() == [5, 7, 2, 3]  # trigram beats later bigram
 
 
 def test_spec_single_round_matches_plain_greedy():
