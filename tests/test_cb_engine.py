@@ -584,3 +584,37 @@ def test_fetcher_failure_recovers_and_serving_continues(tiny):
     assert len(out[0]["token_ids"]) == 8
     cbe.stop()
     assert all(s is None for s in cbe._slots)
+
+
+def test_weight_swap_mid_generation_with_pipeline(tiny):
+    """update_weights while a long stream is mid-generation with the deep
+    run-ahead pipeline: the stream must complete cleanly (no device-state
+    tear), and a request AFTER the swap must decode with the new policy."""
+    cfg, params = tiny
+    cbe = _mk_engine(tiny, max_seq_len=512, num_pages=128)
+    cbe.start()
+    sp_long = SamplingParams(temperature=0.0, max_new_tokens=300,
+                             stop_token_ids=())
+    q = cbe.submit("mid", [5, 3, 9], sp_long)
+    first = q.get(timeout=60)
+    assert first["token_ids"]
+    params2 = decoder.init_params(jax.random.PRNGKey(99), cfg)
+    cbe.update_weights(params2, version=3)
+    from polyrl_tpu.rollout.cb_engine import STREAM_END
+    n = len(first["token_ids"])
+    while True:
+        item = q.get(timeout=120)
+        if item is STREAM_END:
+            break
+        n += len(item["token_ids"])
+    assert n == 300  # budget-bound stream still completes exactly
+    assert cbe.weight_version == 3
+    # post-swap decode equals a fresh engine on params2
+    sp = SamplingParams(temperature=0.0, max_new_tokens=8, stop_token_ids=())
+    got = cbe.generate([[7, 1, 4]], sp)[0]["token_ids"]
+    ref_eng = CBEngine(cfg, params2, max_slots=4, page_size=8,
+                       max_seq_len=128, prompt_buckets=(16, 32), num_pages=64)
+    want = ref_eng.generate([[7, 1, 4]], sp)[0]["token_ids"]
+    ref_eng.stop()
+    cbe.stop()
+    assert got == want
