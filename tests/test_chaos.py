@@ -150,3 +150,82 @@ def test_optimizer_host_offload_roundtrip():
     p_on = run(False)
     jax.tree_util.tree_map(
         lambda a, b_: np.testing.assert_array_equal(a, b_), p_off, p_on)
+
+
+def test_engine_pipeline_stress_mixed_load():
+    """Serving stress over the fetcher-thread pipeline: 24 concurrent
+    streams with mixed budgets, a third aborted mid-flight, a weight swap
+    and a release/resume cycle injected under load — every stream must
+    terminate with a coherent reason and all slots/pages must reclaim."""
+    import queue as _queue
+    import threading
+
+    from polyrl_tpu.models import decoder
+    from polyrl_tpu.rollout.cb_engine import CBEngine, STREAM_END
+    from polyrl_tpu.rollout.sampling import SamplingParams
+
+    cfg = decoder.get_config("tiny")
+    params = decoder.init_params(jax.random.PRNGKey(0), cfg)
+    eng = CBEngine(cfg, params, max_slots=6, page_size=8, max_seq_len=256,
+                   prompt_buckets=(16, 32), num_pages=256,
+                   steps_per_dispatch=4).start()
+    rng = np.random.default_rng(5)
+    n_req = 24
+    aborts = [threading.Event() if i % 3 == 0 else None for i in range(n_req)]
+    qs = []
+    for i in range(n_req):
+        sp = SamplingParams(temperature=0.0 if i % 2 else 1.0,
+                            max_new_tokens=int(rng.integers(8, 120)),
+                            stop_token_ids=(int(rng.integers(1, 64)),))
+        qs.append(eng.submit(
+            f"s{i}", rng.integers(1, cfg.vocab_size,
+                                  int(rng.integers(2, 30))).tolist(),
+            sp, abort=aborts[i]))
+
+    stop_inject = threading.Event()
+
+    def injector() -> None:
+        time.sleep(0.3)
+        for ev in aborts:
+            if ev is not None:
+                ev.set()
+                time.sleep(0.02)
+        eng.update_weights(
+            decoder.init_params(jax.random.PRNGKey(1), cfg), version=2)
+        stop_inject.set()
+
+    inj = threading.Thread(target=injector, daemon=True)
+    inj.start()
+
+    results = []
+    for i, q in enumerate(qs):
+        toks, reason = 0, ""
+        while True:
+            try:
+                item = q.get(timeout=180)
+            except _queue.Empty:
+                raise AssertionError(f"stream {i} wedged") from None
+            if item is STREAM_END:
+                break
+            toks += len(item.get("token_ids", []))
+            if item.get("finished"):
+                reason = item.get("finish_reason", "")
+        results.append((toks, reason))
+    inj.join(timeout=30)
+    assert stop_inject.is_set()
+    for i, (toks, reason) in enumerate(results):
+        assert reason in ("stop", "length", "abort", "error"), (i, reason)
+        if aborts[i] is None:
+            assert reason in ("stop", "length"), (i, reason)
+            assert toks >= 1
+
+    # release/resume under a now-idle engine, then serve again
+    eng.release_memory()
+    eng.resume_memory()
+    out = eng.generate([[9, 9, 2]], SamplingParams(
+        temperature=0.0, max_new_tokens=6, stop_token_ids=()), timeout=120.0)
+    assert len(out[0]["token_ids"]) == 6
+    assert eng.weight_version == 2
+    eng.stop()
+    assert all(s is None for s in eng._slots)
+    assert eng.allocator.free_count == eng.num_pages - 1
