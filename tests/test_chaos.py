@@ -214,10 +214,19 @@ def test_engine_pipeline_stress_mixed_load():
     inj.join(timeout=30)
     assert stop_inject.is_set()
     for i, (toks, reason) in enumerate(results):
-        assert reason in ("stop", "length", "abort", "error"), (i, reason)
+        # "error" never appears: aborts emit "abort" and healthy streams
+        # finish via stop/length — an "error" means the engine loop crashed
+        assert reason in ("stop", "length", "abort"), (i, reason)
         if aborts[i] is None:
             assert reason in ("stop", "length"), (i, reason)
             assert toks >= 1
+    # the abort injection must be OBSERVABLE: with 24 streams over 6 slots
+    # several aborted requests are still queued or mid-flight at +0.3 s
+    # (a stream that legitimately finished before its abort landed reports
+    # stop/length — but never all eight)
+    assert any(reason == "abort"
+               for i, (_t, reason) in enumerate(results)
+               if aborts[i] is not None)
 
     # release/resume under a now-idle engine, then serve again
     eng.release_memory()
