@@ -174,6 +174,7 @@ class CBEngine:
         seed: int = 0,
         enable_prefix_cache: bool = True,
         steps_per_dispatch: int = 8,
+        pipeline_depth: int | None = None,
         mesh=None,
         prefill_chunk: int = 0,
         trace: bool | None = None,
@@ -287,8 +288,9 @@ class CBEngine:
         # 0 = fully synchronous (drain every dispatch); negative would make
         # the drain's `outstanding <= keep` exit unreachable and spin the
         # loop thread forever
-        self.pipeline_depth = max(
-            0, int(os.environ.get("POLYRL_CB_PIPELINE") or 16))
+        if pipeline_depth is None:
+            pipeline_depth = int(os.environ.get("POLYRL_CB_PIPELINE") or 16)
+        self.pipeline_depth = max(0, int(pipeline_depth))
         # fused decode steps per dispatch (multi-step scheduling): divides
         # dispatch/fetch overhead by k at the cost of ≤(k-1) wasted
         # device iterations per finished slot and up to k steps of

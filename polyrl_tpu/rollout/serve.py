@@ -37,6 +37,7 @@ def create_server(model: str, manager_endpoint: str | None = None,
                   max_seq_len: int = 16384,
                   num_pages: int | None = None,
                   steps_per_dispatch: int = 8,
+                  pipeline_depth: int | None = None,
                   weight_quant: str = "",
                   warmup: bool = False,
                   tp: int = 1,
@@ -148,7 +149,7 @@ def create_server(model: str, manager_endpoint: str | None = None,
             prompt_buckets=tuple(prompt_buckets) if prompt_buckets
             else (128, 256, 512, 1024, 2048, 4096), seed=seed, mesh=mesh,
             prefill_chunk=prefill_chunk, spec_tokens=spec_tokens,
-            spec_rounds=spec_rounds)
+            spec_rounds=spec_rounds, pipeline_depth=pipeline_depth)
     else:
         kwargs = {}
         if batch_buckets:
@@ -222,6 +223,11 @@ def main() -> None:
     p.add_argument("--max-seq-len", type=int, default=16384)
     p.add_argument("--steps-per-dispatch", type=int, default=8,
                    help="fused decode steps per device dispatch")
+    p.add_argument("--pipeline-depth", type=int, default=None,
+                   help="run-ahead dispatch window for the fetcher-thread "
+                        "pipeline (default 16 / POLYRL_CB_PIPELINE); lower "
+                        "it for tighter abort latency on colocated "
+                        "time-sliced workers")
     p.add_argument("--weight-quant", default="", choices=("", "int8"),
                    help="int8 = weight-only quantized serving")
     p.add_argument("--warmup", action="store_true",
@@ -263,6 +269,7 @@ def main() -> None:
                            page_size=args.page_size,
                            max_seq_len=args.max_seq_len,
                            steps_per_dispatch=args.steps_per_dispatch,
+                           pipeline_depth=args.pipeline_depth,
                            weight_quant=args.weight_quant,
                            warmup=args.warmup,
                            prompt_buckets=args.prompt_buckets,
