@@ -13,8 +13,9 @@ Runs on the real TPU chip. Prints ONE JSON line
   cb_serve isolates dispatch/HTTP overhead from device compute.
 - ``extra.bucketed``: the v0 bucketed ``RolloutEngine`` decode number
   (round-1/2 headline, kept for continuity).
-- ``extra.weight_sync``: pack → localhost TCP (sender/receiver agents) →
-  unpack → engine hot-swap for the FULL flagship param set, seconds + MB/s
+- ``extra.weight_sync``: the STREAMED sync round for the FULL flagship
+  param set — pack ‖ localhost TCP (sender/receiver agents) ‖ per-tensor
+  device install, total seconds + effective MB/s
   (reference KPI: sender_agent.py:628-630; north star <5 s).
 - ``extra.llama3_8b``: 8B-class decode tok/s/chip — bf16 when the chip's
   HBM fits it, else the int8 weight-only-quantized CB engine
@@ -365,8 +366,9 @@ def bench_spec(cfg, params, batch=64, prompt_len=128, new_tokens=128,
 
 
 def bench_weight_sync(params):
-    """Full-flagship weight sync over the real fabric: pack → localhost TCP
-    (multi-stream) → receiver → device hot-swap. Reference KPI
+    """Full-flagship STREAMED weight sync over the real fabric: pack ‖
+    localhost TCP (multi-stream, watermark-gated) ‖ per-tensor device
+    install, then the engine hot-swap. Reference KPI
     sender_agent.py:628-630; north star <5 s (BASELINE.md)."""
     import jax
 
