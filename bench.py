@@ -111,9 +111,10 @@ def bench_bucketed(cfg, params, batch, prompt_len, new_tokens):
     return {"tok_s": round(total_new / dt, 1), "wall_s": round(dt, 2)}
 
 
-def _http_generate(endpoint: str, rid: str, input_ids, max_new: int) -> int:
-    """One serving request; returns generated-token count (drains the NDJSON
-    stream like the manager's router does)."""
+def _http_generate(endpoint: str, rid: str, input_ids,
+                   max_new: int) -> tuple[int, float]:
+    """One serving request; returns (generated-token count, time-to-first-
+    token seconds) — drains the NDJSON stream like the manager's router."""
     body = json.dumps({
         "rid": rid, "input_ids": input_ids,
         "sampling_params": {"temperature": 1.0, "max_new_tokens": max_new,
@@ -123,13 +124,18 @@ def _http_generate(endpoint: str, rid: str, input_ids, max_new: int) -> int:
         f"http://{endpoint}/generate", data=body, method="POST",
         headers={"Content-Type": "application/json"})
     n = 0
+    t0 = time.monotonic()
+    ttft = 0.0
     with urllib.request.urlopen(req, timeout=600.0) as r:
         for raw in r:
             line = raw.decode().strip()
             if not line:
                 continue
-            n += len(json.loads(line).get("token_ids", []))
-    return n
+            got = len(json.loads(line).get("token_ids", []))
+            if got and not ttft:
+                ttft = time.monotonic() - t0
+            n += got
+    return n, ttft
 
 
 def make_cb_engine(cfg, params, prompt_len, new_tokens, *, max_slots=64,
@@ -226,11 +232,14 @@ def bench_cb(cfg, params, batch, prompt_len, new_tokens, max_slots=64,
     counts = [0] * batch
     errs: list[str] = []
 
+    ttfts = [0.0] * batch
+
     def worker(lo: int, hi: int) -> None:
         for i in range(lo, hi):
             try:
-                counts[i] = _http_generate(server.endpoint, f"bench-{i}",
-                                           serve_prompts[i], new_tokens)
+                counts[i], ttfts[i] = _http_generate(
+                    server.endpoint, f"bench-{i}", serve_prompts[i],
+                    new_tokens)
             except Exception as exc:  # noqa: BLE001
                 errs.append(str(exc))
 
@@ -263,6 +272,7 @@ def bench_cb(cfg, params, batch, prompt_len, new_tokens, max_slots=64,
     stop_sampling.set()
     sampler_t.join(timeout=5.0)  # before del engine: the closure reads it
     serve_tokens = sum(counts)
+    ttft_ok = [t for t in ttfts if t]  # failed/zero-token requests excluded
     server.stop()
     trace = {k: round(v, 3) for k, v in sorted(engine.trace_report().items())}
     del engine
@@ -278,6 +288,13 @@ def bench_cb(cfg, params, batch, prompt_len, new_tokens, max_slots=64,
         "errors": len(errs),
         "error_sample": errs[0][:200] if errs else "",
         "serve_peak_tok_s": round(peak[0], 1),
+        # admission-to-first-token latency under the full concurrent load
+        # (includes queueing behind earlier admissions — the serving-side
+        # KPI the throughput numbers don't capture)
+        "ttft_p50_ms": round(float(np.percentile(ttft_ok, 50)) * 1e3, 1)
+        if ttft_ok else 0.0,
+        "ttft_p95_ms": round(float(np.percentile(ttft_ok, 95)) * 1e3, 1)
+        if ttft_ok else 0.0,
     }
 
 
