@@ -36,6 +36,7 @@ parent always prints the final JSON line from whatever the state holds.
 
 from __future__ import annotations
 
+import contextlib
 import gc
 import json
 import os
@@ -49,6 +50,62 @@ STATE_PATH = os.environ.get("POLYRL_BENCH_STATE",
 MAX_ATTEMPTS = int(os.environ.get("POLYRL_BENCH_ATTEMPTS", "3"))
 ATTEMPT_TIMEOUT_S = float(os.environ.get("POLYRL_BENCH_TIMEOUT", "2700"))
 RETRY_SLEEP_S = float(os.environ.get("POLYRL_BENCH_RETRY_SLEEP", "60"))
+# The axon relay's PJRT dial port. A plain-socket probe here answers "is the
+# TPU reachable" in <2 s without importing jax (a jax dial against a dead
+# relay HANGS for the whole dial watchdog — r4 burned its entire driver
+# window on two of those).
+RELAY_PROBE_PORT = int(os.environ.get("POLYRL_BENCH_RELAY_PORT", "8113"))
+RELAY_POLL_S = float(os.environ.get("POLYRL_BENCH_RELAY_POLL", "30"))
+# phase name → key its result is stored under in extra (single source for
+# child_main's phase table, attempt refunds, and the headline assembly)
+PHASE_STORE_KEYS = {"8b": "llama3_8b"}
+
+
+def _relay_required() -> bool:
+    """True when this process would reach the TPU through the local axon
+    relay (the sitecustomize registers the plugin iff PALLAS_AXON_POOL_IPS
+    is set). On a real TPU VM or a CPU run there is no relay to probe.
+    POLYRL_BENCH_RELAY_REQUIRED=1/0 overrides (tests must NOT set the
+    pool var itself — that re-activates the plugin's interpreter-start
+    dial in the subprocess, the exact hang this probe exists to avoid)."""
+    override = os.environ.get("POLYRL_BENCH_RELAY_REQUIRED", "")
+    if override:
+        return override == "1"
+    return bool(os.environ.get("PALLAS_AXON_POOL_IPS"))
+
+
+def _relay_up() -> bool:
+    import socket
+
+    try:
+        with socket.create_connection(("127.0.0.1", RELAY_PROBE_PORT),
+                                      timeout=2.0):
+            return True
+    except OSError:
+        return False
+
+
+@contextlib.contextmanager
+def _hang_fuse(what: str, deadline: float):
+    """Hard-exit (rc=17 → parent retries in a fresh process) if the guarded
+    block hasn't finished within ``deadline``. A dying relay makes jax
+    dials and remote compiles HANG rather than raise; every such window
+    needs its own fuse or a wedged child burns the parent's full 2700 s
+    attempt timeout."""
+    done = threading.Event()
+
+    def _watch() -> None:
+        if not done.wait(deadline):
+            print(f"[bench] {what} exceeded {deadline:.0f}s — aborting "
+                  "child for a fresh-process retry",
+                  file=sys.stderr, flush=True)
+            os._exit(17)
+
+    threading.Thread(target=_watch, daemon=True).start()
+    try:
+        yield
+    finally:
+        done.set()
 
 
 def _note(name: str, result) -> None:
@@ -689,8 +746,16 @@ def assemble_result(state: dict) -> dict:
     new_tokens = meta.get("new_tokens", 128)
     n_chips = max(meta.get("n_chips", 1), 1)
     cb_serve = (extra.get("cb") or {}).get("serve_tok_s")
+    b8 = extra.get("llama3_8b") or {}
     if cb_serve:
         name, primary = "cb_serving_tok_s_per_chip", cb_serve
+    elif b8.get("tok_s"):
+        # narrow-window case the 8b-first phase order exists for: the 8B
+        # number IS the north-star headline (BASELINE: ≥2k tok/s/chip at 8B)
+        preset = meta.get("preset_8b", "llama3-8b")
+        batch = b8.get("batch", batch)
+        name = f"decode_tok_s_per_chip_{b8.get('quant', 'bf16')}"
+        primary = b8["tok_s"]
     else:  # metric label must say what was actually measured
         name = "rollout_decode_tok_s_per_chip"
         primary = (extra.get("bucketed") or {}).get("tok_s", 0.0)
@@ -726,8 +791,11 @@ def child_main() -> None:
     batch = int(os.environ.get("POLYRL_BENCH_BATCH", "256"))
     prompt_len = int(os.environ.get("POLYRL_BENCH_PROMPT", "128"))
     new_tokens = int(os.environ.get("POLYRL_BENCH_NEW", "128"))
+    # Execution ORDER (not just a filter): the unproven headline numbers —
+    # 8B int8, CB serving, weight sync — land first so a narrow tunnel
+    # window captures them before the already-proven (r1/r2) bucketed one.
     phases = os.environ.get(
-        "POLYRL_BENCH_PHASES", "bucketed,cb,spec,weight_sync,8b").split(",")
+        "POLYRL_BENCH_PHASES", "8b,cb,weight_sync,spec,bucketed").split(",")
 
     def run_phase(name: str, fn, store_key: str | None = None) -> None:
         key = store_key or name
@@ -761,46 +829,30 @@ def child_main() -> None:
         _note(key, extra[key])
 
     # ---- first backend dial happens HERE, inside the retry envelope ----
-    # Watchdog: a wedged TPU relay can HANG the dial (not raise) — r3 sat
-    # silently for the driver's whole budget. If the backend + flagship
-    # param build haven't completed within the dial deadline, hard-exit so
-    # the parent retries in a fresh process while wall clock remains.
-    dial_done = threading.Event()
-    dial_deadline = float(os.environ.get("POLYRL_BENCH_DIAL_TIMEOUT", "900"))
+    # Fuse: a wedged TPU relay can HANG the dial (not raise) — r3 sat
+    # silently for the driver's whole budget. A LIVE tunnel dials in
+    # 20-40 s, so 180 s is already generous; the parent's relay pre-probe
+    # means a hung dial past that is a relay that died mid-handshake —
+    # hard-exit so the parent goes back to cheap socket polling.
+    with _hang_fuse("backend dial",
+                    float(os.environ.get("POLYRL_BENCH_DIAL_TIMEOUT",
+                                         "180"))):
+        import jax
+        import jax.numpy as jnp
 
-    def _dial_watchdog() -> None:
-        if not dial_done.wait(dial_deadline):
-            print(f"[bench] backend dial exceeded {dial_deadline:.0f}s — "
-                  "aborting child for a fresh-process retry",
-                  file=sys.stderr, flush=True)
-            os._exit(17)
+        from polyrl_tpu.models import decoder
 
-    threading.Thread(target=_dial_watchdog, daemon=True).start()
-
-    import jax
-    import jax.numpy as jnp
-
-    from polyrl_tpu.models import decoder
-
-    cfg = decoder.get_config(preset, dtype=jnp.bfloat16)
-    needs_flagship = [p for p in ("bucketed", "cb", "spec", "weight_sync")
-                      if p in phases and p not in extra]
-    params = None
-    if needs_flagship:
-        params = jax.jit(lambda: decoder.init_params(jax.random.PRNGKey(0),
-                                                     cfg))()
-        jax.block_until_ready(params)
-    dev = jax.devices()[0]
-    state["meta"] = {
-        "preset": preset, "batch": batch, "prompt_len": prompt_len,
-        "new_tokens": new_tokens, "n_chips": max(len(jax.devices()), 1),
-        "device_kind": getattr(dev, "device_kind", "unknown"),
-    }
-    extra.setdefault("hbm_gb", round(_hbm_limit_gb(), 1))
-    _save_state(state)
-    dial_done.set()
-    _note("dial", {"device": state["meta"]["device_kind"],
-                   "flagship_params_built": bool(needs_flagship)})
+        cfg = decoder.get_config(preset, dtype=jnp.bfloat16)
+        dev = jax.devices()[0]  # the dial the fuse is guarding
+        state["meta"] = {
+            "preset": preset, "preset_8b": preset_8b, "batch": batch,
+            "prompt_len": prompt_len, "new_tokens": new_tokens,
+            "n_chips": max(len(jax.devices()), 1),
+            "device_kind": getattr(dev, "device_kind", "unknown"),
+        }
+        extra.setdefault("hbm_gb", round(_hbm_limit_gb(), 1))
+        _save_state(state)
+    _note("dial", {"device": state["meta"]["device_kind"]})
 
     import numpy as np
 
@@ -811,6 +863,30 @@ def child_main() -> None:
     kind = state["meta"]["device_kind"]
     max_slots = int(os.environ.get("POLYRL_BENCH_SLOTS", "128"))
 
+    # Flagship params build LAZILY so the 8B phase (which allocates its own
+    # ~8.6 GiB int8 tree) can run first without the 1.7B bf16 tree also
+    # resident; they build once at the first flagship phase and are freed
+    # before any later 8B attempt.
+    _params_cell: list = []
+
+    def get_params():
+        if not _params_cell:
+            # its own fuse: the dial fuse is already released here, and a
+            # relay dying mid-compile would otherwise wedge the child for
+            # the parent's whole 2700 s attempt window
+            with _hang_fuse("flagship param build", float(os.environ.get(
+                    "POLYRL_BENCH_COMPILE_TIMEOUT", "420"))):
+                p = jax.jit(lambda: decoder.init_params(
+                    jax.random.PRNGKey(0), cfg))()
+                jax.block_until_ready(p)
+            _params_cell.append(p)
+        return _params_cell[0]
+
+    def free_params() -> None:
+        if _params_cell:
+            _params_cell.clear()
+            gc.collect()
+
     def _with_util(res: dict, key: str, eff_batch: int,
                    pcount: int, pbytes: int) -> dict:
         if isinstance(res, dict) and res.get(key):
@@ -818,38 +894,68 @@ def child_main() -> None:
                                        eff_batch, kind)
         return res
 
-    run_phase("bucketed", lambda: _with_util(
-        bench_bucketed(cfg, params, batch, prompt_len, new_tokens),
-        "tok_s", batch, param_count, param_count * 2))
-    run_phase("cb", lambda: _with_util(
-        bench_cb(cfg, params, batch, prompt_len, new_tokens,
-                 max_slots=max_slots,
-                 steps_per_dispatch=int(os.environ.get("POLYRL_BENCH_K",
-                                                       "8"))),
-        "serve_tok_s", min(max_slots, batch), param_count, param_count * 2))
-    run_phase("spec", lambda: bench_spec(
-        cfg, params, batch=min(batch, 64), prompt_len=prompt_len,
-        new_tokens=new_tokens,
-        spec_tokens=int(os.environ.get("POLYRL_BENCH_SPEC", "4"))))
-    run_phase("weight_sync", lambda: bench_weight_sync(params))
-    if params is not None:
-        del params
-        gc.collect()
-    run_phase("8b", lambda: bench_8b(preset_8b), store_key="llama3_8b")
+    def _run_8b():
+        free_params()
+        return bench_8b(preset_8b)
+
+    phase_table: dict = {
+        "bucketed": (lambda: _with_util(
+            bench_bucketed(cfg, get_params(), batch, prompt_len, new_tokens),
+            "tok_s", batch, param_count, param_count * 2), None),
+        "cb": (lambda: _with_util(
+            bench_cb(cfg, get_params(), batch, prompt_len, new_tokens,
+                     max_slots=max_slots,
+                     steps_per_dispatch=int(os.environ.get("POLYRL_BENCH_K",
+                                                           "8"))),
+            "serve_tok_s", min(max_slots, batch), param_count,
+            param_count * 2), None),
+        "spec": (lambda: bench_spec(
+            cfg, get_params(), batch=min(batch, 64), prompt_len=prompt_len,
+            new_tokens=new_tokens,
+            spec_tokens=int(os.environ.get("POLYRL_BENCH_SPEC", "4"))), None),
+        "weight_sync": (lambda: bench_weight_sync(get_params()), None),
+        "8b": (_run_8b, PHASE_STORE_KEYS["8b"]),
+    }
+    for name in phases:
+        if name not in phase_table:
+            continue
+        fn, store_key = phase_table[name]
+        run_phase(name, fn, store_key=store_key)
+    free_params()
 
     state["result"] = assemble_result(state)
     _save_state(state)
     print(json.dumps(state["result"]))
 
 
-def _emit_partial(note: str) -> None:
+def _emit_partial(note: str, relay_stats: dict | None = None) -> None:
     """Print the state-derived JSON line (partial results beat none)."""
     state = _load_state()
     result = state.get("result") or assemble_result(state)
     result.setdefault("extra", {})["bench_incomplete"] = note[:300]
+    if relay_stats and relay_stats.get("down_polls"):
+        # evidence the window was spent on cheap socket polls, not jax dials
+        result["extra"]["relay"] = relay_stats
     if not result.get("value"):
         result["metric"] = "bench_failed"
     print(json.dumps(result), flush=True)
+
+
+def _refund_unfinished_attempts() -> None:
+    """A child failure observed while the relay is DOWN was (almost surely)
+    caused by the tunnel dying mid-run — refund the retry attempts of every
+    phase that hasn't produced a result, so a tunnel that rises later in
+    the window gets fresh attempts instead of 'phase failed 2x; skipped'."""
+    st = _load_state()
+    done = set(st.get("extra") or {})
+    st["phase_attempts"] = {
+        k: v for k, v in (st.get("phase_attempts") or {}).items()
+        if PHASE_STORE_KEYS.get(k, k) in done}
+    if "phase_errors" in st:
+        st["phase_errors"] = {
+            k: v for k, v in st["phase_errors"].items()
+            if PHASE_STORE_KEYS.get(k, k) in done}
+    _save_state(st)
 
 
 def parent_main() -> None:
@@ -867,6 +973,7 @@ def parent_main() -> None:
     if os.path.exists(STATE_PATH):
         os.remove(STATE_PATH)  # state is per-invocation, not per-round
     child_ref: list = [None]
+    relay_stats = {"down_polls": 0, "down_s": 0.0}
 
     def on_term(signum, frame):  # noqa: ARG001
         # non-reentrant: a second signal mid-emission must not interleave a
@@ -878,7 +985,7 @@ def parent_main() -> None:
                 child_ref[0].kill()
             except OSError:
                 pass
-        _emit_partial(f"killed by signal {signum}")
+        _emit_partial(f"killed by signal {signum}", relay_stats)
         os._exit(0)
 
     signal.signal(signal.SIGTERM, on_term)
@@ -898,8 +1005,25 @@ def parent_main() -> None:
                           sort_keys=True)
 
     prev = snapshot()
-    while (runs < 12 and no_progress < MAX_ATTEMPTS
-           and time.monotonic() - t_start < budget_s):
+    while time.monotonic() - t_start < budget_s:
+        if runs >= 12 or no_progress >= MAX_ATTEMPTS:
+            break  # retry ladder exhausted — emit now, relay state moot
+        # ---- relay pre-probe: NEVER hand a dead relay to a jax dial ----
+        # (r4 post-mortem: two 900 s dead dials ate the whole window). A
+        # down relay costs one 2 s socket probe + a 30 s sleep per poll;
+        # the heartbeat lines make a tunnel-down round diagnosable from
+        # the driver's stderr tail.
+        if _relay_required() and not _relay_up():
+            relay_stats["down_polls"] += 1
+            remaining = budget_s - (time.monotonic() - t_start)
+            print(f"[bench] relay 127.0.0.1:{RELAY_PROBE_PORT} DOWN "
+                  f"(poll {relay_stats['down_polls']}, "
+                  f"{remaining:.0f}s of budget left) — sleeping "
+                  f"{RELAY_POLL_S:.0f}s", file=sys.stderr, flush=True)
+            nap = min(RELAY_POLL_S, max(remaining, 0.0))
+            time.sleep(nap)
+            relay_stats["down_s"] = round(relay_stats["down_s"] + nap, 1)
+            continue  # polls consume neither runs nor the progress streak
         runs += 1
         print(f"[bench] child run {runs} (no-progress streak {no_progress})",
               file=sys.stderr, flush=True)
@@ -927,12 +1051,25 @@ def parent_main() -> None:
         if rc == 0 and out.strip():
             sys.stdout.write(out.strip().splitlines()[-1] + "\n")
             return
+        if _relay_required() and not _relay_up():
+            # the tunnel died mid-child: that's a relay failure, not a
+            # phase failure — refund unfinished phases' attempts and go
+            # back to cheap polling without burning the progress streak
+            _refund_unfinished_attempts()
+            print("[bench] relay found DOWN after failed child — attempts "
+                  "refunded, returning to socket polling",
+                  file=sys.stderr, flush=True)
+            prev = snapshot()
+            continue
         cur = snapshot()
         no_progress = 0 if cur != prev else no_progress + 1
         prev = cur
         time.sleep(RETRY_SLEEP_S)  # give the TPU relay time to recover
     # exhausted: print whatever the state file accumulated
-    _emit_partial(last_err or "wall budget exhausted")
+    _emit_partial(last_err or (
+        "relay never rose; polled the whole window"
+        if relay_stats["down_polls"] and not runs else "wall budget exhausted"),
+        relay_stats)
 
 
 if __name__ == "__main__":
