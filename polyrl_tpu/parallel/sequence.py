@@ -12,6 +12,15 @@ one signature: q/k/v are [B, T, H, D] logically-global arrays sharded
 P(batch, sp, None, None); ``token_mask`` is [B, T] validity (left-pad
 aware); causal masking over GLOBAL positions is applied internally.
 
+``packed=True`` returns the segment-aware variant — signature gains a
+``segment_ids`` [B, T] argument (0 = pad, 1-based per row) and attention is
+block-diagonal within segments, composing remove-padding training with SP
+exactly as the reference's Ulysses slices packed varlen inputs
+(``stream_dp_actor.py:37-47,135`` — its default long-context mode). A
+packed segment may SPAN the rank boundary: the all-to-all / ring exchange
+re-unifies the sequence before masking, so equality against gathered (or
+rotating) segment ids is exact regardless of where the slice fell.
+
 - Ulysses: all-to-all redistributes heads<->sequence so each rank computes
   full-sequence attention for H/sp heads — one cheap ICI all-to-all each
   way, best when H >= sp.
@@ -57,27 +66,48 @@ def _expand_kv_minimal(k, v, hq: int, sp: int):
 
 
 def make_ulysses_attention(mesh: Mesh, axis: str = SP,
-                           batch_axes=(DP, FSDP)):
+                           batch_axes=(DP, FSDP), packed: bool = False):
     """Returns attn_fn(q, k, v, token_mask) -> out, all [B, T, H, D] with the
     seq dim sharded over ``axis``. Ulysses ≙ all-to-all head redistribution
-    (verl's FSDPUlyssesShardingManager equivalent)."""
+    (verl's FSDPUlyssesShardingManager equivalent). ``packed=True``: the fn
+    takes a trailing ``segment_ids`` and the gathered full-sequence
+    attention runs the SAME segment-id flash kernel as the non-SP packed
+    path (Pallas on TPU, dense fallback elsewhere — ops/flash.py)."""
     sp = mesh.shape[axis]
 
-    def inner(q, k, v, token_mask):
+    def _exchange(q, k, v):
         # local: q [B, Ts, Hq, D]; all_to_all -> [B, T, Hq/sp, D]
-        hq = q.shape[2]
-        k, v = _expand_kv_minimal(k, v, hq, sp)
+        k, v = _expand_kv_minimal(k, v, q.shape[2], sp)
         q_g = lax.all_to_all(q, axis, split_axis=2, concat_axis=1, tiled=True)
         k_g = lax.all_to_all(k, axis, split_axis=2, concat_axis=1, tiled=True)
         v_g = lax.all_to_all(v, axis, split_axis=2, concat_axis=1, tiled=True)
+        return q_g, k_g, v_g
+
+    def inner(q, k, v, token_mask):
+        q_g, k_g, v_g = _exchange(q, k, v)
         mask_g = lax.all_gather(token_mask, axis, axis=1, tiled=True)  # [B, T]
         t = q_g.shape[1]
         mask = causal_mask(t, t)[None, None, :, :] & (mask_g[:, None, None, :] > 0)
         out = attention(q_g, k_g, v_g, mask=mask)        # [B, T, Hq/sp, D]
         return lax.all_to_all(out, axis, split_axis=1, concat_axis=2, tiled=True)
 
+    def inner_packed(q, k, v, token_mask, segment_ids):
+        from polyrl_tpu.ops import flash
+
+        q_g, k_g, v_g = _exchange(q, k, v)
+        mask_g = lax.all_gather(token_mask, axis, axis=1, tiled=True)
+        seg_g = lax.all_gather(segment_ids, axis, axis=1, tiled=True)
+        out = flash.flash_attention_train(q_g, k_g, v_g, mask_g, causal=True,
+                                          segment_ids=seg_g)
+        return lax.all_to_all(out, axis, split_axis=1, concat_axis=2, tiled=True)
+
     qkv_spec = P(batch_axes, axis, None, None)
     mask_spec = P(batch_axes, axis)
+    if packed:
+        return jax.shard_map(
+            inner_packed, mesh=mesh,
+            in_specs=(qkv_spec, qkv_spec, qkv_spec, mask_spec, mask_spec),
+            out_specs=qkv_spec, check_vma=False)
     return jax.shard_map(inner, mesh=mesh,
                          in_specs=(qkv_spec, qkv_spec, qkv_spec, mask_spec),
                          out_specs=qkv_spec, check_vma=False)
@@ -88,14 +118,17 @@ def make_ulysses_attention(mesh: Mesh, axis: str = SP,
 # --------------------------------------------------------------------------
 
 
-def make_ring_attention(mesh: Mesh, axis: str = SP, batch_axes=(DP, FSDP)):
+def make_ring_attention(mesh: Mesh, axis: str = SP, batch_axes=(DP, FSDP),
+                        packed: bool = False):
     """Returns attn_fn(q, k, v, token_mask) -> out. Blockwise attention with
     K/V rotating over the sp ring (ppermute) and online-softmax merging —
-    the TPU context-parallel mode SURVEY §2.3 calls for."""
+    the TPU context-parallel mode SURVEY §2.3 calls for. ``packed=True``:
+    segment ids rotate WITH their K/V block and the mask adds same-segment
+    equality (block-diagonal packed attention)."""
     sp = mesh.shape[axis]
     perm = [(i, (i + 1) % sp) for i in range(sp)]
 
-    def inner(q, k, v, token_mask):
+    def inner(q, k, v, token_mask, segment_ids=None):
         # GQA-native: heads never leave their rank in ring attention, so KV
         # is NOT expanded at all — the rotating K/V blocks stay at hkv heads
         # (the dominant memory/ICI cost) and Q heads group against their
@@ -111,7 +144,7 @@ def make_ring_attention(mesh: Mesh, axis: str = SP, batch_axes=(DP, FSDP)):
         m = jnp.full((b, hkv, g, tq), _NEG, jnp.float32)
         l = jnp.zeros((b, hkv, g, tq), jnp.float32)
         o = jnp.zeros((b, tq, hkv, g, d), jnp.float32)
-        k_cur, v_cur, mask_cur = k, v, token_mask
+        k_cur, v_cur, mask_cur, seg_cur = k, v, token_mask, segment_ids
 
         for step in range(sp):
             src = (idx - step) % sp  # block id currently held
@@ -121,6 +154,9 @@ def make_ring_attention(mesh: Mesh, axis: str = SP, batch_axes=(DP, FSDP)):
             kv_pos = src * tk + jnp.arange(tk)
             ok = (kv_pos[None, :] <= q_pos[:, None])[None, None, None, :, :]
             ok = ok & (mask_cur[:, None, None, None, :] > 0)
+            if seg_cur is not None:
+                ok = ok & (segment_ids[:, :, None]
+                           == seg_cur[:, None, :])[:, None, None, :, :]
             logits = jnp.where(ok, logits, _NEG)
             m_new = jnp.maximum(m, logits.max(axis=-1))
             p = jnp.exp(logits - m_new[..., None])
@@ -134,24 +170,32 @@ def make_ring_attention(mesh: Mesh, axis: str = SP, batch_axes=(DP, FSDP)):
                 k_cur = lax.ppermute(k_cur, axis, perm)
                 v_cur = lax.ppermute(v_cur, axis, perm)
                 mask_cur = lax.ppermute(mask_cur, axis, perm)
+                if seg_cur is not None:
+                    seg_cur = lax.ppermute(seg_cur, axis, perm)
 
         denom = jnp.maximum(l, 1e-30).transpose(0, 3, 1, 2)[..., None]
         return (o / denom).reshape(b, tq, hq, d).astype(q.dtype)
 
     qkv_spec = P(batch_axes, axis, None, None)
     mask_spec = P(batch_axes, axis)
-    return jax.shard_map(inner, mesh=mesh,
-                         in_specs=(qkv_spec, qkv_spec, qkv_spec, mask_spec),
-                         out_specs=qkv_spec, check_vma=False)
+    if packed:
+        return jax.shard_map(
+            inner, mesh=mesh,
+            in_specs=(qkv_spec, qkv_spec, qkv_spec, mask_spec, mask_spec),
+            out_specs=qkv_spec, check_vma=False)
+    return jax.shard_map(
+        lambda q, k, v, tm: inner(q, k, v, tm), mesh=mesh,
+        in_specs=(qkv_spec, qkv_spec, qkv_spec, mask_spec),
+        out_specs=qkv_spec, check_vma=False)
 
 
 def make_sp_attention(mesh: Mesh, mode: str, axis: str = SP,
-                      batch_axes=(DP, FSDP)):
+                      batch_axes=(DP, FSDP), packed: bool = False):
     """Dispatch: 'ulysses' | 'ring' | 'dense' (None)."""
     if mode == "ulysses":
-        return make_ulysses_attention(mesh, axis, batch_axes)
+        return make_ulysses_attention(mesh, axis, batch_axes, packed=packed)
     if mode == "ring":
-        return make_ring_attention(mesh, axis, batch_axes)
+        return make_ring_attention(mesh, axis, batch_axes, packed=packed)
     if mode in ("dense", "none", None):
         return None
     raise ValueError(f"unknown sp attention mode {mode!r}")

@@ -234,6 +234,7 @@ def build_trainer(cfg: RunConfig, cleanup: list | None = None):
     # fail before the manager/fabric/reward workers are spawned and torn
     # back down on every attempt
     attn_fn = None
+    packed_attn_fn = None
     if mesh is not None and mesh.shape.get("sp", 1) > 1:
         # long-context: shard the sequence dim with a dedicated SP attention
         # (Ulysses all-to-all / ring ppermute) instead of whatever GSPMD
@@ -241,11 +242,6 @@ def build_trainer(cfg: RunConfig, cleanup: list | None = None):
         from polyrl_tpu.parallel.sequence import make_sp_attention
 
         sp = mesh.shape["sp"]
-        if cfg.trainer.use_remove_padding:
-            raise NotImplementedError(
-                "use_remove_padding with parallel.sp > 1 is not supported "
-                "yet — the packed passes run their own segment-id flash "
-                "attention; run packed OR sequence-parallel")
         if mesh.shape.get("tp", 1) > 1:
             raise NotImplementedError(
                 "parallel.sp > 1 with parallel.tp > 1 is not supported: the "
@@ -256,6 +252,21 @@ def build_trainer(cfg: RunConfig, cleanup: list | None = None):
                 f"ulysses SP needs num_heads ({mcfg.num_heads}) divisible "
                 f"by sp ({sp}); use sp_mode=ring or a different sp")
         attn_fn = make_sp_attention(mesh, cfg.parallel.sp_mode)
+        if cfg.trainer.use_remove_padding:
+            # packed (remove-padding) long-context training composes with
+            # SP via the segment-aware variant — the reference's default
+            # long-context configuration (Ulysses over PACKED varlen
+            # inputs, stream_dp_actor.py:37-47,135). The trainer rounds
+            # pack_len up to a multiple of sp (_pack_geometry). Only
+            # ulysses/ring have the segment-aware path; 'dense' under
+            # sp>1 would silently hand GSPMD an unvalidated composition.
+            if cfg.parallel.sp_mode not in ("ulysses", "ring"):
+                raise NotImplementedError(
+                    "use_remove_padding with parallel.sp > 1 requires "
+                    "sp_mode=ulysses or ring (segment-aware SP attention); "
+                    f"got sp_mode={cfg.parallel.sp_mode!r}")
+            packed_attn_fn = make_sp_attention(mesh, cfg.parallel.sp_mode,
+                                               packed=True)
 
     layers_fn = None
     critic_layers_fn = None
@@ -316,14 +327,15 @@ def build_trainer(cfg: RunConfig, cleanup: list | None = None):
                               shuffle=cfg.data.shuffle, seed=cfg.data.seed)
 
     actor = StreamActor(mcfg, cfg.actor, params, mesh=mesh, attn_fn=attn_fn,
-                        layers_fn=layers_fn)
+                        layers_fn=layers_fn, packed_attn_fn=packed_attn_fn)
     critic = None
     if cfg.trainer.adv_estimator == "gae":
         import jax
 
         critic = StreamCritic(mcfg, cfg.critic, init_critic_params(
             jax.random.PRNGKey(cfg.trainer.seed + 1), mcfg), mesh=mesh,
-            attn_fn=attn_fn, layers_fn=critic_layers_fn)
+            attn_fn=attn_fn, layers_fn=critic_layers_fn,
+            packed_attn_fn=packed_attn_fn)
     # ReferencePolicy stays mesh-FREE deliberately: its params are a local
     # replicated copy and its feeds arrive as host numpy on every process —
     # a mesh-bound shard_map attn_fn would drag the global mesh into a

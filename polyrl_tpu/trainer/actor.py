@@ -125,7 +125,7 @@ def _model_logprobs_entropy(params, model_cfg, input_ids, positions, attn_mask,
 
 def _packed_logprobs_entropy(params, model_cfg, input_ids, positions,
                              attn_mask, segment_ids, remat, compute_entropy,
-                             loss_mask=None):
+                             loss_mask=None, attn_fn=None):
     """Packed-row (remove-padding) variant: rows hold several trajectories
     separated by segment ids (reference use_remove_padding + flash varlen,
     stream_dp_actor.py:41-47). Returns per-COLUMN logprobs [R, L]: column t
@@ -136,11 +136,22 @@ def _packed_logprobs_entropy(params, model_cfg, input_ids, positions,
     ``loss_mask`` (optional, [R, L]) enables the same double-where finiteness
     guard as the padded path: logits at columns outside the mask are zeroed
     BEFORE the logprob computation so a NaN there (pack-padding columns)
-    cannot reach the forward value or the gradient."""
+    cannot reach the forward value or the gradient.
+
+    ``attn_fn`` (optional): a segment-aware SP attention
+    (parallel.sequence.make_sp_attention(packed=True)) — signature
+    (q, k, v, token_mask, segment_ids) — so packed training composes with
+    sp > 1 (the reference's default long-context configuration,
+    stream_dp_actor.py:37-47,135); defaults to the single-logical-device
+    segment-id flash kernel."""
     from polyrl_tpu.ops import flash
 
-    attn = lambda q, k, v, am: flash.flash_attention_train(  # noqa: E731
-        q, k, v, am, causal=True, segment_ids=segment_ids)
+    if attn_fn is None:
+        attn = lambda q, k, v, am: flash.flash_attention_train(  # noqa: E731
+            q, k, v, am, causal=True, segment_ids=segment_ids)
+    else:
+        attn = lambda q, k, v, am: attn_fn(  # noqa: E731
+            q, k, v, am, segment_ids)
     logits, _ = decoder.forward(params, model_cfg, input_ids, positions,
                                 attn_mask, remat=remat, attn_fn=attn)
     pred = logits[:, :-1, :]
@@ -171,12 +182,16 @@ class StreamActor:
         mesh=None,
         attn_fn=None,
         layers_fn=None,
+        packed_attn_fn=None,
     ):
         self.model_cfg = model_cfg
         self.cfg = cfg
         self.mesh = mesh
         self.attn_fn = attn_fn if attn_fn is not None else default_train_attention()
         self.layers_fn = layers_fn  # pipeline-parallel layer stack (pp > 1)
+        # segment-aware SP attention for the packed (remove-padding) passes;
+        # None → the single-logical-device segment-id flash kernel
+        self.packed_attn_fn = packed_attn_fn
         self._lora = cfg.lora_rank > 0
         if self._lora:
             from polyrl_tpu.models import lora as lora_mod
@@ -273,7 +288,7 @@ class StreamActor:
                 batch["input_ids"], batch["positions"],
                 batch["attention_mask"], batch["segment_ids"],
                 cfg.remat, cfg.entropy_coeff != 0.0,
-                loss_mask=batch["loss_mask"],
+                loss_mask=batch["loss_mask"], attn_fn=self.packed_attn_fn,
             )
             batch = dict(batch, response_mask=batch["loss_mask"])
         else:
@@ -431,7 +446,8 @@ class StreamActor:
         if key not in self._logprob_fns:
             self._logprob_fns[key] = jax.jit(
                 partial(_packed_logprobs_entropy, remat=False,
-                        compute_entropy=compute_entropy),
+                        compute_entropy=compute_entropy,
+                        attn_fn=self.packed_attn_fn),
                 static_argnums=(1,),
             )
         return self._logprob_fns[key](

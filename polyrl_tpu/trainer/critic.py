@@ -68,18 +68,23 @@ def forward_values(params, model_cfg, input_ids, positions, attn_mask, responses
 
 
 def forward_values_packed(params, model_cfg, input_ids, positions, attn_mask,
-                          segment_ids, remat, loss_mask=None):
+                          segment_ids, remat, loss_mask=None, attn_fn=None):
     """Per-column values [R, L] on the packed (remove-padding) layout
     (reference packed critic, stream_dp_critic.py:35,83): column t holds the
     value predicted from column t-1 — the same one-left shift as
     ``forward_values`` and the packed logprob pass, so the caller's
     loss_mask/gather spec selects response-token values directly.
     ``loss_mask`` zeroes columns outside the mask (finiteness guard, same
-    double-where rationale as the actor's packed pass)."""
+    double-where rationale as the actor's packed pass). ``attn_fn``:
+    optional segment-aware SP attention (see the actor's packed pass)."""
     from polyrl_tpu.ops import flash
 
-    attn = lambda q, k, v, am: flash.flash_attention_train(  # noqa: E731
-        q, k, v, am, causal=True, segment_ids=segment_ids)
+    if attn_fn is None:
+        attn = lambda q, k, v, am: flash.flash_attention_train(  # noqa: E731
+            q, k, v, am, causal=True, segment_ids=segment_ids)
+    else:
+        attn = lambda q, k, v, am: attn_fn(  # noqa: E731
+            q, k, v, am, segment_ids)
     value_params = dict(params)
     head = value_params.pop("value_head")
     value_params["lm_head"] = head
@@ -95,7 +100,8 @@ def forward_values_packed(params, model_cfg, input_ids, positions, attn_mask,
 
 class StreamCritic:
     def __init__(self, model_cfg: decoder.ModelConfig, cfg: CriticConfig,
-                 params: Any, mesh=None, attn_fn=None, layers_fn=None):
+                 params: Any, mesh=None, attn_fn=None, layers_fn=None,
+                 packed_attn_fn=None):
         from polyrl_tpu.trainer.actor import default_train_attention
 
         self.model_cfg = model_cfg
@@ -103,6 +109,7 @@ class StreamCritic:
         self.mesh = mesh
         self.attn_fn = attn_fn if attn_fn is not None else default_train_attention()
         self.layers_fn = layers_fn  # pipeline-parallel layer stack (pp > 1)
+        self.packed_attn_fn = packed_attn_fn  # see StreamActor
         if mesh is not None:
             # backbone leaves follow decoder.param_specs; critic-only leaves
             # (the [D, 1] value head) fall back to replicated
@@ -127,7 +134,7 @@ class StreamCritic:
                 params, self.model_cfg, batch["input_ids"],
                 batch["positions"], batch["attention_mask"],
                 batch["segment_ids"], self.cfg.remat,
-                loss_mask=batch["loss_mask"],
+                loss_mask=batch["loss_mask"], attn_fn=self.packed_attn_fn,
             )
             mask = batch["loss_mask"]
         else:
@@ -224,6 +231,7 @@ class StreamCritic:
                     p, self.model_cfg, b["input_ids"], b["positions"],
                     b["attention_mask"], b["segment_ids"], False,
                     loss_mask=b.get("loss_mask"),
+                    attn_fn=self.packed_attn_fn,
                 )
             )
         return self._value_fn_packed(self.params, batch)

@@ -540,10 +540,22 @@ class StreamRLTrainer:
     def _pack_geometry(self) -> tuple[int, int]:
         cfg = self.cfg
         pack_len = cfg.pack_len or (cfg.max_prompt_length + cfg.max_response_length)
+        mesh = getattr(self.actor, "mesh", None)
+        if mesh is not None:
+            # packed × SP: the pack columns shard over sp (shard_map needs
+            # even slices), and the rows over the batch axes — round both
+            # up so any configured budget produces a shardable grid
+            sp = mesh.shape.get("sp", 1)
+            pack_len = -(-pack_len // sp) * sp
         if cfg.micro_token_budget > 0:
             n_rows = max(1, cfg.micro_token_budget // pack_len)
         else:
             n_rows = cfg.micro_batch_size
+        if mesh is not None:
+            # round DOWN (floor one full shard): rounding up could exceed
+            # micro_token_budget — the HBM guard it exists to be
+            rows_div = mesh.shape.get("dp", 1) * mesh.shape.get("fsdp", 1)
+            n_rows = max(rows_div, n_rows // rows_div * rows_div)
         return pack_len, n_rows
 
     def _packed_logprob_pass(self, ibatch: TensorBatch,
