@@ -21,6 +21,15 @@ packed segment may SPAN the rank boundary: the all-to-all / ring exchange
 re-unifies the sequence before masking, so equality against gathered (or
 rotating) segment ids is exact regardless of where the slice fell.
 
+SP composes with TENSOR parallelism: the head dim of q/k/v is sharded over
+``tp`` in the shard_map specs, so tp-sharded projections feed straight in
+with no head all-gather. Ring attention never moves heads, so tp>1 is free;
+Ulysses all-to-alls each tp shard's LOCAL heads over sp (correct because
+heads shard contiguously over tp first: local q head j maps to local KV
+head j // (Hq/Hkv) exactly as in the global layout, given Hkv % tp == 0 —
+the constraint tp decoding already imposes). Ulysses therefore needs
+``num_heads % (tp * sp) == 0``; train.py validates.
+
 - Ulysses: all-to-all redistributes heads<->sequence so each rank computes
   full-sequence attention for H/sp heads — one cheap ICI all-to-all each
   way, best when H >= sp.
@@ -39,7 +48,7 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from polyrl_tpu.ops.attention import attention, causal_mask, repeat_kv
-from polyrl_tpu.parallel.mesh import DP, FSDP, SP
+from polyrl_tpu.parallel.mesh import DP, FSDP, SP, TP
 
 _NEG = -0.7 * float(jnp.finfo(jnp.float32).max)  # finite -inf (no exp NaNs)
 
@@ -101,7 +110,7 @@ def make_ulysses_attention(mesh: Mesh, axis: str = SP,
                                           segment_ids=seg_g)
         return lax.all_to_all(out, axis, split_axis=1, concat_axis=2, tiled=True)
 
-    qkv_spec = P(batch_axes, axis, None, None)
+    qkv_spec = P(batch_axes, axis, TP, None)  # heads stay tp-sharded
     mask_spec = P(batch_axes, axis)
     if packed:
         return jax.shard_map(
@@ -176,7 +185,7 @@ def make_ring_attention(mesh: Mesh, axis: str = SP, batch_axes=(DP, FSDP),
         denom = jnp.maximum(l, 1e-30).transpose(0, 3, 1, 2)[..., None]
         return (o / denom).reshape(b, tq, hq, d).astype(q.dtype)
 
-    qkv_spec = P(batch_axes, axis, None, None)
+    qkv_spec = P(batch_axes, axis, TP, None)  # heads stay tp-sharded
     mask_spec = P(batch_axes, axis)
     if packed:
         return jax.shard_map(

@@ -242,15 +242,16 @@ def build_trainer(cfg: RunConfig, cleanup: list | None = None):
         from polyrl_tpu.parallel.sequence import make_sp_attention
 
         sp = mesh.shape["sp"]
-        if mesh.shape.get("tp", 1) > 1:
-            raise NotImplementedError(
-                "parallel.sp > 1 with parallel.tp > 1 is not supported: the "
-                "SP attention replicates the head dim, which would silently "
-                "all-gather tensor-parallel q/k/v every layer")
-        if cfg.parallel.sp_mode == "ulysses" and mcfg.num_heads % sp != 0:
+        # SP × TP composes: the SP attention keeps the head dim sharded
+        # over tp (parallel/sequence.py specs), so tp-sharded projections
+        # feed in with no head all-gather. Ulysses all-to-alls each tp
+        # shard's LOCAL heads over sp → needs num_heads % (tp*sp) == 0;
+        # ring never moves heads, so it has no extra constraint.
+        tp = mesh.shape.get("tp", 1)
+        if cfg.parallel.sp_mode == "ulysses" and mcfg.num_heads % (sp * tp):
             raise ValueError(
                 f"ulysses SP needs num_heads ({mcfg.num_heads}) divisible "
-                f"by sp ({sp}); use sp_mode=ring or a different sp")
+                f"by sp*tp ({sp}*{tp}); use sp_mode=ring or different axes")
         attn_fn = make_sp_attention(mesh, cfg.parallel.sp_mode)
         if cfg.trainer.use_remove_padding:
             # packed (remove-padding) long-context training composes with
