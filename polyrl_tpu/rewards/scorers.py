@@ -112,6 +112,22 @@ def compute_score_math(solution_str: str, ground_truth: str) -> float:
     return 0.0
 
 
+_GEO3K_FORMAT_RE = re.compile(r"<think>.*</think>.*\\boxed\{.*\}.*",
+                              re.DOTALL)
+
+
+def compute_score_geo3k(solution_str: str, ground_truth: str) -> float:
+    """Geometry3k (reference dispatch row reward_score/__init__.py:92-95 →
+    verl's geo3k scorer): 0.9 × boxed-answer accuracy + 0.1 × format reward
+    (a full ``<think>…</think> … \\boxed{}`` trace). The accuracy half
+    reuses the boxed-math equivalence grader; the multimodal (image) input
+    side rides the normal prompt path — scoring is text-only, as in the
+    reference."""
+    acc = compute_score_math(solution_str, ground_truth)
+    fmt = 1.0 if _GEO3K_FORMAT_RE.fullmatch(solution_str) else 0.0
+    return 0.9 * acc + 0.1 * fmt
+
+
 def compute_score_math_dapo(
     solution_str: str,
     ground_truth: str,
@@ -292,8 +308,9 @@ def default_compute_score(
         return compute_score_math_dapo(solution_str, ground_truth)
     if any(k in ds for k in ("numina", "prime_math")):
         return compute_score_prime_math(solution_str, ground_truth)
-    if any(k in ds for k in ("math", "openr1", "deepscaler", "geometry3k")):
-        # geometry3k's vision-aware scorer reduces to boxed-math compare here
+    if any(k in ds for k in ("geometry3k", "geo3k")):
+        return compute_score_geo3k(solution_str, ground_truth)
+    if any(k in ds for k in ("math", "openr1", "deepscaler")):
         return compute_score_math(solution_str, ground_truth)
     if any(k in ds for k in ("code", "apps", "taco", "codeforces")):
         return compute_score_code(solution_str, ground_truth, extra_info,

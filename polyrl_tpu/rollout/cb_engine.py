@@ -1536,24 +1536,41 @@ class CBEngine:
                 self._fetch_inflight = len(batch)
                 epoch = self._fetch_epoch
             t0 = time.monotonic()
+            handed_off = False
             try:
-                fetched = jax.device_get([e[1] for e in batch])
-            except Exception as exc:  # noqa: BLE001 — surface on the
-                # loop thread (next drain) where _recover can reset pools;
-                # true BaseExceptions (SystemExit et al) must NOT be
-                # forwarded: _loop only recovers from Exception
+                try:
+                    fetched = jax.device_get([e[1] for e in batch])
+                except Exception as exc:  # noqa: BLE001 — surface on the
+                    # loop thread (next drain) where _recover can reset
+                    # pools; true BaseExceptions (SystemExit et al) must
+                    # NOT be forwarded: _loop only recovers from Exception
+                    with cv:
+                        self._fetch_inflight = 0
+                        if epoch == self._fetch_epoch:
+                            self._fetch_exc = exc
+                        cv.notify_all()
+                    handed_off = True
+                    continue
+                self._tmark("fetch", t0)
                 with cv:
+                    self._fetched_q.extend(
+                        (epoch, e, a) for e, a in zip(batch, fetched))
                     self._fetch_inflight = 0
-                    if epoch == self._fetch_epoch:
-                        self._fetch_exc = exc
                     cv.notify_all()
-                continue
-            self._tmark("fetch", t0)
-            with cv:
-                self._fetched_q.extend(
-                    (epoch, e, a) for e, a in zip(batch, fetched))
-                self._fetch_inflight = 0
-                cv.notify_all()
+                handed_off = True
+            finally:
+                if not handed_off:
+                    # a BaseException is killing this thread mid-batch:
+                    # requeue the batch (front, preserving FIFO) and zero
+                    # the inflight count so _drain_emit_q's accounting
+                    # stays consistent and its dead-fetcher fallback can
+                    # fetch these entries synchronously — otherwise the
+                    # loop thread (and every HTTP handler) wedges forever
+                    with cv:
+                        for e in reversed(batch):
+                            self._emit_q.appendleft(e)
+                        self._fetch_inflight = 0
+                        cv.notify_all()
 
     def _drain_emit_q(self, keep: int = 0) -> None:
         """Stream out every dispatch output the fetcher has landed, bringing
@@ -1581,10 +1598,14 @@ class CBEngine:
                 if (len(self._emit_q) + self._fetch_inflight
                         + len(self._fetched_q) <= keep):
                     return
-            if self._stop.is_set():
-                # the fetcher exits on stop() even with entries queued;
-                # finish the drain synchronously so the loop thread can
-                # observe _stop and join instead of waiting out the timeout.
+            fetcher_dead = (self._fetch_thread is not None
+                            and not self._fetch_thread.is_alive())
+            if self._stop.is_set() or fetcher_dead:
+                # the fetcher exits on stop() even with entries queued — or
+                # died on a BaseException (its finally requeued the batch
+                # and zeroed inflight); finish the drain synchronously so
+                # the loop thread can observe _stop / keep serving instead
+                # of waiting out the timeout.
                 # FIFO: if the fetcher still owns an older in-flight batch,
                 # wait for it to land rather than fetching newer entries
                 # past it (out-of-order emission corrupts the mirrors); the
@@ -1594,8 +1615,12 @@ class CBEngine:
                     if self._fetch_inflight:
                         cv.wait(timeout=0.2)
                         continue
-                    batch = list(self._emit_q)
-                    self._emit_q.clear()
+                    # respect ``keep``: a dead fetcher must not turn the
+                    # steady-state drain into a full barrier that stalls
+                    # on just-dispatched device work
+                    n = len(self._emit_q) - keep
+                    batch = [self._emit_q.popleft()
+                             for _ in range(max(0, n))]
                     epoch = self._fetch_epoch
                 if batch:
                     fetched = jax.device_get([e[1] for e in batch])

@@ -447,17 +447,25 @@ class RolloutServer:
                 # tree_map over engine.params
                 install, device_named = make_incremental_installer(
                     self.engine.params)
-                self.receiver.wait_for_version(
+                # record the version actually INSTALLED: when a
+                # superseding round's bytes landed instead, reporting the
+                # older requested version would under-report until the
+                # newer push's own update call (advisor r4)
+                installed = self.receiver.wait_for_version(
                     version, timeout=self.weight_sync_timeout_s,
                     on_tensor=install)
+                if installed is None:  # pre-r5 receiver contract
+                    installed = version
                 new_params = unflatten_like(template, device_named)
                 with self._weight_lock:  # not mid-batch
                     self.engine.params = new_params
-                    self.engine.weight_version = version
+                    self.engine.weight_version = installed
                     self._flush_engine_prefix_cache()
                 return True, ""
-            self.receiver.wait_for_version(
+            installed = self.receiver.wait_for_version(
                 version, timeout=self.weight_sync_timeout_s)
+            if installed is None:  # pre-r5 receiver contract
+                installed = version
             named = unpack_params(self.receiver.buffer, self.receiver.layout)
             new_params = unflatten_like(template, named)
             if self.weight_apply is not None:
@@ -467,7 +475,7 @@ class RolloutServer:
                 with self._weight_lock:
                     self.engine.params = self.weight_apply(
                         self.engine.params, new_params)
-                    self.engine.weight_version = version
+                    self.engine.weight_version = installed
                     self._flush_engine_prefix_cache()
                 return True, ""
             if self.weight_preprocess is not None:
@@ -478,7 +486,7 @@ class RolloutServer:
                     lambda o, n: jax.device_put(
                         np.asarray(n).astype(o.dtype), o.sharding), old,
                     new_params)
-                self.engine.weight_version = version
+                self.engine.weight_version = installed
                 self._flush_engine_prefix_cache()
             return True, ""
         except Exception as exc:  # noqa: BLE001

@@ -156,9 +156,13 @@ class ReceiverAgent:
                 backoff = min(backoff * 2, 5.0)
 
     def wait_for_version(self, version: int, timeout: float = 600.0,
-                         on_tensor=None) -> None:
+                         on_tensor=None) -> int:
         """Block until weights of at least ``version`` are in the buffer
         (the reference's 'receive_weights' wait, receiver_agent.py:257-268).
+        Returns the version whose bytes were actually installed — ≥ the
+        requested one when a superseding round landed instead (callers
+        recording ``engine.weight_version`` must use the RETURN value, not
+        the request, or they under-report until the next push).
 
         ``on_tensor(entry, np_view)``: incremental install hook — invoked
         IN LAYOUT ORDER for each tensor whose bytes have fully landed,
@@ -168,7 +172,15 @@ class ReceiverAgent:
         snapshot), so a completed tensor never changes within a round. If
         a retry/newer round supersedes the one being tailed, every tensor
         is re-emitted from the final buffer — the consumer must treat
-        emissions as idempotent upserts by name."""
+        emissions as idempotent upserts by name.
+
+        The install lock is dropped BETWEEN tensor emissions (advisor r4:
+        ``on_tensor`` is a device_put that can take seconds, and the
+        sender's prepare→ready gate is 60 s — holding the lock across a
+        whole emission batch starved back-to-back pushes into spurious
+        manager aborts). A prepare arriving between two tensors arms the
+        new round; the next iteration observes it under the lock and stops
+        reading the old bytes before any stream can overwrite them."""
         deadline = time.monotonic() + timeout
         emitted = 0
         tail_round = None
@@ -178,16 +190,21 @@ class ReceiverAgent:
             nonlocal emitted, tail_round
             if on_tensor is None:
                 return
-            with self._version_cv:
-                armed = self._armed_version
-            if armed != target:  # only tail the round we are waiting on
-                return
-            with self._install_lock:
-                rnd = self.sockets._round
-                if rnd != tail_round:
-                    tail_round, emitted = rnd, 0  # retry round: start over
-                for e in covered_entries(self.layout,
-                                         self.sockets.coverage(), emitted):
+            while True:
+                with self._version_cv:
+                    armed = self._armed_version
+                if armed != target:  # only tail the round we wait on
+                    return
+                with self._install_lock:
+                    rnd = self.sockets._round
+                    if rnd != tail_round:
+                        tail_round, emitted = rnd, 0  # retry: start over
+                    es = covered_entries(self.layout,
+                                         self.sockets.coverage(), emitted,
+                                         limit=1)
+                    if not es:
+                        return
+                    e = es[0]  # ONE tensor per lock hold (see docstring)
                     on_tensor(e, self.buffer[e.offset : e.offset + e.nbytes])
                     emitted += 1
 
@@ -216,27 +233,41 @@ class ReceiverAgent:
                         self._version_cv.wait(min(left, 1.0))
                 final = self.version
             if on_tensor is None:
-                return
-            # completion tail, under the install lock (the NEXT round's
-            # prepare blocks until these buffer reads are done)
-            with self._install_lock:
-                with self._version_cv:
-                    armed = self._armed_version
-                    cur = self.version
-                if armed > cur:
-                    # a SUPERSEDING round armed before we got here: its
-                    # streams are landing over the buffer right now, so the
-                    # bytes are not ours to read — install that round
-                    # instead once it completes (still "at least version")
-                    target = armed
-                    emitted, tail_round = 0, None
-                    continue
-                if final != target or tail_round is None \
-                        or self.sockets._round != tail_round:
-                    emitted = 0
-                for e in self.layout.entries[emitted:]:
+                return final
+            # completion tail: emit the remaining entries, one lock hold
+            # per tensor (the NEXT round's prepare waits out at most one
+            # device_put, not the whole tail)
+            superseded = False
+            tail_checked = False
+            while not superseded:
+                with self._install_lock:
+                    with self._version_cv:
+                        armed = self._armed_version
+                        cur = self.version
+                    if armed > cur or cur != final:
+                        # a SUPERSEDING round armed (streams will land over
+                        # the buffer) — or armed AND completed within one
+                        # inter-tensor lock gap (cur moved past the version
+                        # this tail was emitting): either way the remaining
+                        # bytes are not round-``final``'s — restart the
+                        # tail against the newest version (still "at least
+                        # version"). Without the ``cur != final`` arm a
+                        # fully-landed supersede would mix two versions'
+                        # tensors into one install.
+                        target = max(armed, cur)
+                        emitted, tail_round = 0, None
+                        superseded = True
+                        continue
+                    if not tail_checked:
+                        if final != target or tail_round is None \
+                                or self.sockets._round != tail_round:
+                            emitted = 0
+                        tail_checked = True
+                    if emitted >= len(self.layout.entries):
+                        return final
+                    e = self.layout.entries[emitted]
                     on_tensor(e, self.buffer[e.offset : e.offset + e.nbytes])
-            return
+                    emitted += 1
 
     def stop(self) -> None:
         self._stop.set()

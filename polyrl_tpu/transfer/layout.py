@@ -157,11 +157,14 @@ def pack_params_streaming(params: Any, layout: ParamLayout,
     progress(layout.total_bytes)
 
 
-def covered_entries(layout: ParamLayout, coverage, start_idx: int = 0):
+def covered_entries(layout: ParamLayout, coverage, start_idx: int = 0,
+                    limit: int | None = None):
     """Entries from ``start_idx`` whose bytes are fully landed, given
     receive-side ``coverage`` = sorted (range_offset, bytes_landed) pairs
     (ReceiverSockets.coverage()). Stops at the first incomplete entry so
-    callers emit tensors strictly in layout order."""
+    callers emit tensors strictly in layout order. ``limit`` caps the
+    result (per-tensor install loops want just the next one — building the
+    full list each lock hold is O(entries²) over a round)."""
     # landed prefixes of contiguous stream ranges: merge adjacent so an
     # entry spanning a range boundary is recognised once both sides land
     merged: list[list[int]] = []
@@ -180,6 +183,8 @@ def covered_entries(layout: ParamLayout, coverage, start_idx: int = 0):
             i += 1
         if i < len(merged) and merged[i][0] <= lo and hi <= merged[i][1]:
             out.append(e)
+            if limit is not None and len(out) >= limit:
+                break
         else:
             break
     return out

@@ -19,6 +19,10 @@ from dataclasses import dataclass, field
 
 SOCK_BUF = 16 * 1024 * 1024  # 16 MB socket buffers (transfer_engine.py:40-42)
 SEND_CHUNK = 64 * 1024 * 1024  # 64 MB send chunks
+# streamed (watermark) mode: round-robin stripe per stream — small enough
+# that every stream's next needed byte stays within n_streams*STRIPE of the
+# packer (all streams active the whole round), big enough to amortize frames
+STREAM_STRIPE = 16 * 1024 * 1024
 HEADER = struct.Struct("<QQQQ")  # (round_id, offset, length, total_streams)
 
 
@@ -165,28 +169,42 @@ class ReceiverSockets:
             try:
                 with conn:
                     _tune(conn)
-                    hdr = b""
-                    while len(hdr) < HEADER.size:
-                        chunk = conn.recv(HEADER.size - len(hdr))
-                        if not chunk:
-                            raise ConnectionError("eof in header")
-                        hdr += chunk
-                    round_id, offset, length, nstreams = HEADER.unpack(hdr)
+                    hdr = self._recv_header(conn, first=True)
+                    if hdr is None:
+                        raise ConnectionError("eof in header")
+                    round_id, offset, length, nstreams = hdr
                     with self._lock:
                         if round_id != self._round:
                             continue  # stale stream from an aborted round
                         self._expected = nstreams
                         self._conns.setdefault(round_id, []).append(conn)
-                    view = self._mv[offset : offset + length]
-                    got = 0
-                    while got < length:
-                        n = conn.recv_into(view[got:], min(length - got, SOCK_BUF))
-                        if n == 0:
-                            raise ConnectionError(f"eof at {got}/{length}")
-                        got += n
-                        with self._lock:
-                            if round_id == self._round:
-                                self._progress[offset] = got
+                    # a stream is a SEQUENCE of (offset, length) framed
+                    # ranges (streamed mode interleaves round-robin stripes
+                    # so every stream trails the packer; serial mode sends
+                    # exactly one contiguous range). Clean EOF at a frame
+                    # boundary terminates the stream.
+                    while True:
+                        view = self._mv[offset : offset + length]
+                        got = 0
+                        while got < length:
+                            n = conn.recv_into(view[got:],
+                                               min(length - got, SOCK_BUF))
+                            if n == 0:
+                                raise ConnectionError(
+                                    f"eof at {got}/{length}")
+                            got += n
+                            with self._lock:
+                                if round_id == self._round:
+                                    self._progress[offset] = got
+                        hdr = self._recv_header(conn, first=False)
+                        if hdr is None:
+                            break  # clean EOF: stream complete
+                        r2, offset, length, _ = hdr
+                        if r2 != round_id:
+                            raise ConnectionError(
+                                "round id changed mid-stream")
+                        if length == 0:
+                            break
                     with self._lock:
                         if round_id != self._round:
                             continue
@@ -201,6 +219,21 @@ class ReceiverSockets:
                     if round_id == self._round:
                         self._errors.append(str(exc))
                         self._done.set()
+
+    @staticmethod
+    def _recv_header(conn: socket.socket, first: bool):
+        """Read one frame header; None on clean EOF at the boundary (only
+        legal between frames — ``first=True`` treats it as an error)."""
+        hdr = b""
+        while len(hdr) < HEADER.size:
+            chunk = conn.recv(HEADER.size - len(hdr))
+            if not chunk:
+                if hdr or first:
+                    raise ConnectionError(
+                        f"eof mid-header ({len(hdr)}/{HEADER.size})")
+                return None
+            hdr += chunk
+        return HEADER.unpack(hdr)
 
     def coverage(self) -> list[tuple[int, int]]:
         """Snapshot of (range_offset, bytes_landed) for the armed round —
@@ -250,9 +283,13 @@ class TcpTransferEngine:
         self.bind_host = bind_host
         self._pool = ThreadPoolExecutor(max_workers=workers or num_streams)
 
-    def _send_range(self, host: str, port: int, mv: memoryview,
-                    round_id: int, offset: int, length: int,
-                    nstreams: int, watermark: "Watermark | None" = None) -> None:
+    def _send_ranges(self, host: str, port: int, mv: memoryview,
+                     round_id: int, ranges: list[tuple[int, int]],
+                     nstreams: int,
+                     watermark: "Watermark | None" = None) -> None:
+        """One stream = one connection carrying a sequence of framed
+        (offset, length) ranges; closing the connection at a frame boundary
+        terminates the stream (ReceiverSockets._serve_loop)."""
         src = (self.bind_host, 0) if self.bind_host else None
         # smaller chunks under a watermark: the gate advances per packed
         # tensor group, and a 64 MB chunk would add that much latency to
@@ -261,30 +298,45 @@ class TcpTransferEngine:
         with socket.create_connection((host, port), timeout=60.0,
                                       source_address=src) as s:
             _tune(s)
-            s.sendall(HEADER.pack(round_id, offset, length, nstreams))
-            end = offset + length
-            pos = offset
-            while pos < end:
-                nxt = min(pos + chunk, end)
-                if watermark is not None:
-                    watermark.wait_until(nxt)
-                s.sendall(mv[pos:nxt])
-                pos = nxt
+            for offset, length in ranges:
+                s.sendall(HEADER.pack(round_id, offset, length, nstreams))
+                end = offset + length
+                pos = offset
+                while pos < end:
+                    nxt = min(pos + chunk, end)
+                    if watermark is not None:
+                        watermark.wait_until(nxt)
+                    s.sendall(mv[pos:nxt])
+                    pos = nxt
 
     def transfer_submit_write(self, host: str, ports: list[int], buffer,
                               round_id: int = 0,
                               watermark: "Watermark | None" = None,
                               ) -> TransferBatch:
-        """Split ``buffer`` across ``ports`` and send concurrently; with a
-        ``watermark`` each stream trails the packer instead of requiring a
-        fully packed buffer."""
+        """Split ``buffer`` across ``ports`` and send concurrently.
+
+        Serial mode: one contiguous range per stream (bandwidth-optimal for
+        an already-packed buffer). Streamed (``watermark``) mode: STRIPE
+        chunks assigned round-robin, so every stream works just behind the
+        packer — contiguous ranges would leave stream k idle until the
+        watermark crossed its start offset, serializing the round's wire
+        behind pack order (advisor r4)."""
         mv = memoryview(buffer).cast("B")
-        ranges = split_ranges(len(mv), len(ports))
         batch = TransferBatch()
-        for (off, ln), port in zip(ranges, ports):
+        if watermark is None:
+            assignments = [[r] for r in split_ranges(len(mv), len(ports))]
+        else:
+            total = len(mv)
+            chunks = [(off, min(STREAM_STRIPE, total - off))
+                      for off in range(0, total, STREAM_STRIPE)]
+            n_active = min(len(ports), len(chunks)) or 1
+            assignments = [c for c in
+                           (chunks[i::n_active] for i in range(n_active))
+                           if c]
+        for ranges, port in zip(assignments, ports):
             batch.futures.append(self._pool.submit(
-                self._send_range, host, port, mv, round_id, off, ln,
-                len(ranges), watermark))
+                self._send_ranges, host, port, mv, round_id, ranges,
+                len(assignments), watermark))
         return batch
 
     def shutdown(self) -> None:

@@ -97,9 +97,16 @@ def test_kv_write_pallas_matches_scatter(dtype):
     hkv, n_pool, d, s = 2, 16, 32, 5
     k_pool = jnp.asarray(rng.standard_normal((hkv, n_pool, PAGE, d)), dtype)
     v_pool = jnp.asarray(rng.standard_normal((hkv, n_pool, PAGE, d)), dtype)
-    k_upd = jnp.asarray(rng.standard_normal((s, hkv, d)), dtype)
-    v_upd = jnp.asarray(rng.standard_normal((s, hkv, d)), dtype)
-    # slots 3+4 inactive -> caller routes both to (page 0, off 0)
+    k_upd_np = rng.standard_normal((s, hkv, d))
+    v_upd_np = rng.standard_normal((s, hkv, d))
+    # slots 3+4 inactive -> caller routes both to (page 0, off 0). XLA
+    # scatter's duplicate-index ordering is formally UNDEFINED, so give the
+    # two null-routed slots identical payloads — otherwise exact equality
+    # vs the kernel's sequential grid could flake on a backend change.
+    k_upd_np[4] = k_upd_np[3]
+    v_upd_np[4] = v_upd_np[3]
+    k_upd = jnp.asarray(k_upd_np, dtype)
+    v_upd = jnp.asarray(v_upd_np, dtype)
     page = jnp.asarray([3, 9, 3, 0, 0], jnp.int32)
     off = jnp.asarray([0, 7, 5, 0, 0], jnp.int32)
 

@@ -67,7 +67,21 @@ def test_dispatch_routes():
     assert f("aime_2024", "\\boxed{2}", "2") == 1.0
     assert f("numina_math", "answer is 4", "4") == 1.0
     assert f("searchR1_nq", "<answer>blue</answer>", "blue") == 1.0
-    assert f("geometry3k", "\\boxed{30}", "30") == 1.0
+    # geometry3k routes to its DEDICATED scorer (0.9*acc + 0.1*format)
+    assert f("geometry3k", "\\boxed{30}", "30") == pytest.approx(0.9)
+
+
+def test_geo3k_scorer():
+    """verl geo3k semantics (reference reward_score/__init__.py:92-95):
+    0.9 × boxed-answer accuracy + 0.1 × <think>…</think>…\\boxed format."""
+    g = scorers.compute_score_geo3k
+    full = "<think>angle sum is 180</think> so \\boxed{30}"
+    assert g(full, "30") == pytest.approx(1.0)
+    assert g("\\boxed{30}", "30") == pytest.approx(0.9)  # right, no trace
+    assert g("<think>hmm</think> \\boxed{31}", "30") == pytest.approx(0.1)
+    assert g("the answer is 30", "30") == 0.0  # no boxed, no format
+    assert g("<think>x</think> \\boxed{\\frac{1}{2}}", "0.5") == \
+        pytest.approx(1.0)
 
 
 # -- managers ----------------------------------------------------------------

@@ -384,6 +384,47 @@ def test_pack_params_streaming_matches_pack():
     assert len(marks) > 2
 
 
+def test_streamed_interleave_keeps_all_streams_busy(monkeypatch):
+    """Advisor r4: contiguous per-stream ranges serialized the streamed
+    round's wire behind pack order (stream k idle until the watermark
+    crossed its start offset). With round-robin stripes, EVERY stream must
+    land bytes while the pack is only half done — and the multi-frame
+    protocol must still reassemble the buffer exactly."""
+    from polyrl_tpu.transfer import tcp_engine as te
+
+    monkeypatch.setattr(te, "STREAM_STRIPE", 1024)
+    total = 16 * 1024
+    src = np.frombuffer(np.random.default_rng(0).bytes(total),
+                        np.uint8).copy()
+    dst = np.zeros(total, np.uint8)
+    rs = te.ReceiverSockets(dst, 2, host="127.0.0.1")
+    eng = te.TcpTransferEngine(num_streams=2)
+    try:
+        rs.arm(7)
+        wm = te.Watermark(total)
+        batch = eng.transfer_submit_write("127.0.0.1", rs.ports, src,
+                                          round_id=7, watermark=wm)
+        wm.advance(total // 2)  # pack "stalled" halfway
+        deadline = time.monotonic() + 10
+        s0 = s1 = 0
+        while time.monotonic() < deadline:
+            cov = dict(rs.coverage())
+            s0 = sum(g for off, g in cov.items() if (off // 1024) % 2 == 0)
+            s1 = sum(g for off, g in cov.items() if (off // 1024) % 2 == 1)
+            if s0 > 0 and s1 > 0:
+                break
+            time.sleep(0.01)
+        assert s0 > 0 and s1 > 0, \
+            f"wire serialized behind pack order: {dict(rs.coverage())}"
+        wm.finish()
+        batch.result(timeout=10)
+        rs.wait(timeout=10)
+        np.testing.assert_array_equal(dst, src)
+    finally:
+        rs.close()
+        eng.shutdown()
+
+
 def test_streaming_push_with_incremental_install():
     """signal_update_streaming: the pack trails behind gated sender streams
     and the receiver emits tensors in layout order as their bytes land;
