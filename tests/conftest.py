@@ -46,3 +46,29 @@ def devices8():
 @pytest.fixture(scope="session")
 def rng():
     return np.random.default_rng(0)
+
+
+# -- quick/full test tiers (VERDICT r4 item 8) ------------------------------
+# The suite grew past 14 min on this 1-core box (TSAN rebuild, serving
+# stress, multi-process fits dominate). `-m quick` is the iteration tier
+# (~5 min); the FULL suite stays the pre-commit bar. Every test outside the
+# heavy modules is auto-marked quick so new tests land in the fast tier by
+# default; a test can opt out with an explicit @pytest.mark.slow.
+
+_HEAVY_MODULES = {
+    "test_tsan_and_parallel_aux",   # TSAN manager rebuild + load hammer
+    "test_examples",                # 8B recipe end-to-end at true width
+    "test_multihost",               # 2- and 4-process jax.distributed fits
+    "test_chaos",                   # cascading mid-stream death scenarios
+    "test_colocated_hybrid",        # time-slice release/resume cycles
+    "test_rollout_server",          # serving stress + TTFT under load
+}
+
+
+def pytest_collection_modifyitems(config, items):
+    for item in items:
+        mod = getattr(item.module, "__name__", "")
+        if mod in _HEAVY_MODULES or item.get_closest_marker("slow"):
+            continue
+        if item.get_closest_marker("quick") is None:
+            item.add_marker(pytest.mark.quick)
