@@ -47,7 +47,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
-from polyrl_tpu.ops.attention import attention, causal_mask, repeat_kv
+from polyrl_tpu.ops.attention import repeat_kv
 from polyrl_tpu.parallel.mesh import DP, FSDP, SP, TP
 
 _NEG = -0.7 * float(jnp.finfo(jnp.float32).max)  # finite -inf (no exp NaNs)
@@ -92,20 +92,20 @@ def make_ulysses_attention(mesh: Mesh, axis: str = SP,
         v_g = lax.all_to_all(v, axis, split_axis=2, concat_axis=1, tiled=True)
         return q_g, k_g, v_g
 
-    def inner(q, k, v, token_mask):
-        q_g, k_g, v_g = _exchange(q, k, v)
-        mask_g = lax.all_gather(token_mask, axis, axis=1, tiled=True)  # [B, T]
-        t = q_g.shape[1]
-        mask = causal_mask(t, t)[None, None, :, :] & (mask_g[:, None, None, :] > 0)
-        out = attention(q_g, k_g, v_g, mask=mask)        # [B, T, Hq/sp, D]
-        return lax.all_to_all(out, axis, split_axis=1, concat_axis=2, tiled=True)
-
-    def inner_packed(q, k, v, token_mask, segment_ids):
+    def inner(q, k, v, token_mask, segment_ids=None):
+        # the gathered full-sequence attention runs the flash kernel
+        # (Pallas on TPU — O(T) memory; the whole point of SP is sequence
+        # lengths where dense [B, H, T, T] logits cannot exist), with the
+        # dense masked fallback off-TPU / non-tiling shapes (ops/flash.py).
+        # Without explicit segment ids, padding rides the mask-derived ids
+        # (pad=0 attends only pads; pad rows are garbage either way and
+        # the loss masks them).
         from polyrl_tpu.ops import flash
 
         q_g, k_g, v_g = _exchange(q, k, v)
-        mask_g = lax.all_gather(token_mask, axis, axis=1, tiled=True)
-        seg_g = lax.all_gather(segment_ids, axis, axis=1, tiled=True)
+        mask_g = lax.all_gather(token_mask, axis, axis=1, tiled=True)  # [B, T]
+        seg_g = (lax.all_gather(segment_ids, axis, axis=1, tiled=True)
+                 if segment_ids is not None else None)
         out = flash.flash_attention_train(q_g, k_g, v_g, mask_g, causal=True,
                                           segment_ids=seg_g)
         return lax.all_to_all(out, axis, split_axis=1, concat_axis=2, tiled=True)
@@ -114,12 +114,13 @@ def make_ulysses_attention(mesh: Mesh, axis: str = SP,
     mask_spec = P(batch_axes, axis)
     if packed:
         return jax.shard_map(
-            inner_packed, mesh=mesh,
+            inner, mesh=mesh,
             in_specs=(qkv_spec, qkv_spec, qkv_spec, mask_spec, mask_spec),
             out_specs=qkv_spec, check_vma=False)
-    return jax.shard_map(inner, mesh=mesh,
-                         in_specs=(qkv_spec, qkv_spec, qkv_spec, mask_spec),
-                         out_specs=qkv_spec, check_vma=False)
+    return jax.shard_map(
+        lambda q, k, v, tm: inner(q, k, v, tm), mesh=mesh,
+        in_specs=(qkv_spec, qkv_spec, qkv_spec, mask_spec),
+        out_specs=qkv_spec, check_vma=False)
 
 
 # --------------------------------------------------------------------------
