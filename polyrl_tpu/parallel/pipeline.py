@@ -42,7 +42,6 @@ def make_pipeline_layers_fn(mesh: Mesh, cfg, num_microbatches: int,
     Requires ``cfg.num_layers % pp == 0`` and ``B % num_microbatches == 0``.
     """
     from polyrl_tpu.models import decoder as _dec
-    from polyrl_tpu.ops.attention import causal_mask
 
     pp = mesh.shape[PP]
     n = num_microbatches
@@ -51,17 +50,31 @@ def make_pipeline_layers_fn(mesh: Mesh, cfg, num_microbatches: int,
                          f"pp {pp}")
     perm = [(i, (i + 1) % pp) for i in range(pp)]
 
-    def stage_apply(stage_layers, h, cos, sin, mask, valid):
+    def stage_apply(stage_layers, h, cos, sin, valid, seg):
+        # stage attention goes through the flash wrapper (Pallas O(T)
+        # memory on TPU, dense fallback elsewhere — ops/flash.py), NOT a
+        # materialized [T, T] mask: packed long-context is exactly the
+        # workload where dense per-stage logits would O(T²) the pipeline.
+        # ``seg`` carries real segment ids in the packed case and the
+        # validity mask (pad=0) otherwise — identical semantics to the
+        # mask-derived ids flash uses everywhere else.
+        from polyrl_tpu.ops import flash
+
+        am = valid.astype(h.dtype)
+        attn = lambda q, k, v: flash.flash_attention_train(  # noqa: E731
+            q, k, v, am, causal=True, segment_ids=seg)
+
         def body(carry, lp):
-            out, _ = _dec._layer_forward(cfg, carry, lp, cos, sin, mask,
-                                         None, token_valid=valid)
+            out, _ = _dec._layer_forward(cfg, carry, lp, cos, sin, None,
+                                         None, attn_fn=attn,
+                                         token_valid=valid)
             return out, None
         if remat:
             body = jax.checkpoint(body)
         h, _ = lax.scan(body, h, stage_layers)
         return h
 
-    def inner(stage_layers, xs, coss, sins, masks, valids):
+    def inner(stage_layers, xs, coss, sins, valids, segs):
         # manual on pp only: stage dim is local (length 1) — drop it
         stage_layers = jax.tree_util.tree_map(lambda a: a[0], stage_layers)
         stage = lax.axis_index(PP)
@@ -76,7 +89,7 @@ def make_pipeline_layers_fn(mesh: Mesh, cfg, num_microbatches: int,
             mb = jnp.clip(step - stage, 0, n - 1)
             inp = jnp.where(stage == 0, xs[jnp.clip(step, 0, n - 1)], state)
             h = stage_apply(stage_layers, inp, coss[mb], sins[mb],
-                            masks[mb], valids[mb])
+                            valids[mb], segs[mb])
             out_idx = step - (pp - 1)
             ok = (stage == pp - 1) & (out_idx >= 0)
             oi = jnp.clip(out_idx, 0, n - 1)
@@ -92,7 +105,12 @@ def make_pipeline_layers_fn(mesh: Mesh, cfg, num_microbatches: int,
         # zeros — the psum replicates the result across the ring
         return lax.psum(outs, PP)
 
-    def layers_fn(layers, x, cos, sin, attn_mask):
+    def layers_fn(layers, x, cos, sin, attn_mask, segment_ids=None):
+        """``segment_ids`` (optional [B, T], 0 = pad): packed
+        (remove-padding) rows — the stages' internal attention masks turn
+        block-diagonal within segments, composing packed training with
+        pipeline parallelism (the packed caller binds them per batch via a
+        closure, exactly like its attn lambda)."""
         b, t, d = x.shape
         # total over ANY batch size: logprob feeds (ibatch-sized) and
         # ragged tail micros flow through the same layers_fn as the
@@ -106,6 +124,8 @@ def make_pipeline_layers_fn(mesh: Mesh, cfg, num_microbatches: int,
             cos = jnp.pad(cos, ((0, grow),) + ((0, 0),) * (cos.ndim - 1))
             sin = jnp.pad(sin, ((0, grow),) + ((0, 0),) * (sin.ndim - 1))
             attn_mask = jnp.pad(attn_mask, ((0, grow), (0, 0)))
+            if segment_ids is not None:
+                segment_ids = jnp.pad(segment_ids, ((0, grow), (0, 0)))
         mb = b_pad // n
         lpp = cfg.num_layers // pp
         staged = jax.tree_util.tree_map(
@@ -114,15 +134,15 @@ def make_pipeline_layers_fn(mesh: Mesh, cfg, num_microbatches: int,
         coss = cos.reshape((n, mb) + cos.shape[1:])
         sins = sin.reshape((n, mb) + sin.shape[1:])
         valids = (attn_mask > 0).reshape(n, mb, t)
-        cm = causal_mask(t, t)
-        masks = cm[None, None, None, :, :] & valids[:, :, None, None, :]
+        segs = (segment_ids if segment_ids is not None
+                else (attn_mask > 0).astype(jnp.int32)).reshape(n, mb, t)
 
         specs = jax.tree_util.tree_map(lambda _: P(PP), staged)
         fn = jax.shard_map(
             inner, mesh=mesh,
             in_specs=(specs, P(), P(), P(), P(), P()),
             out_specs=P(), axis_names={PP}, check_vma=False)
-        outs = fn(staged, xs, coss, sins, masks, valids)
+        outs = fn(staged, xs, coss, sins, valids, segs)
         return outs.reshape(b_pad, t, d)[:b]
 
     return layers_fn

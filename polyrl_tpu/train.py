@@ -277,11 +277,9 @@ def build_trainer(cfg: RunConfig, cleanup: list | None = None):
         from polyrl_tpu.parallel.pipeline import make_pipeline_layers_fn
 
         pp = mesh.shape["pp"]
-        if cfg.trainer.use_remove_padding:
-            raise NotImplementedError(
-                "use_remove_padding with parallel.pp > 1 is not supported — "
-                "the packed passes run their own segment-id flash attention, "
-                "which the pipeline stages do not thread through")
+        # packed × pp composes: the pipeline's stage attention takes
+        # per-batch segment ids (make_pipeline_layers_fn segment_ids
+        # kwarg; the actor/critic packed passes bind them via closure)
         if attn_fn is not None:
             raise NotImplementedError(
                 "parallel.sp > 1 with parallel.pp > 1 is not supported: "

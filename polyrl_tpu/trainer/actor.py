@@ -125,7 +125,7 @@ def _model_logprobs_entropy(params, model_cfg, input_ids, positions, attn_mask,
 
 def _packed_logprobs_entropy(params, model_cfg, input_ids, positions,
                              attn_mask, segment_ids, remat, compute_entropy,
-                             loss_mask=None, attn_fn=None):
+                             loss_mask=None, attn_fn=None, layers_fn=None):
     """Packed-row (remove-padding) variant: rows hold several trajectories
     separated by segment ids (reference use_remove_padding + flash varlen,
     stream_dp_actor.py:41-47). Returns per-COLUMN logprobs [R, L]: column t
@@ -152,8 +152,16 @@ def _packed_logprobs_entropy(params, model_cfg, input_ids, positions,
     else:
         attn = lambda q, k, v, am: attn_fn(  # noqa: E731
             q, k, v, am, segment_ids)
+    lf = None
+    if layers_fn is not None:
+        # packed × pipeline: bind this batch's segment ids into the stage
+        # attention (decoder.forward routes the whole stack through
+        # layers_fn, which computes attention internally)
+        lf = lambda layers, x, cos, sin, am: layers_fn(  # noqa: E731
+            layers, x, cos, sin, am, segment_ids=segment_ids)
     logits, _ = decoder.forward(params, model_cfg, input_ids, positions,
-                                attn_mask, remat=remat, attn_fn=attn)
+                                attn_mask, remat=remat, attn_fn=attn,
+                                layers_fn=lf)
     pred = logits[:, :-1, :]
     targets = input_ids[:, 1:]
     if loss_mask is not None:
@@ -289,6 +297,7 @@ class StreamActor:
                 batch["attention_mask"], batch["segment_ids"],
                 cfg.remat, cfg.entropy_coeff != 0.0,
                 loss_mask=batch["loss_mask"], attn_fn=self.packed_attn_fn,
+                layers_fn=self.layers_fn,
             )
             batch = dict(batch, response_mask=batch["loss_mask"])
         else:
@@ -447,7 +456,8 @@ class StreamActor:
             self._logprob_fns[key] = jax.jit(
                 partial(_packed_logprobs_entropy, remat=False,
                         compute_entropy=compute_entropy,
-                        attn_fn=self.packed_attn_fn),
+                        attn_fn=self.packed_attn_fn,
+                        layers_fn=self.layers_fn),
                 static_argnums=(1,),
             )
         return self._logprob_fns[key](

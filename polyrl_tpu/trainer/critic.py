@@ -68,7 +68,8 @@ def forward_values(params, model_cfg, input_ids, positions, attn_mask, responses
 
 
 def forward_values_packed(params, model_cfg, input_ids, positions, attn_mask,
-                          segment_ids, remat, loss_mask=None, attn_fn=None):
+                          segment_ids, remat, loss_mask=None, attn_fn=None,
+                          layers_fn=None):
     """Per-column values [R, L] on the packed (remove-padding) layout
     (reference packed critic, stream_dp_critic.py:35,83): column t holds the
     value predicted from column t-1 — the same one-left shift as
@@ -85,12 +86,17 @@ def forward_values_packed(params, model_cfg, input_ids, positions, attn_mask,
     else:
         attn = lambda q, k, v, am: attn_fn(  # noqa: E731
             q, k, v, am, segment_ids)
+    lf = None
+    if layers_fn is not None:  # packed × pipeline (see the actor's pass)
+        lf = lambda layers, x, cos, sin, am: layers_fn(  # noqa: E731
+            layers, x, cos, sin, am, segment_ids=segment_ids)
     value_params = dict(params)
     head = value_params.pop("value_head")
     value_params["lm_head"] = head
     cfg = dataclasses.replace(model_cfg, tie_word_embeddings=False)
     values, _ = decoder.forward(value_params, cfg, input_ids, positions,
-                                attn_mask, remat=remat, attn_fn=attn)
+                                attn_mask, remat=remat, attn_fn=attn,
+                                layers_fn=lf)
     v = values[:, :-1, 0].astype(jnp.float32)
     v = jnp.pad(v, ((0, 0), (1, 0)))
     if loss_mask is not None:
@@ -135,6 +141,7 @@ class StreamCritic:
                 batch["positions"], batch["attention_mask"],
                 batch["segment_ids"], self.cfg.remat,
                 loss_mask=batch["loss_mask"], attn_fn=self.packed_attn_fn,
+                layers_fn=self.layers_fn,
             )
             mask = batch["loss_mask"]
         else:
@@ -232,6 +239,7 @@ class StreamCritic:
                     b["attention_mask"], b["segment_ids"], False,
                     loss_mask=b.get("loss_mask"),
                     attn_fn=self.packed_attn_fn,
+                    layers_fn=self.layers_fn,
                 )
             )
         return self._value_fn_packed(self.params, batch)

@@ -144,3 +144,40 @@ def test_pipeline_train_step_e2e(pp_mesh):
     moved = np.abs(np.asarray(new_params["layers"]["wq"])
                    - np.asarray(params["layers"]["wq"])).sum()
     assert moved > 0.0
+
+
+def test_pipeline_packed_segments_match_single_device(pp_mesh):
+    """Packed (remove-padding) rows through the pipeline: the actor's
+    packed logprob pass with the segment-aware stage attention must match
+    the single-device segment-id flash pass (packed × pp composition)."""
+    from polyrl_tpu.trainer.actor import _packed_logprobs_entropy
+
+    cfg = decoder.get_config("tiny", dtype=jnp.float32)
+    params = decoder.init_params(jax.random.PRNGKey(0), cfg)
+    b, t = 4, 16
+    rng = np.random.default_rng(3)
+    ids = jnp.asarray(rng.integers(1, cfg.vocab_size, (b, t)), jnp.int32)
+    seg = np.zeros((b, t), np.int32)
+    pos = np.zeros((b, t), np.int32)
+    lm = np.zeros((b, t), np.float32)
+    for s, e, sid in [(0, 6, 1), (6, 13, 2)]:  # trailing pad cols 13..16
+        seg[:, s:e] = sid
+        pos[:, s:e] = np.arange(e - s)
+        lm[:, s + 2:e] = 1.0
+    am = (seg > 0).astype(np.float32)
+    seg, pos, lm, am = map(jnp.asarray, (seg, pos, lm, am))
+
+    want_lp, want_ent = _packed_logprobs_entropy(
+        params, cfg, ids, pos, am, seg, False, True, loss_mask=lm)
+
+    layers_fn = make_pipeline_layers_fn(pp_mesh, cfg, num_microbatches=2)
+    with pp_mesh:
+        got_lp, got_ent = jax.jit(
+            lambda p: _packed_logprobs_entropy(
+                p, cfg, ids, pos, am, seg, False, True, loss_mask=lm,
+                layers_fn=layers_fn)
+        )(params)
+    np.testing.assert_allclose(np.asarray(got_lp), np.asarray(want_lp),
+                               rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(np.asarray(got_ent), np.asarray(want_ent),
+                               rtol=2e-4, atol=2e-4)
