@@ -58,6 +58,12 @@ def make_pipeline_layers_fn(mesh: Mesh, cfg, num_microbatches: int,
         # ``seg`` carries real segment ids in the packed case and the
         # validity mask (pad=0) otherwise — identical semantics to the
         # mask-derived ids flash uses everywhere else.
+        # CAVEAT (hardware validation pending): the Pallas flash kernel
+        # inside this partial-manual shard_map has only executed via the
+        # CPU dense fallback on this rig — supports_flash() gates it off
+        # for untileable shapes, but a TPU lowering failure of the
+        # supported path would only surface on real hardware (same
+        # exposure as every training-path flash call since r1).
         from polyrl_tpu.ops import flash
 
         am = valid.astype(h.dtype)

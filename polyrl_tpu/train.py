@@ -283,7 +283,9 @@ def build_trainer(cfg: RunConfig, cleanup: list | None = None):
         if attn_fn is not None:
             raise NotImplementedError(
                 "parallel.sp > 1 with parallel.pp > 1 is not supported: "
-                "pipeline stages compute dense masked attention internally")
+                "decoder.forward routes the whole stack through the "
+                "pipeline layers_fn, which computes its own (flash) stage "
+                "attention — an SP attn_fn would be silently ignored")
         n_micro = cfg.parallel.pp_microbatches or 2 * pp
         if cfg.trainer.micro_batch_size % n_micro != 0:
             # not strictly required (the pipeline pads ragged feeds), but a

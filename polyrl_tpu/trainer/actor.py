@@ -146,19 +146,25 @@ def _packed_logprobs_entropy(params, model_cfg, input_ids, positions,
     segment-id flash kernel."""
     from polyrl_tpu.ops import flash
 
-    if attn_fn is None:
+    attn = lf = None
+    if layers_fn is not None:
+        # packed × pipeline: bind this batch's segment ids into the stage
+        # attention (decoder.forward routes the whole stack through
+        # layers_fn, which computes attention internally — an attn_fn
+        # would be silently ignored, so reject the combination here too,
+        # not just in build_trainer)
+        if attn_fn is not None:
+            raise ValueError(
+                "packed pass got BOTH an SP attn_fn and a pipeline "
+                "layers_fn; the pipeline computes its own stage attention")
+        lf = lambda layers, x, cos, sin, am: layers_fn(  # noqa: E731
+            layers, x, cos, sin, am, segment_ids=segment_ids)
+    elif attn_fn is None:
         attn = lambda q, k, v, am: flash.flash_attention_train(  # noqa: E731
             q, k, v, am, causal=True, segment_ids=segment_ids)
     else:
         attn = lambda q, k, v, am: attn_fn(  # noqa: E731
             q, k, v, am, segment_ids)
-    lf = None
-    if layers_fn is not None:
-        # packed × pipeline: bind this batch's segment ids into the stage
-        # attention (decoder.forward routes the whole stack through
-        # layers_fn, which computes attention internally)
-        lf = lambda layers, x, cos, sin, am: layers_fn(  # noqa: E731
-            layers, x, cos, sin, am, segment_ids=segment_ids)
     logits, _ = decoder.forward(params, model_cfg, input_ids, positions,
                                 attn_mask, remat=remat, attn_fn=attn,
                                 layers_fn=lf)

@@ -80,16 +80,20 @@ def forward_values_packed(params, model_cfg, input_ids, positions, attn_mask,
     optional segment-aware SP attention (see the actor's packed pass)."""
     from polyrl_tpu.ops import flash
 
-    if attn_fn is None:
+    attn = lf = None
+    if layers_fn is not None:  # packed × pipeline (see the actor's pass)
+        if attn_fn is not None:
+            raise ValueError(
+                "packed value pass got BOTH an SP attn_fn and a pipeline "
+                "layers_fn; the pipeline computes its own stage attention")
+        lf = lambda layers, x, cos, sin, am: layers_fn(  # noqa: E731
+            layers, x, cos, sin, am, segment_ids=segment_ids)
+    elif attn_fn is None:
         attn = lambda q, k, v, am: flash.flash_attention_train(  # noqa: E731
             q, k, v, am, causal=True, segment_ids=segment_ids)
     else:
         attn = lambda q, k, v, am: attn_fn(  # noqa: E731
             q, k, v, am, segment_ids)
-    lf = None
-    if layers_fn is not None:  # packed × pipeline (see the actor's pass)
-        lf = lambda layers, x, cos, sin, am: layers_fn(  # noqa: E731
-            layers, x, cos, sin, am, segment_ids=segment_ids)
     value_params = dict(params)
     head = value_params.pop("value_head")
     value_params["lm_head"] = head

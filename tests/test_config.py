@@ -142,3 +142,38 @@ def test_main_print_config(capsys):
     assert rc == 0
     out = capsys.readouterr().out
     assert "total_steps: 42" in out
+
+
+def test_build_trainer_packed_sp_wiring():
+    """train.py's SP block at the CONFIG surface (r5): parallel.sp>1 with
+    use_remove_padding assembles the segment-aware attention and one fit
+    step runs; the dense sp_mode still fails fast with packed."""
+    cfg = cfg_lib.load_config(overrides=list(_FAST) + [
+        "parallel.sp=2", "parallel.fsdp=2", "parallel.dp=2",
+        "trainer.use_remove_padding=true",
+    ])
+    trainer = build_trainer(cfg)
+    assert trainer.actor.packed_attn_fn is not None
+    history = trainer.fit()
+    assert len(history) == 1 and "actor/pg_loss" in history[0]
+
+    bad = cfg_lib.load_config(overrides=list(_FAST) + [
+        "parallel.sp=2", "parallel.fsdp=2", "parallel.dp=2",
+        "parallel.sp_mode=dense", "trainer.use_remove_padding=true",
+    ])
+    with pytest.raises(NotImplementedError, match="sp_mode=ulysses or ring"):
+        build_trainer(bad)
+
+
+def test_build_trainer_packed_pp_wiring():
+    """packed × pipeline at the config surface: layers_fn threads segment
+    ids (r5) — one fit step runs under parallel.pp=2."""
+    cfg = cfg_lib.load_config(overrides=list(_FAST) + [
+        "parallel.pp=2", "parallel.fsdp=2", "parallel.dp=2",
+        "parallel.pp_microbatches=2",
+        "trainer.use_remove_padding=true",
+    ])
+    trainer = build_trainer(cfg)
+    assert trainer.actor.layers_fn is not None
+    history = trainer.fit()
+    assert len(history) == 1 and "actor/pg_loss" in history[0]
