@@ -123,6 +123,33 @@ def _model_logprobs_entropy(params, model_cfg, input_ids, positions, attn_mask,
     return logprobs, entropy
 
 
+def bind_packed_attention(attn_fn, layers_fn, segment_ids):
+    """Bind a packed batch's segment ids into the attention machinery —
+    ONE place for the dispatch shared by the actor's logprob pass and the
+    critic's value pass. Returns ``(attn, lf)`` for ``decoder.forward``:
+
+    - ``layers_fn`` set (packed × pipeline): the stage attention takes the
+      segment ids; an SP attn_fn alongside it is rejected here too (not
+      just in build_trainer) because decoder.forward would silently ignore
+      it — the pipeline computes its own stage attention.
+    - ``attn_fn`` set (packed × SP): the segment-aware Ulysses/ring fn.
+    - neither: the single-logical-device segment-id flash kernel.
+    """
+    from polyrl_tpu.ops import flash
+
+    if layers_fn is not None:
+        if attn_fn is not None:
+            raise ValueError(
+                "packed pass got BOTH an SP attn_fn and a pipeline "
+                "layers_fn; the pipeline computes its own stage attention")
+        return None, (lambda layers, x, cos, sin, am: layers_fn(
+            layers, x, cos, sin, am, segment_ids=segment_ids))
+    if attn_fn is None:
+        return (lambda q, k, v, am: flash.flash_attention_train(
+            q, k, v, am, causal=True, segment_ids=segment_ids)), None
+    return (lambda q, k, v, am: attn_fn(q, k, v, am, segment_ids)), None
+
+
 def _packed_logprobs_entropy(params, model_cfg, input_ids, positions,
                              attn_mask, segment_ids, remat, compute_entropy,
                              loss_mask=None, attn_fn=None, layers_fn=None):
@@ -144,27 +171,7 @@ def _packed_logprobs_entropy(params, model_cfg, input_ids, positions,
     sp > 1 (the reference's default long-context configuration,
     stream_dp_actor.py:37-47,135); defaults to the single-logical-device
     segment-id flash kernel."""
-    from polyrl_tpu.ops import flash
-
-    attn = lf = None
-    if layers_fn is not None:
-        # packed × pipeline: bind this batch's segment ids into the stage
-        # attention (decoder.forward routes the whole stack through
-        # layers_fn, which computes attention internally — an attn_fn
-        # would be silently ignored, so reject the combination here too,
-        # not just in build_trainer)
-        if attn_fn is not None:
-            raise ValueError(
-                "packed pass got BOTH an SP attn_fn and a pipeline "
-                "layers_fn; the pipeline computes its own stage attention")
-        lf = lambda layers, x, cos, sin, am: layers_fn(  # noqa: E731
-            layers, x, cos, sin, am, segment_ids=segment_ids)
-    elif attn_fn is None:
-        attn = lambda q, k, v, am: flash.flash_attention_train(  # noqa: E731
-            q, k, v, am, causal=True, segment_ids=segment_ids)
-    else:
-        attn = lambda q, k, v, am: attn_fn(  # noqa: E731
-            q, k, v, am, segment_ids)
+    attn, lf = bind_packed_attention(attn_fn, layers_fn, segment_ids)
     logits, _ = decoder.forward(params, model_cfg, input_ids, positions,
                                 attn_mask, remat=remat, attn_fn=attn,
                                 layers_fn=lf)

@@ -78,22 +78,9 @@ def forward_values_packed(params, model_cfg, input_ids, positions, attn_mask,
     ``loss_mask`` zeroes columns outside the mask (finiteness guard, same
     double-where rationale as the actor's packed pass). ``attn_fn``:
     optional segment-aware SP attention (see the actor's packed pass)."""
-    from polyrl_tpu.ops import flash
+    from polyrl_tpu.trainer.actor import bind_packed_attention
 
-    attn = lf = None
-    if layers_fn is not None:  # packed × pipeline (see the actor's pass)
-        if attn_fn is not None:
-            raise ValueError(
-                "packed value pass got BOTH an SP attn_fn and a pipeline "
-                "layers_fn; the pipeline computes its own stage attention")
-        lf = lambda layers, x, cos, sin, am: layers_fn(  # noqa: E731
-            layers, x, cos, sin, am, segment_ids=segment_ids)
-    elif attn_fn is None:
-        attn = lambda q, k, v, am: flash.flash_attention_train(  # noqa: E731
-            q, k, v, am, causal=True, segment_ids=segment_ids)
-    else:
-        attn = lambda q, k, v, am: attn_fn(  # noqa: E731
-            q, k, v, am, segment_ids)
+    attn, lf = bind_packed_attention(attn_fn, layers_fn, segment_ids)
     value_params = dict(params)
     head = value_params.pop("value_head")
     value_params["lm_head"] = head

@@ -354,3 +354,46 @@ def test_mixtral_hf_logits_parity(tmp_path):
     got, _ = decoder.forward(params, cfg, jnp.asarray(ids), jnp.asarray(pos),
                              jnp.asarray(mask))
     np.testing.assert_allclose(np.asarray(got), want, rtol=2e-4, atol=2e-4)
+
+
+def test_moe_packed_logprobs_under_ep_match_single(devices8):
+    """Packed (remove-padding) training on the MoE family under a real
+    expert-parallel mesh: the packed logprob pass with experts sharded over
+    ep must match the single-device segment-id pass (packed × ep cell —
+    ep needs no special attention, GSPMD inserts dispatch/combine from the
+    param specs; pack-pad columns are segment 0 and loss-masked, and MoE
+    capacity ignores them via token_valid)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from polyrl_tpu.parallel import mesh as meshlib
+    from polyrl_tpu.trainer.actor import _packed_logprobs_entropy
+
+    cfg, params = _mk()
+    b, t = 2, 16
+    rng = np.random.default_rng(5)
+    ids = jnp.asarray(rng.integers(1, cfg.vocab_size, (b, t)), jnp.int32)
+    seg = np.zeros((b, t), np.int32)
+    pos = np.zeros((b, t), np.int32)
+    lm = np.zeros((b, t), np.float32)
+    for s, e, sid in [(0, 6, 1), (6, 13, 2)]:  # trailing pack-pad cols 13..15
+        seg[:, s:e] = sid
+        pos[:, s:e] = np.arange(e - s)
+        lm[:, s + 2:e] = 1.0
+    am = (seg > 0).astype(np.float32)
+    seg, pos, lm, am = map(jnp.asarray, (seg, pos, lm, am))
+
+    want_lp, _ = _packed_logprobs_entropy(
+        params, cfg, ids, pos, am, seg, False, False, loss_mask=lm)
+
+    mesh = meshlib.make_mesh(meshlib.MeshConfig(dp=1, fsdp=2, tp=2, ep=2),
+                             devices8)
+    sharded = meshlib.shard_params(mesh, params, decoder.param_specs(cfg))
+    rspec = NamedSharding(mesh, P())
+    with mesh:
+        got_lp, _ = jax.jit(
+            lambda p, i, po, a, s, l: _packed_logprobs_entropy(
+                p, cfg, i, po, a, s, False, False, loss_mask=l)
+        )(sharded, *(jax.device_put(x, rspec)
+                     for x in (ids, pos, am, seg, lm)))
+    np.testing.assert_allclose(np.asarray(got_lp), np.asarray(want_lp),
+                               rtol=2e-4, atol=2e-4)

@@ -160,7 +160,7 @@ def test_pipeline_packed_segments_match_single_device(pp_mesh):
     seg = np.zeros((b, t), np.int32)
     pos = np.zeros((b, t), np.int32)
     lm = np.zeros((b, t), np.float32)
-    for s, e, sid in [(0, 6, 1), (6, 13, 2)]:  # trailing pad cols 13..16
+    for s, e, sid in [(0, 6, 1), (6, 13, 2)]:  # trailing pad cols 13..15
         seg[:, s:e] = sid
         pos[:, s:e] = np.arange(e - s)
         lm[:, s + 2:e] = 1.0
