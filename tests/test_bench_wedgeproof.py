@@ -11,6 +11,7 @@ import subprocess
 import sys
 import time
 
+import numpy as np
 import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -124,3 +125,24 @@ def test_8b_result_is_the_headline_when_only_it_landed(tmp_path, monkeypatch):
     })
     assert res2["value"] == 9000.0
     assert res2["metric"].startswith("cb_serving_tok_s_per_chip")
+
+
+@pytest.mark.quick
+def test_dryrun_mesh_list_covers_all_variants():
+    """The driver's multichip dryrun must exercise every composition the
+    build claims: base GRPO, ring-sp, packed×sp(ulysses), MoE-ep, GPipe,
+    packed×pp, and PPO+critic — a regression here silently shrinks the
+    driver evidence."""
+    spec = importlib.util.spec_from_file_location(
+        "graft_entry", os.path.join(REPO, "__graft_entry__.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    pairs = mod._dryrun_mesh_list(8)
+    variants = [v for _, v in pairs]
+    assert variants == ["grpo", "grpo", "packed_sp", "grpo", "grpo",
+                        "packed_pp", "ppo_critic"]
+    dims = [d for d, _ in pairs]
+    assert dims[2] == (1, 2, 2, 2, 1, 1)   # packed × ulysses (sp=2, tp=2)
+    assert dims[5] == (1, 2, 2, 1, 1, 2)   # packed × pipeline (pp=2)
+    for d in dims:
+        assert int(np.prod(d)) == 8
