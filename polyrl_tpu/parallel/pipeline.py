@@ -30,20 +30,29 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
-from polyrl_tpu.parallel.mesh import PP
+from polyrl_tpu.parallel.mesh import PP, SP
 
 
 def make_pipeline_layers_fn(mesh: Mesh, cfg, num_microbatches: int,
-                            remat: bool = False):
+                            remat: bool = False, sp_ring: bool = False):
     """Returns ``layers_fn(layers, x, cos, sin, attn_mask)`` — a drop-in
     for the decoder's layer-stack scan (decoder.forward ``layers_fn``
     hook): x [B, T, d] → [B, T, d] with the stack executed as a pipeline.
 
     Requires ``cfg.num_layers % pp == 0`` and ``B % num_microbatches == 0``.
+
+    ``sp_ring=True`` composes SEQUENCE parallelism into the pipeline: the
+    shard_map goes manual on {pp, sp}, activations keep their seq dim
+    sharded over sp, and the stage attention runs
+    :func:`polyrl_tpu.parallel.sequence.ring_attention_local` — K/V blocks
+    ring over sp INSIDE each stage while microbatches ring over pp. Needs
+    ``T % sp == 0``. (Ulysses inside the stages is not implemented: its
+    head all-to-all would reshard every stage boundary.)
     """
     from polyrl_tpu.models import decoder as _dec
 
     pp = mesh.shape[PP]
+    sp = mesh.shape[SP] if sp_ring else 1
     n = num_microbatches
     if cfg.num_layers % pp != 0:
         raise ValueError(f"num_layers {cfg.num_layers} not divisible by "
@@ -67,8 +76,17 @@ def make_pipeline_layers_fn(mesh: Mesh, cfg, num_microbatches: int,
         from polyrl_tpu.ops import flash
 
         am = valid.astype(h.dtype)
-        attn = lambda q, k, v: flash.flash_attention_train(  # noqa: E731
-            q, k, v, am, causal=True, segment_ids=seg)
+        if sp_ring:
+            # seq dim is LOCAL (T/sp); ring the K/V blocks over sp within
+            # this stage — global causality comes from the ring's own
+            # axis-index positioning
+            from polyrl_tpu.parallel.sequence import ring_attention_local
+
+            attn = lambda q, k, v: ring_attention_local(  # noqa: E731
+                q, k, v, am, seg, axis=SP, sp=sp)
+        else:
+            attn = lambda q, k, v: flash.flash_attention_train(  # noqa: E731
+                q, k, v, am, causal=True, segment_ids=seg)
 
         def body(carry, lp):
             out, _ = _dec._layer_forward(cfg, carry, lp, cos, sin, None,
@@ -144,10 +162,28 @@ def make_pipeline_layers_fn(mesh: Mesh, cfg, num_microbatches: int,
                 else (attn_mask > 0).astype(jnp.int32)).reshape(n, mb, t)
 
         specs = jax.tree_util.tree_map(lambda _: P(PP), staged)
+        if sp_ring:
+            if t % sp != 0:
+                raise ValueError(
+                    f"sp_ring pipeline needs seq len {t} divisible by "
+                    f"sp {sp}")
+            # seq dim (index 2 after the [n, mb, ...] reshape) shards over
+            # sp; params stay replicated over sp (their specs name only pp)
+
+            def seq_spec(a):
+                return P(*([None, None, SP] + [None] * (a.ndim - 3)))
+
+            in_specs = (specs, seq_spec(xs), seq_spec(coss), seq_spec(sins),
+                        P(None, None, SP), P(None, None, SP))
+            out_spec = P(None, None, SP, None)
+            manual = {PP, SP}
+        else:
+            in_specs = (specs, P(), P(), P(), P(), P())
+            out_spec = P()
+            manual = {PP}
         fn = jax.shard_map(
-            inner, mesh=mesh,
-            in_specs=(specs, P(), P(), P(), P(), P()),
-            out_specs=P(), axis_names={PP}, check_vma=False)
+            inner, mesh=mesh, in_specs=in_specs,
+            out_specs=out_spec, axis_names=manual, check_vma=False)
         outs = fn(staged, xs, coss, sins, valids, segs)
         return outs.reshape(b_pad, t, d)[:b]
 

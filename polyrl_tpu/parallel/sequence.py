@@ -128,63 +128,77 @@ def make_ulysses_attention(mesh: Mesh, axis: str = SP,
 # --------------------------------------------------------------------------
 
 
+def ring_attention_local(q, k, v, token_mask, segment_ids=None, *,
+                         axis: str = SP, sp: int):
+    """The ring-attention body for use INSIDE a shard_map region that is
+    manual on ``axis``: q/k/v are the LOCAL [b, T/sp, H, D] blocks; K/V
+    (with their mask/segment ids) rotate around the ring via ``ppermute``
+    with online-softmax merging over GLOBAL positions. Exposed so the
+    pipeline's stage attention can run it inside its own manual region
+    (sp × pp composition); ``make_ring_attention`` is the standalone
+    shard_map wrapper.
+
+    GQA-native: heads never leave their rank, so KV is NOT expanded at
+    all — the rotating K/V blocks stay at hkv heads (the dominant
+    memory/ICI cost) and Q heads group against their shared KV head in
+    the einsum, exactly like ops.attention."""
+    perm = [(i, (i + 1) % sp) for i in range(sp)]
+    b, tq, hq, d = q.shape
+    hkv = k.shape[2]
+    g = hq // hkv
+    scale = d ** -0.5
+    idx = lax.axis_index(axis)
+    q32 = q.reshape(b, tq, hkv, g, d).astype(jnp.float32) * scale
+    q_pos = idx * tq + jnp.arange(tq)  # global positions of local Q rows
+
+    m = jnp.full((b, hkv, g, tq), _NEG, jnp.float32)
+    l = jnp.zeros((b, hkv, g, tq), jnp.float32)
+    o = jnp.zeros((b, tq, hkv, g, d), jnp.float32)
+    k_cur, v_cur, mask_cur, seg_cur = k, v, token_mask, segment_ids
+
+    for step in range(sp):
+        src = (idx - step) % sp  # block id currently held
+        tk = k_cur.shape[1]
+        logits = jnp.einsum("bqhgd,bkhd->bhgqk", q32,
+                            k_cur.astype(jnp.float32))
+        kv_pos = src * tk + jnp.arange(tk)
+        ok = (kv_pos[None, :] <= q_pos[:, None])[None, None, None, :, :]
+        ok = ok & (mask_cur[:, None, None, None, :] > 0)
+        if seg_cur is not None:
+            ok = ok & (segment_ids[:, :, None]
+                       == seg_cur[:, None, :])[:, None, None, :, :]
+        logits = jnp.where(ok, logits, _NEG)
+        m_new = jnp.maximum(m, logits.max(axis=-1))
+        p = jnp.exp(logits - m_new[..., None])
+        p = jnp.where(ok, p, 0.0)
+        corr = jnp.exp(m - m_new)                      # [b,hkv,g,tq]
+        l = l * corr + p.sum(axis=-1)
+        o = o * corr.transpose(0, 3, 1, 2)[..., None] + jnp.einsum(
+            "bhgqk,bkhd->bqhgd", p, v_cur.astype(jnp.float32))
+        m = m_new
+        if step < sp - 1:
+            k_cur = lax.ppermute(k_cur, axis, perm)
+            v_cur = lax.ppermute(v_cur, axis, perm)
+            mask_cur = lax.ppermute(mask_cur, axis, perm)
+            if seg_cur is not None:
+                seg_cur = lax.ppermute(seg_cur, axis, perm)
+
+    denom = jnp.maximum(l, 1e-30).transpose(0, 3, 1, 2)[..., None]
+    return (o / denom).reshape(b, tq, hq, d).astype(q.dtype)
+
+
 def make_ring_attention(mesh: Mesh, axis: str = SP, batch_axes=(DP, FSDP),
                         packed: bool = False):
-    """Returns attn_fn(q, k, v, token_mask) -> out. Blockwise attention with
-    K/V rotating over the sp ring (ppermute) and online-softmax merging —
+    """Returns attn_fn(q, k, v, token_mask) -> out over a standalone
+    shard_map (manual on ``axis``) around :func:`ring_attention_local` —
     the TPU context-parallel mode SURVEY §2.3 calls for. ``packed=True``:
     segment ids rotate WITH their K/V block and the mask adds same-segment
     equality (block-diagonal packed attention)."""
     sp = mesh.shape[axis]
-    perm = [(i, (i + 1) % sp) for i in range(sp)]
 
     def inner(q, k, v, token_mask, segment_ids=None):
-        # GQA-native: heads never leave their rank in ring attention, so KV
-        # is NOT expanded at all — the rotating K/V blocks stay at hkv heads
-        # (the dominant memory/ICI cost) and Q heads group against their
-        # shared KV head in the einsum, exactly like ops.attention.
-        b, tq, hq, d = q.shape
-        hkv = k.shape[2]
-        g = hq // hkv
-        scale = d ** -0.5
-        idx = lax.axis_index(axis)
-        q32 = q.reshape(b, tq, hkv, g, d).astype(jnp.float32) * scale
-        q_pos = idx * tq + jnp.arange(tq)  # global positions of local Q rows
-
-        m = jnp.full((b, hkv, g, tq), _NEG, jnp.float32)
-        l = jnp.zeros((b, hkv, g, tq), jnp.float32)
-        o = jnp.zeros((b, tq, hkv, g, d), jnp.float32)
-        k_cur, v_cur, mask_cur, seg_cur = k, v, token_mask, segment_ids
-
-        for step in range(sp):
-            src = (idx - step) % sp  # block id currently held
-            tk = k_cur.shape[1]
-            logits = jnp.einsum("bqhgd,bkhd->bhgqk", q32,
-                                k_cur.astype(jnp.float32))
-            kv_pos = src * tk + jnp.arange(tk)
-            ok = (kv_pos[None, :] <= q_pos[:, None])[None, None, None, :, :]
-            ok = ok & (mask_cur[:, None, None, None, :] > 0)
-            if seg_cur is not None:
-                ok = ok & (segment_ids[:, :, None]
-                           == seg_cur[:, None, :])[:, None, None, :, :]
-            logits = jnp.where(ok, logits, _NEG)
-            m_new = jnp.maximum(m, logits.max(axis=-1))
-            p = jnp.exp(logits - m_new[..., None])
-            p = jnp.where(ok, p, 0.0)
-            corr = jnp.exp(m - m_new)                      # [b,hkv,g,tq]
-            l = l * corr + p.sum(axis=-1)
-            o = o * corr.transpose(0, 3, 1, 2)[..., None] + jnp.einsum(
-                "bhgqk,bkhd->bqhgd", p, v_cur.astype(jnp.float32))
-            m = m_new
-            if step < sp - 1:
-                k_cur = lax.ppermute(k_cur, axis, perm)
-                v_cur = lax.ppermute(v_cur, axis, perm)
-                mask_cur = lax.ppermute(mask_cur, axis, perm)
-                if seg_cur is not None:
-                    seg_cur = lax.ppermute(seg_cur, axis, perm)
-
-        denom = jnp.maximum(l, 1e-30).transpose(0, 3, 1, 2)[..., None]
-        return (o / denom).reshape(b, tq, hq, d).astype(q.dtype)
+        return ring_attention_local(q, k, v, token_mask, segment_ids,
+                                    axis=axis, sp=sp)
 
     qkv_spec = P(batch_axes, axis, TP, None)  # heads stay tp-sharded
     mask_spec = P(batch_axes, axis)

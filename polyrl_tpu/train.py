@@ -235,6 +235,7 @@ def build_trainer(cfg: RunConfig, cleanup: list | None = None):
     # back down on every attempt
     attn_fn = None
     packed_attn_fn = None
+    sp_in_pipeline = False
     if mesh is not None and mesh.shape.get("sp", 1) > 1:
         # long-context: shard the sequence dim with a dedicated SP attention
         # (Ulysses all-to-all / ring ppermute) instead of whatever GSPMD
@@ -252,40 +253,53 @@ def build_trainer(cfg: RunConfig, cleanup: list | None = None):
             raise ValueError(
                 f"ulysses SP needs num_heads ({mcfg.num_heads}) divisible "
                 f"by sp*tp ({sp}*{tp}); use sp_mode=ring or different axes")
-        attn_fn = make_sp_attention(mesh, cfg.parallel.sp_mode)
-        if cfg.trainer.use_remove_padding:
-            # packed (remove-padding) long-context training composes with
-            # SP via the segment-aware variant — the reference's default
-            # long-context configuration (Ulysses over PACKED varlen
-            # inputs, stream_dp_actor.py:37-47,135). The trainer rounds
-            # pack_len up to a multiple of sp (_pack_geometry). Only
-            # ulysses/ring have the segment-aware path; 'dense' under
-            # sp>1 would silently hand GSPMD an unvalidated composition.
-            if cfg.parallel.sp_mode not in ("ulysses", "ring"):
+        if mesh.shape.get("pp", 1) > 1:
+            # sp × pp: decoder.forward routes the whole stack through the
+            # pipeline layers_fn, so the SP attention must live INSIDE the
+            # stages — ring does (ring_attention_local in the pipeline's
+            # {pp, sp}-manual region); Ulysses' head all-to-all would
+            # reshard every stage boundary and is not implemented there.
+            if cfg.parallel.sp_mode != "ring":
                 raise NotImplementedError(
-                    "use_remove_padding with parallel.sp > 1 requires "
-                    "sp_mode=ulysses or ring (segment-aware SP attention); "
-                    f"got sp_mode={cfg.parallel.sp_mode!r}")
-            packed_attn_fn = make_sp_attention(mesh, cfg.parallel.sp_mode,
-                                               packed=True)
+                    "parallel.sp > 1 with parallel.pp > 1 requires "
+                    "sp_mode=ring (stage attention rings over sp inside "
+                    f"the pipeline); got {cfg.parallel.sp_mode!r}")
+            t_total = (cfg.trainer.max_prompt_length
+                       + cfg.trainer.max_response_length)
+            if t_total % sp:
+                raise ValueError(
+                    f"sp×pp needs max_prompt+max_response ({t_total}) "
+                    f"divisible by sp ({sp})")
+            sp_in_pipeline = True
+        else:
+            attn_fn = make_sp_attention(mesh, cfg.parallel.sp_mode)
+            if cfg.trainer.use_remove_padding:
+                # packed (remove-padding) long-context training composes
+                # with SP via the segment-aware variant — the reference's
+                # default long-context configuration (Ulysses over PACKED
+                # varlen inputs, stream_dp_actor.py:37-47,135). The trainer
+                # rounds pack_len up to a multiple of sp (_pack_geometry).
+                # Only ulysses/ring have the segment-aware path; 'dense'
+                # under sp>1 would silently hand GSPMD an unvalidated
+                # composition.
+                if cfg.parallel.sp_mode not in ("ulysses", "ring"):
+                    raise NotImplementedError(
+                        "use_remove_padding with parallel.sp > 1 requires "
+                        "sp_mode=ulysses or ring (segment-aware SP "
+                        f"attention); got sp_mode={cfg.parallel.sp_mode!r}")
+                packed_attn_fn = make_sp_attention(
+                    mesh, cfg.parallel.sp_mode, packed=True)
 
     layers_fn = None
     critic_layers_fn = None
     if mesh is not None and mesh.shape.get("pp", 1) > 1:
         # pipeline-parallel layer stack (parallel/pipeline.py): validate the
-        # combination up front, same rationale as the SP block above
+        # combination up front, same rationale as the SP block above.
+        # packed × pp composes (stage attention takes per-batch segment
+        # ids); sp × pp composes via sp_ring (validated above).
         from polyrl_tpu.parallel.pipeline import make_pipeline_layers_fn
 
         pp = mesh.shape["pp"]
-        # packed × pp composes: the pipeline's stage attention takes
-        # per-batch segment ids (make_pipeline_layers_fn segment_ids
-        # kwarg; the actor/critic packed passes bind them via closure)
-        if attn_fn is not None:
-            raise NotImplementedError(
-                "parallel.sp > 1 with parallel.pp > 1 is not supported: "
-                "decoder.forward routes the whole stack through the "
-                "pipeline layers_fn, which computes its own (flash) stage "
-                "attention — an SP attn_fn would be silently ignored")
         n_micro = cfg.parallel.pp_microbatches or 2 * pp
         if cfg.trainer.micro_batch_size % n_micro != 0:
             # not strictly required (the pipeline pads ragged feeds), but a
@@ -295,9 +309,11 @@ def build_trainer(cfg: RunConfig, cleanup: list | None = None):
                 f"micro_batch_size {cfg.trainer.micro_batch_size} not "
                 f"divisible by pp_microbatches {n_micro}")
         layers_fn = make_pipeline_layers_fn(mesh, mcfg, n_micro,
-                                            remat=cfg.actor.remat)
+                                            remat=cfg.actor.remat,
+                                            sp_ring=sp_in_pipeline)
         critic_layers_fn = make_pipeline_layers_fn(mesh, mcfg, n_micro,
-                                                   remat=cfg.critic.remat)
+                                                   remat=cfg.critic.remat,
+                                                   sp_ring=sp_in_pipeline)
 
     if multihost.is_main():
         rollout = _build_rollout(cfg, mcfg, params, tokenizer, cleanup)

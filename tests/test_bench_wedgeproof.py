@@ -140,9 +140,10 @@ def test_dryrun_mesh_list_covers_all_variants():
     pairs = mod._dryrun_mesh_list(8)
     variants = [v for _, v in pairs]
     assert variants == ["grpo", "grpo", "packed_sp", "grpo", "grpo",
-                        "packed_pp", "ppo_critic"]
+                        "packed_pp", "packed_sp_pp", "ppo_critic"]
     dims = [d for d, _ in pairs]
     assert dims[2] == (1, 2, 2, 2, 1, 1)   # packed × ulysses (sp=2, tp=2)
     assert dims[5] == (1, 2, 2, 1, 1, 2)   # packed × pipeline (pp=2)
+    assert dims[6] == (1, 2, 1, 2, 1, 2)   # packed × ring-sp × pipeline
     for d in dims:
         assert int(np.prod(d)) == 8

@@ -177,3 +177,26 @@ def test_build_trainer_packed_pp_wiring():
     assert trainer.actor.layers_fn is not None
     history = trainer.fit()
     assert len(history) == 1 and "actor/pg_loss" in history[0]
+
+
+def test_build_trainer_sp_ring_pp_wiring():
+    """sp × pp at the config surface (r5): sp_mode=ring runs the ring
+    inside the pipeline stages (one fit step, with packed on top);
+    ulysses × pp still fails fast."""
+    cfg = cfg_lib.load_config(overrides=list(_FAST) + [
+        "parallel.sp=2", "parallel.pp=2", "parallel.fsdp=2",
+        "parallel.sp_mode=ring", "parallel.pp_microbatches=2",
+        "trainer.use_remove_padding=true",
+    ])
+    trainer = build_trainer(cfg)
+    assert trainer.actor.layers_fn is not None
+    assert trainer.actor.attn_fn is not None  # default flash, unused by pp
+    history = trainer.fit()
+    assert len(history) == 1 and "actor/pg_loss" in history[0]
+
+    bad = cfg_lib.load_config(overrides=list(_FAST) + [
+        "parallel.sp=2", "parallel.pp=2", "parallel.fsdp=2",
+        "parallel.pp_microbatches=2",  # sp_mode defaults to ulysses
+    ])
+    with pytest.raises(NotImplementedError, match="sp_mode=ring"):
+        build_trainer(bad)
