@@ -74,6 +74,18 @@ class RolloutSection:
     # workers/config/rollout.py:95-101)
     manager_endpoint: str = ""            # "" → spawn the C++ manager locally
     manager_args: tuple = ()              # extra CLI args for the spawned manager
+    # control-plane fault tolerance (ARCHITECTURE.md "Fault-tolerance
+    # layers"): a locally spawned manager runs under a ManagerSupervisor
+    # that respawns it with exponential backoff (base doubling to max) and
+    # replays registered instances/senders/weight version via /reconcile
+    manager_respawn_backoff_s: float = 0.5
+    manager_respawn_backoff_max_s: float = 10.0
+    # mid-stream transport failures re-issue only the unfinished rids, at
+    # most resume_budget times per batch, waiting up to resume_wait_s each
+    # time for the manager to come back; past the budget a colocated local
+    # engine finishes the batch, else ControlPlaneDown surfaces
+    resume_budget: int = 3
+    resume_wait_s: float = 60.0
     transfer_streams: int = 4
     advertise_host: str = "127.0.0.1"
     # multi-NIC weight push (transfer/nic.py): >1 runs one sender agent per

@@ -5,21 +5,50 @@ Plays the roles of the reference's trainer-side HTTP calls
 ``launcher.py:32-49`` spawn_rollout_manager; registration/metrics calls in
 ``stream_ray_trainer.py:691-704`` and ``sglang_http_async_engine.py:102-113``)
 against the C++ ``polyrl-manager`` binary.
+
+Fault tolerance (control-plane tier, ARCHITECTURE.md "Fault-tolerance
+layers"): idempotent JSON calls retry with capped exponential backoff +
+jitter on transport errors and 5xx responses; non-idempotent calls fail
+fast with a typed :class:`ManagerTransportError` so the caller decides
+(re-running ``/generate`` or a version bump is not safe to do blindly).
+When the client is bound to a :class:`~polyrl_tpu.manager.supervisor.
+ManagerSupervisor`, the endpoint re-resolves through it on every attempt —
+a respawned manager binds a fresh ephemeral port and the next retry simply
+lands there.
 """
 
 from __future__ import annotations
 
+import http.client
 import json
 import os
-import shutil
+import random
+import socket
 import subprocess
+import tempfile
 import time
+import urllib.error
 import urllib.request
 from dataclasses import dataclass
 from typing import Any, Iterator
 
 _CPP_DIR = os.path.join(os.path.dirname(__file__), "cpp")
 _BINARY = os.path.join(_CPP_DIR, "polyrl-manager")
+
+
+class ManagerError(RuntimeError):
+    """Base class for control-plane client errors."""
+
+
+class ManagerTransportError(ManagerError):
+    """The manager could not be reached (connection error / timeout /
+    truncated response). Raised immediately for non-idempotent calls and
+    after the retry budget for idempotent ones."""
+
+
+class ControlPlaneDown(ManagerError):
+    """The manager stayed unreachable past the stream resume budget and no
+    local fallback could finish the batch (rollout/remote.py)."""
 
 
 def build_manager(force: bool = False) -> str:
@@ -31,20 +60,42 @@ def build_manager(force: bool = False) -> str:
 
 def spawn_rollout_manager(bind_addr: str = "0.0.0.0:0",
                           config_file: str | None = None,
-                          extra_args: list[str] | None = None):
+                          extra_args: list[str] | None = None,
+                          log_path: str | None = None):
     """Start the manager subprocess; returns (Popen, port). Reads the
-    'LISTENING <port>' line the binary prints (supports ephemeral ports)."""
+    'LISTENING <port>' line the binary prints (supports ephemeral ports).
+
+    stderr (the manager's own log lines) is teed to ``log_path`` — default
+    a per-spawn file under the temp dir — so chaos-test and CI failures are
+    debuggable instead of vanishing into DEVNULL. The path is recorded on
+    the returned Popen as ``manager_log_path``."""
     binary = build_manager()
     cmd = [binary, "--bind-addr", bind_addr]
     if config_file:
         cmd += ["--config-file", config_file]
     cmd += extra_args or []
-    proc = subprocess.Popen(cmd, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
-                            text=True)
+    if log_path is None:
+        log_path = os.path.join(
+            tempfile.gettempdir(),
+            f"polyrl-manager-{os.getpid()}-{time.monotonic_ns()}.log")
+    log_f = open(log_path, "ab")
+    try:
+        proc = subprocess.Popen(cmd, stdout=subprocess.PIPE, stderr=log_f,
+                                text=True)
+    finally:
+        log_f.close()  # the child inherited the fd
+    proc.manager_log_path = log_path
     line = proc.stdout.readline().strip()
     if not line.startswith("LISTENING"):
         proc.kill()
-        raise RuntimeError(f"manager failed to start: {line!r}")
+        tail = ""
+        try:
+            with open(log_path, "rb") as f:
+                tail = f.read()[-2048:].decode(errors="replace").strip()
+        except OSError:
+            pass
+        raise RuntimeError(
+            f"manager failed to start: {line!r} (log {log_path}): {tail}")
     port = int(line.split()[1])
     return proc, port
 
@@ -59,15 +110,44 @@ class GenerateResult:
     error: str = ""
 
 
+# transport-level failures worth retrying (connection refused/reset,
+# timeouts, truncated chunked bodies). urllib.error.HTTPError subclasses
+# URLError and must be handled FIRST (it is a status, not a transport fault).
+_TRANSPORT_ERRORS = (urllib.error.URLError, http.client.HTTPException,
+                     ConnectionError, TimeoutError, socket.timeout, OSError)
+
+
 class ManagerClient:
-    def __init__(self, endpoint: str, timeout_s: float = 600.0):
-        self.endpoint = endpoint if endpoint.startswith("http") else f"http://{endpoint}"
+    def __init__(self, endpoint: str = "", timeout_s: float = 600.0,
+                 supervisor=None, retry_deadline_s: float = 30.0,
+                 max_retries: int = 8, backoff_base_s: float = 0.2,
+                 backoff_max_s: float = 2.0):
+        if not endpoint and supervisor is None:
+            raise ValueError("ManagerClient needs an endpoint or a supervisor")
+        self._endpoint = (endpoint if not endpoint or endpoint.startswith("http")
+                          else f"http://{endpoint}")
+        self.supervisor = supervisor
         self.timeout_s = timeout_s
+        self.retry_deadline_s = retry_deadline_s
+        self.max_retries = max_retries
+        self.backoff_base_s = backoff_base_s
+        self.backoff_max_s = backoff_max_s
+        self.retry_count = 0  # cumulative, surfaced as fault/client_retries
+
+    @property
+    def endpoint(self) -> str:
+        """Current manager base URL; re-resolves through the supervisor (a
+        respawned manager binds a fresh ephemeral port)."""
+        if self.supervisor is not None:
+            ep = self.supervisor.endpoint
+            if ep:
+                return ep if ep.startswith("http") else f"http://{ep}"
+        return self._endpoint
 
     # -- plain JSON calls --------------------------------------------------
 
-    def _call(self, method: str, path: str, payload: dict | None = None,
-              timeout: float | None = None) -> dict:
+    def _call_once(self, method: str, path: str, payload: dict | None = None,
+                   timeout: float | None = None) -> dict:
         data = json.dumps(payload or {}).encode()
         req = urllib.request.Request(
             self.endpoint + path, data=data, method=method,
@@ -75,9 +155,42 @@ class ManagerClient:
         with urllib.request.urlopen(req, timeout=timeout or self.timeout_s) as r:
             return json.loads(r.read() or b"{}")
 
+    def _call(self, method: str, path: str, payload: dict | None = None,
+              timeout: float | None = None, idempotent: bool = False) -> dict:
+        attempt = 0
+        deadline = time.monotonic() + self.retry_deadline_s
+        while True:
+            try:
+                return self._call_once(method, path, payload, timeout)
+            except urllib.error.HTTPError as exc:
+                # status errors (4xx: bad request / ACL 403) are the
+                # caller's problem; only a 5xx on an idempotent call retries
+                if not idempotent or exc.code < 500:
+                    raise
+                err: Exception = exc
+            except _TRANSPORT_ERRORS as exc:
+                if not idempotent:
+                    raise ManagerTransportError(
+                        f"{method} {path} failed: {exc}") from exc
+                err = exc
+            attempt += 1
+            self.retry_count += 1
+            left = deadline - time.monotonic()
+            if attempt > self.max_retries or left <= 0:
+                raise ManagerTransportError(
+                    f"{method} {path} failed after {attempt} attempts: "
+                    f"{err}") from err
+            # capped exponential backoff with jitter in [0.5x, 1.5x]
+            sleep = min(self.backoff_base_s * 2 ** (attempt - 1),
+                        self.backoff_max_s) * (0.5 + random.random())
+            time.sleep(min(sleep, max(left, 0.0)))
+
     def health(self) -> bool:
+        # single probe, no internal retry: wait_healthy/supervisor loops own
+        # the retry cadence and want a fast, honest answer
         try:
-            return self._call("GET", "/health", timeout=3.0).get("status") == "ok"
+            return self._call_once("GET", "/health",
+                                   timeout=3.0).get("status") == "ok"
         except Exception:
             return False
 
@@ -90,15 +203,21 @@ class ManagerClient:
         raise TimeoutError("manager not healthy")
 
     def get_instances_status(self) -> dict:
-        return self._call("GET", "/get_instances_status")
+        return self._call("GET", "/get_instances_status", idempotent=True)
 
     def register_rollout_instance(self, instance_endpoint: str) -> dict:
-        return self._call("POST", "/register_rollout_instance",
-                          {"endpoint": instance_endpoint})
+        out = self._call("POST", "/register_rollout_instance",
+                         {"endpoint": instance_endpoint}, idempotent=True)
+        if self.supervisor is not None:
+            self.supervisor.record_remote_instances([instance_endpoint])
+        return out
 
     def register_local_rollout_instances(self, endpoints: list[str]) -> dict:
-        return self._call("POST", "/register_local_rollout_instances",
-                          {"endpoints": endpoints})
+        out = self._call("POST", "/register_local_rollout_instances",
+                         {"endpoints": endpoints}, idempotent=True)
+        if self.supervisor is not None:
+            self.supervisor.record_local_instances(endpoints)
+        return out
 
     def generate(self, rid: str, input_ids: list[int], sampling_params: dict) -> GenerateResult:
         out = self._call("POST", "/generate", {
@@ -106,9 +225,15 @@ class ManagerClient:
         return self._to_result(out)
 
     def update_weight_version(self) -> int:
-        return int(self._call("POST", "/update_weight_version")["weight_version"])
+        v = int(self._call("POST", "/update_weight_version")["weight_version"])
+        if self.supervisor is not None:
+            self.supervisor.record_weight_version(v)
+        return v
 
     def get_receive_instances(self, sender: str = "") -> dict:
+        # NOT idempotent: the manager CAS-marks returned instances as
+        # updating — a retry after a lost response would strand the first
+        # claim until abort_weight_update
         return self._call("POST", "/get_receive_instances", {"sender": sender})
 
     def update_weights(self, instances: list[str], weight_version: int | None = None) -> dict:
@@ -121,11 +246,16 @@ class ManagerClient:
         return self._call("POST", "/abort_weight_update", {"instances": instances})
 
     def update_weight_senders(self, senders: list[str], groups_per_sender: int = 1) -> dict:
-        return self._call("PUT", "/update_weight_senders",
-                          {"senders": senders, "groups_per_sender": groups_per_sender})
+        out = self._call("PUT", "/update_weight_senders",
+                         {"senders": senders,
+                          "groups_per_sender": groups_per_sender},
+                         idempotent=True)
+        if self.supervisor is not None:
+            self.supervisor.record_weight_senders(senders, groups_per_sender)
+        return out
 
     def update_metrics(self, **stats) -> dict:
-        return self._call("POST", "/update_metrics", stats)
+        return self._call("POST", "/update_metrics", stats, idempotent=True)
 
     def shutdown_instances(self, skip_if_updating_weights: bool = False) -> dict:
         return self._call("POST", "/shutdown_instances",
@@ -135,7 +265,21 @@ class ManagerClient:
         return self._call("POST", "/abort_local_requests")
 
     def resume_local_instances(self) -> dict:
-        return self._call("POST", "/resume_local_instances")
+        return self._call("POST", "/resume_local_instances", idempotent=True)
+
+    def reconcile(self, remote_endpoints: list[str], local_endpoints: list[str],
+                  senders: list[str], groups_per_sender: int,
+                  weight_version: int) -> dict:
+        """Idempotent bulk re-registration (supervisor replay after a
+        manager respawn): already-known endpoints are kept as-is and the
+        weight version is only ever raised, never reset."""
+        return self._call("POST", "/reconcile", {
+            "remote_endpoints": remote_endpoints,
+            "local_endpoints": local_endpoints,
+            "senders": senders,
+            "groups_per_sender": groups_per_sender,
+            "weight_version": weight_version,
+        }, idempotent=True)
 
     # -- streaming batch (the C7 StreamingBatchIterator role) -------------
 
@@ -144,7 +288,10 @@ class ManagerClient:
                               ) -> Iterator[GenerateResult]:
         """POST /batch_generate_requests; yields results as NDJSON lines
         arrive. The first 'notifier' line is consumed internally (it signals
-        batch acceptance — reference stream_batch_iter.py:41-43)."""
+        batch acceptance — reference stream_batch_iter.py:41-43). Transport
+        failures (manager died mid-stream, truncated chunk) raise a typed
+        :class:`ManagerTransportError` so RemoteRollout's stream-resume
+        layer can re-issue only the unfinished rids."""
         payload: dict[str, Any] = {"requests": requests}
         if max_local_gen_s is not None:
             payload["max_local_gen_s"] = max_local_gen_s
@@ -152,15 +299,27 @@ class ManagerClient:
             self.endpoint + "/batch_generate_requests",
             data=json.dumps(payload).encode(), method="POST",
             headers={"Content-Type": "application/json"})
-        with urllib.request.urlopen(req, timeout=self.timeout_s) as r:
-            for raw in r:
-                line = raw.decode().strip()
-                if not line:
-                    continue
-                obj = json.loads(line)
-                if obj.get("type") == "notifier":
-                    continue
-                yield self._to_result(obj)
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout_s) as r:
+                for raw in r:
+                    line = raw.decode().strip()
+                    if not line:
+                        continue
+                    try:
+                        obj = json.loads(line)
+                    except json.JSONDecodeError as exc:
+                        # a line cut mid-byte by a dying manager is a
+                        # transport fault, not a protocol error
+                        raise ManagerTransportError(
+                            f"truncated stream line: {exc}") from exc
+                    if obj.get("type") == "notifier":
+                        continue
+                    yield self._to_result(obj)
+        except urllib.error.HTTPError:
+            raise
+        except _TRANSPORT_ERRORS as exc:
+            raise ManagerTransportError(
+                f"batch stream failed: {exc}") from exc
 
     @staticmethod
     def _to_result(out: dict) -> GenerateResult:

@@ -430,6 +430,53 @@ void register_routes(phttp::Server& server, Manager& mgr) {
     rw.body = "{\"status\":\"ok\"}";
   });
 
+  // Idempotent bulk re-registration for supervisor replay after a respawn
+  // (supervisor.py): already-known endpoints are left untouched (no
+  // pending-state reset, no double health check), the weight version is
+  // only ever RAISED (raise_weight_version_floor — no drain), and senders
+  // are re-installed before instances so re-registrations get sender
+  // assignments. Safe to call any number of times.
+  server.route("POST", "/reconcile",
+               [&, acl_reject](const phttp::Request& req, phttp::ResponseWriter& rw) {
+    if (acl_reject(req, rw)) return;
+    Value body = pjson::Parser::parse(req.body);
+    if (body["senders"].is_arr() && !body["senders"].as_arr().empty()) {
+      std::vector<std::string> senders;
+      for (const auto& s : body["senders"].as_arr()) senders.push_back(s.as_str());
+      int groups = static_cast<int>(body["groups_per_sender"].as_int(
+          mgr.config().groups_per_sender));
+      state.set_weight_senders(std::move(senders), groups);
+    }
+    int64_t version = state.raise_weight_version_floor(
+        body["weight_version"].as_int(0));
+    int64_t added_remote = 0, added_local = 0, kept = 0;
+    for (const auto& epv : body["remote_endpoints"].as_arr()) {
+      const std::string ep = epv.as_str();
+      if (ep.empty()) continue;
+      if (state.has_instance(ep)) { ++kept; continue; }
+      state.register_instance(ep, false);
+      mgr.health_check_async(ep);
+      ++added_remote;
+    }
+    for (const auto& epv : body["local_endpoints"].as_arr()) {
+      const std::string ep = epv.as_str();
+      if (ep.empty()) continue;
+      if (state.has_instance(ep)) { ++kept; continue; }
+      state.register_instance(ep, true);
+      ++added_local;
+    }
+    Object o;
+    o["status"] = Value("ok");
+    o["added_remote"] = Value(added_remote);
+    o["added_local"] = Value(added_local);
+    o["kept"] = Value(kept);
+    o["weight_version"] = Value(version);
+    rw.body = Value(std::move(o)).dump();
+    log_line("reconcile: +" + std::to_string(added_remote) + " remote, +" +
+             std::to_string(added_local) + " local, " + std::to_string(kept) +
+             " kept, weight_version " + std::to_string(version));
+  });
+
   server.route("POST", "/generate",
                [&](const phttp::Request& req, phttp::ResponseWriter& rw) {
     Value body = pjson::Parser::parse(req.body);

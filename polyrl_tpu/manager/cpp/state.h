@@ -93,6 +93,11 @@ class AppState {
     }
   }
 
+  bool has_instance(const std::string& endpoint) {
+    std::lock_guard<std::mutex> g(mu_);
+    return instances_.count(endpoint) > 0;
+  }
+
   void deregister(const std::string& endpoint) {
     std::lock_guard<std::mutex> g(mu_);
     active_.erase(endpoint);
@@ -217,6 +222,17 @@ class AppState {
 
   int64_t weight_version() {
     std::lock_guard<std::mutex> g(mu_);
+    return weight_version_;
+  }
+
+  // Supervisor replay after a respawn (/reconcile): restore the version a
+  // crashed predecessor had reached WITHOUT the drain semantics of
+  // update_weight_version — the fresh registry has nothing to drain, and a
+  // replayed bump must never re-trigger a pool reset. Monotonic: a stale
+  // replay can only raise the version, never rewind it.
+  int64_t raise_weight_version_floor(int64_t version) {
+    std::lock_guard<std::mutex> g(mu_);
+    if (version > weight_version_) weight_version_ = version;
     return weight_version_;
   }
 

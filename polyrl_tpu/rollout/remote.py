@@ -32,7 +32,8 @@ from typing import Any, Iterator
 
 import numpy as np
 
-from polyrl_tpu.manager.client import GenerateResult, ManagerClient
+from polyrl_tpu.manager.client import (ControlPlaneDown, GenerateResult,
+                                       ManagerClient, ManagerTransportError)
 from polyrl_tpu.rollout.sampling import SamplingParams
 
 log = logging.getLogger(__name__)
@@ -45,18 +46,52 @@ class RemoteRollout:
         transfer=None,               # TransferInterface (trainer-side fabric)
         local_server=None,           # colocated RolloutServer (time-sliced)
         pad_token_id: int = 0,
+        resume_budget: int = 3,      # mid-stream re-issues per batch
+        resume_wait_s: float = 60.0,  # per-resume wait for manager recovery
     ):
         self.manager = manager
         self.transfer = transfer
         self.local_server = local_server
         self.pad_token_id = pad_token_id
+        self.resume_budget = resume_budget
+        self.resume_wait_s = resume_wait_s
         self.weight_version = 0
         self.last_gen_throughput = 0.0
         self.dropped_groups = 0
+        # control-plane fault counters (cumulative; trainer gauges them)
+        self.stream_resumes = 0
+        self.local_fallbacks = 0
         # per-stream nonce keeps rids globally unique: concurrent streams
         # (nested REMAX baselines, validation overlapping training) would
         # otherwise collide on bare indices at the shared engines
         self._stream_seq = itertools.count()
+
+    def fault_counters(self) -> dict[str, float]:
+        """Cumulative control-plane fault metrics (supervisor restarts,
+        client retries, stream resumes/fallbacks, dropped groups)."""
+        out = {
+            "fault/stream_resumes": float(self.stream_resumes),
+            "fault/local_fallbacks": float(self.local_fallbacks),
+            "fault/dropped_groups": float(self.dropped_groups),
+        }
+        retries = getattr(self.manager, "retry_count", None)
+        if retries is not None:
+            out["fault/client_retries"] = float(retries)
+        supervisor = getattr(self.manager, "supervisor", None)
+        if supervisor is not None:
+            out["fault/manager_restarts"] = float(supervisor.restarts)
+        return out
+
+    def _wait_manager_recovery(self) -> bool:
+        """Poll /health until the manager answers (the supervisor respawn
+        lands on a fresh port the client re-resolves) or the resume-wait
+        budget expires."""
+        deadline = time.monotonic() + self.resume_wait_s
+        while time.monotonic() < deadline:
+            if self.manager.health():
+                return True
+            time.sleep(0.25)
+        return False
 
     # -- streaming generation ------------------------------------------------
 
@@ -134,13 +169,91 @@ class RemoteRollout:
         # inflate elapsed in exactly the overlapped mode this measures
         gen_end = [gen_t0]
 
-        def reader() -> None:
-            # drains the NDJSON stream so the manager is never backpressured
-            # by training compute (reference stream_batch_iter drain loop)
+        def finish_locally(pending: dict) -> None:
+            # last-resort degrade: the manager stayed down past the resume
+            # budget but a colocated engine exists — finish the batch
+            # in-process rather than losing it. The engine may have been
+            # released by the window timer; resume for the fallback and
+            # hand the HBM back afterwards if so.
+            eng = self.local_server.engine
+            was_released = released.is_set()
+            if hasattr(eng, "resume_memory"):
+                eng.resume_memory()
             try:
-                for res in self.manager.batch_generate_stream(
-                        reqs, max_local_gen_s=max_local_gen_s):
-                    q.put(res)
+                items = list(pending.values())
+                outs = eng.generate([r["input_ids"] for r in items], sampling)
+                for r, o in zip(items, outs):
+                    if isinstance(o, dict):
+                        ids, lps = o["token_ids"], o["logprobs"]
+                        reason = o.get("finish_reason", "stop")
+                    else:
+                        ids = list(o.output_ids)
+                        lps = list(o.output_token_logprobs)
+                        reason = getattr(o, "finish_reason", "stop")
+                    q.put(GenerateResult(
+                        rid=r["rid"], success=reason != "error",
+                        output_token_ids=[int(t) for t in ids],
+                        output_token_logprobs=[float(x) for x in lps],
+                        finish_reason=reason,
+                        error="" if reason != "error" else "local fallback"))
+            finally:
+                if was_released and hasattr(eng, "release_memory"):
+                    try:
+                        eng.release_memory()
+                    except Exception:  # noqa: BLE001 — best-effort handback
+                        log.exception("fallback release_memory failed")
+
+        def run_stream() -> None:
+            # drains the NDJSON stream so the manager is never backpressured
+            # by training compute (reference stream_batch_iter drain loop).
+            # Stream-level resume: a mid-stream transport failure re-issues
+            # ONLY the rids without a terminal result yet (completed ones
+            # were already queued for group assembly) against the recovered
+            # manager, at most resume_budget times.
+            pending = {r["rid"]: r for r in reqs}
+            budget = self.resume_budget
+            while pending:
+                failure: ManagerTransportError | None = None
+                try:
+                    for res in self.manager.batch_generate_stream(
+                            list(pending.values()),
+                            max_local_gen_s=max_local_gen_s):
+                        pending.pop(res.rid, None)
+                        q.put(res)
+                except ManagerTransportError as exc:
+                    failure = exc
+                if not pending:
+                    return  # every rid got a terminal result
+                if failure is None:
+                    # the manager answers EVERY rid before ending the
+                    # stream, so a "clean" end with rids missing is a
+                    # truncated stream: a SIGKILLed manager closes the
+                    # socket at a chunk boundary, which http.client reads
+                    # as EOF, not as an error
+                    failure = ManagerTransportError(
+                        f"stream ended with {len(pending)} rids unanswered")
+                log.warning(
+                    "manager stream failed with %d/%d rids pending (%s); "
+                    "attempting resume (%d left in budget)",
+                    len(pending), len(reqs), failure, budget)
+                if budget > 0 and self._wait_manager_recovery():
+                    budget -= 1
+                    self.stream_resumes += 1
+                    continue
+                if self.local_server is not None:
+                    self.local_fallbacks += 1
+                    log.warning("control plane down; finishing %d requests "
+                                "on the colocated engine", len(pending))
+                    finish_locally(pending)
+                    return
+                raise ControlPlaneDown(
+                    f"manager unreachable after {self.resume_budget} stream "
+                    f"resumes; {len(pending)} requests outstanding"
+                ) from failure
+
+        def reader() -> None:
+            try:
+                run_stream()
                 gen_end[0] = time.monotonic()
                 q.put(None)
             except Exception as exc:  # noqa: BLE001
@@ -153,6 +266,7 @@ class RemoteRollout:
 
         groups: dict[int, list[tuple[int, GenerateResult]]] = {}
         failed_groups: set[int] = set()
+        seen_rids: set[str] = set()
         pending: list[tuple[int, GenerateResult]] = []
         # try/finally: if the consumer abandons the generator or the stream
         # raises, the window timer must die and the colocated engine's KV
@@ -166,6 +280,12 @@ class RemoteRollout:
                 if isinstance(item, Exception):
                     raise item
                 res: GenerateResult = item
+                if res.rid in seen_rids:
+                    # exactly-once guard across stream resumes: a result
+                    # delivered just before the transport failure must not
+                    # be double-counted if a re-issue races it
+                    continue
+                seen_rids.add(res.rid)
                 idx = int(res.rid.rsplit(":", 1)[-1])
                 g = idx // group_size
                 if g in failed_groups:

@@ -127,18 +127,27 @@ def _build_rollout(cfg: RunConfig, mcfg, params, tokenizer, cleanup: list):
     if cfg.rollout.mode != "disaggregated":
         raise ValueError(f"unknown rollout.mode {cfg.rollout.mode!r}")
 
-    from polyrl_tpu.manager.client import ManagerClient, spawn_rollout_manager
+    from polyrl_tpu.manager.client import ManagerClient
+    from polyrl_tpu.manager.supervisor import ManagerSupervisor
     from polyrl_tpu.rollout.remote import RemoteRollout
     from polyrl_tpu.transfer import TransferInterface
 
     endpoint = cfg.rollout.manager_endpoint
     if not endpoint:
-        proc, port = spawn_rollout_manager(
-            extra_args=list(cfg.rollout.manager_args))
-        cleanup.append(proc.kill)
-        endpoint = f"127.0.0.1:{port}"
-        log.info("spawned rollout manager on %s", endpoint)
-    mgr = ManagerClient(endpoint)
+        # locally spawned manager runs SUPERVISED: crash/health failure →
+        # backoff respawn + /reconcile state replay, and the client below
+        # re-resolves the fresh ephemeral port through the supervisor
+        supervisor = ManagerSupervisor(
+            extra_args=list(cfg.rollout.manager_args),
+            respawn_backoff_s=cfg.rollout.manager_respawn_backoff_s,
+            respawn_backoff_max_s=cfg.rollout.manager_respawn_backoff_max_s,
+        ).start()
+        cleanup.append(supervisor.stop)
+        mgr = supervisor.client()
+        log.info("spawned supervised rollout manager on %s (log: %s)",
+                 supervisor.endpoint, supervisor.log_path)
+    else:
+        mgr = ManagerClient(endpoint)
     mgr.wait_healthy()
     template = params
     if cfg.trainer.weight_sync == "lora_delta":
@@ -165,7 +174,6 @@ def _build_rollout(cfg: RunConfig, mcfg, params, tokenizer, cleanup: list):
         # resumes its KV HBM around the generation phase (reference
         # sglang_http_async_engine.py:43-113 + stream_fsdp_workers.py:468-492)
         from polyrl_tpu.rollout.cb_engine import CBEngine
-        from polyrl_tpu.rollout.serve import register_with_manager
         from polyrl_tpu.rollout.server import RolloutServer
 
         eng = CBEngine(
@@ -179,11 +187,16 @@ def _build_rollout(cfg: RunConfig, mcfg, params, tokenizer, cleanup: list):
                if cfg.rollout.prompt_buckets else {}))
         local_server = RolloutServer(eng, host="127.0.0.1", port=0).start()
         cleanup.append(local_server.stop)
-        register_with_manager(local_server, endpoint, is_local=True)
+        # register through the trainer's client (not a fresh one): the
+        # supervisor then records the local endpoint for replay after a
+        # manager respawn
+        mgr.register_local_rollout_instances([local_server.endpoint])
         log.info("colocated local engine registered at %s",
                  local_server.endpoint)
     return RemoteRollout(mgr, transfer=iface, local_server=local_server,
-                         pad_token_id=pad)
+                         pad_token_id=pad,
+                         resume_budget=cfg.rollout.resume_budget,
+                         resume_wait_s=cfg.rollout.resume_wait_s)
 
 
 def _build_mesh(cfg: RunConfig):

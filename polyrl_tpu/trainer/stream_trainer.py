@@ -23,6 +23,7 @@ Two rollout modes behind one loop:
 from __future__ import annotations
 
 import dataclasses
+import logging
 import time
 from typing import Any, Callable
 
@@ -40,6 +41,8 @@ from polyrl_tpu.trainer.critic import CriticConfig, StreamCritic
 from polyrl_tpu.utils import checkpoint as ckpt_lib
 from polyrl_tpu.utils.flops import FlopsCounter
 from polyrl_tpu.utils.metrics import MetricsTracker, marked_timer
+
+log = logging.getLogger(__name__)
 
 
 class _ResultView:
@@ -555,6 +558,16 @@ class StreamRLTrainer:
             # round DOWN (floor one full shard): rounding up could exceed
             # micro_token_budget — the HBM guard it exists to be
             rows_div = mesh.shape.get("dp", 1) * mesh.shape.get("fsdp", 1)
+            if cfg.micro_token_budget > 0 and rows_div > n_rows:
+                # the one-row-per-batch-shard floor would silently EXCEED
+                # the budget (rows_div*pack_len > micro_token_budget):
+                # that defeats the HBM guard, so fail loudly (advisor r5)
+                raise ValueError(
+                    f"micro_token_budget={cfg.micro_token_budget} cannot fit"
+                    f" one packed row per batch shard: dp*fsdp={rows_div}"
+                    f" rows x pack_len={pack_len} ="
+                    f" {rows_div * pack_len} tokens minimum; raise the"
+                    f" budget or shrink dp*fsdp/pack_len")
             n_rows = max(rows_div, n_rows // rows_div * rows_div)
         return pack_len, n_rows
 
@@ -910,6 +923,10 @@ class StreamRLTrainer:
             metrics.update(self._flops.step_metrics(
                 state["n_tokens"], state["n_tokens"] / n_traj, step_time))
             if isinstance(self.rollout, RemoteRollout):
+                # control-plane fault counters (supervisor restarts, client
+                # retries, stream resumes): cumulative gauges, visible every
+                # step so a chaos event is observable in the step record
+                metrics.update_gauge(self.rollout.fault_counters())
                 # actuating metrics: the balancer returns the next
                 # local-generation budget (handlers.rs:867-901)
                 resp = self.rollout.update_metrics(

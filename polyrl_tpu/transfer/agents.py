@@ -236,10 +236,21 @@ class ReceiverAgent:
                 return final
             # completion tail: emit the remaining entries, one lock hold
             # per tensor (the NEXT round's prepare waits out at most one
-            # device_put, not the whole tail)
+            # device_put, not the whole tail). The round id AND armed
+            # version are re-read under the lock on EVERY iteration, and
+            # emission is gated on the current round's landed coverage: a
+            # SAME-version re-push (sender retry) arming mid-tail changes
+            # sockets._round and resets coverage, which restarts the tail
+            # and blocks it until the new round's bytes land — without
+            # this the tail would keep emitting buffer ranges the retry's
+            # streams are actively overwriting (advisor r5; the old code
+            # only checked the round once and leaned on the implicit
+            # byte-identical-same-version invariant).
             superseded = False
-            tail_checked = False
+            if final != target:
+                emitted, tail_round = 0, None  # stale pre-wait progress
             while not superseded:
+                progressed = False
                 with self._install_lock:
                     with self._version_cv:
                         armed = self._armed_version
@@ -258,16 +269,32 @@ class ReceiverAgent:
                         emitted, tail_round = 0, None
                         superseded = True
                         continue
-                    if not tail_checked:
-                        if final != target or tail_round is None \
-                                or self.sockets._round != tail_round:
-                            emitted = 0
-                        tail_checked = True
+                    rnd = self.sockets._round
+                    if rnd != tail_round:
+                        # re-push of the SAME version restarted the round:
+                        # start over against its (reset) coverage
+                        tail_round, emitted = rnd, 0
                     if emitted >= len(self.layout.entries):
                         return final
-                    e = self.layout.entries[emitted]
-                    on_tensor(e, self.buffer[e.offset : e.offset + e.nbytes])
-                    emitted += 1
+                    es = covered_entries(self.layout,
+                                         self.sockets.coverage(), emitted,
+                                         limit=1)
+                    if es:
+                        e = es[0]
+                        on_tensor(e,
+                                  self.buffer[e.offset : e.offset + e.nbytes])
+                        emitted += 1
+                        progressed = True
+                if not progressed:
+                    # mid re-push: the next entry's bytes have not landed
+                    # yet — wait for stream progress instead of emitting
+                    # bytes that are being overwritten
+                    if time.monotonic() > deadline:
+                        raise TimeoutError(
+                            f"weights v{final} install tail stalled behind "
+                            f"an incomplete re-push")
+                    with self._version_cv:
+                        self._version_cv.wait(0.05)
 
     def stop(self) -> None:
         self._stop.set()

@@ -24,11 +24,19 @@ class MetricsTracker:
         self._sums = defaultdict(float)
         self._counts = defaultdict(int)
         self._timings = defaultdict(float)
+        self._gauges: dict[str, float] = {}
 
     def update(self, metrics: dict[str, Any]) -> None:
         for k, v in metrics.items():
             self._sums[k] += float(v)
             self._counts[k] += 1
+
+    def update_gauge(self, metrics: dict[str, Any]) -> None:
+        """Last-value-wins metrics: cumulative counters (control-plane
+        restart/resume/retry totals) would be distorted by the averaging
+        `update` applies to repeated keys within a step."""
+        for k, v in metrics.items():
+            self._gauges[k] = float(v)
 
     def add_timing(self, name: str, seconds: float) -> None:
         self._timings[name] += seconds
@@ -36,6 +44,7 @@ class MetricsTracker:
     def as_dict(self) -> dict[str, float]:
         out = {k: self._sums[k] / self._counts[k] for k in self._sums}
         out.update({f"timing_s/{k}": v for k, v in self._timings.items()})
+        out.update(self._gauges)
         return out
 
 

@@ -7,15 +7,33 @@ and collectives are exercised host-side on a virtual device mesh
 
 import os
 
+
+def _xla_flag_supported(flag: str) -> bool:
+    """An UNKNOWN flag in XLA_FLAGS is a hard process abort (SIGABRT in
+    parse_flags_from_env) at first backend init — worse than the problem
+    any optional flag solves. The image's jaxlib can predate a flag (this
+    VM image migrates), so probe the binary for the flag-registry string
+    before adding it."""
+    try:
+        import jaxlib
+
+        so = os.path.join(os.path.dirname(jaxlib.__file__), "xla_extension.so")
+        with open(so, "rb") as f:
+            return flag.encode() in f.read()
+    except Exception:  # noqa: BLE001 — unknown layout: assume supported
+        return True
+
+
 os.environ["JAX_PLATFORMS"] = "cpu"
-os.environ["XLA_FLAGS"] = (
-    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
-    # 8 virtual devices share ONE core: a loaded box can miss XLA:CPU's
-    # default 40 s collective-rendezvous termination window, which ABORTS
-    # the whole pytest process. Slow is fine; aborted is not.
-    + " --xla_cpu_collective_call_warn_stuck_timeout_seconds=120"
-    + " --xla_cpu_collective_call_terminate_timeout_seconds=1200"
-)
+_flags = " --xla_force_host_platform_device_count=8"
+# 8 virtual devices share ONE core: a loaded box can miss XLA:CPU's
+# default 40 s collective-rendezvous termination window, which ABORTS
+# the whole pytest process. Slow is fine; aborted is not. (Skipped on
+# jaxlibs that predate the flags — see _xla_flag_supported.)
+if _xla_flag_supported("xla_cpu_collective_call_warn_stuck_timeout_seconds"):
+    _flags += (" --xla_cpu_collective_call_warn_stuck_timeout_seconds=120"
+               " --xla_cpu_collective_call_terminate_timeout_seconds=1200")
+os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + _flags
 
 import jax  # noqa: E402
 

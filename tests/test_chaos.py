@@ -3,6 +3,8 @@ exists; the build should add one'): cascading instance deaths with
 token-level continuation, retry-budget exhaustion, transfer failure during
 an active stream, and optimizer host offload round-trip."""
 
+import os
+import signal
 import time
 
 import jax
@@ -106,6 +108,70 @@ def test_weight_update_failure_keeps_manager_consistent(manager):
         got2 = manager.get_receive_instances()
         assert eng.endpoint in endpoints(got2)
     finally:
+        eng.stop()
+
+
+def test_manager_sigkill_midstream_supervisor_resumes_stream():
+    """Control-plane chaos (the tier ABOVE engine continuation): kill -9 the
+    manager while generate_stream has completed ~1/3 of its groups. The
+    supervisor must respawn it on a fresh port, replay the registered
+    instance, and the stream must re-issue ONLY the unfinished rids — the
+    final result set covers every group exactly once, with the restart and
+    resume counters visible in the fault metrics."""
+    from polyrl_tpu.manager.supervisor import ManagerSupervisor
+    from polyrl_tpu.rollout.remote import RemoteRollout
+    from polyrl_tpu.rollout.sampling import SamplingParams
+    from polyrl_tpu.utils.metrics import MetricsTracker
+
+    sup = ManagerSupervisor(
+        bind_addr="127.0.0.1:0",
+        extra_args=["--health-check-interval-s", "0.1",
+                    "--stats-poll-interval-s", "0.2",
+                    "--generate-timeout-ms", "10000",
+                    "--schedule-wait-timeout-ms", "3000",
+                    "--generate-workers", "2"],
+        health_interval_s=0.2, health_failures=2,
+        respawn_backoff_s=0.1, respawn_backoff_max_s=0.5).start()
+    client = sup.client()
+    # 2 generate workers x (6 tokens x 50 ms) per request serializes the
+    # batch into waves, so the kill lands with most rids still pending
+    eng = FakeEngine(token_delay_s=0.05, start_token=1000).start()
+    try:
+        client.wait_healthy()
+        client.register_rollout_instance(eng.endpoint)
+        wait_active(client, 1)
+        rr = RemoteRollout(client, resume_budget=3, resume_wait_s=30.0)
+        n_prompts, group_size = 12, 2
+        sampling = SamplingParams(max_new_tokens=6, stop_token_ids=())
+        got: list[int] = []
+        killed = False
+        victim_pid = sup.proc.pid
+        for chunk in rr.generate_stream([[1, 2]] * n_prompts, sampling,
+                                        group_size=group_size,
+                                        min_emit=group_size):
+            for i, res in chunk:
+                got.append(i)
+                assert res.success
+                assert len(res.output_token_ids) == 6
+            if not killed and len(got) >= n_prompts // 3:
+                os.kill(victim_pid, signal.SIGKILL)
+                killed = True
+        assert killed, "stream finished before the kill could land"
+        # every group covered, zero duplicates, re-issued exactly once
+        assert sorted(got) == list(range(n_prompts))
+        assert sup.restarts >= 1
+        assert rr.stream_resumes >= 1
+        counters = rr.fault_counters()
+        assert counters["fault/manager_restarts"] >= 1.0
+        assert counters["fault/stream_resumes"] >= 1.0
+        # and they surface in a step metrics record via the gauge path
+        mt = MetricsTracker()
+        mt.update_gauge(counters)
+        rec = mt.as_dict()
+        assert rec["fault/manager_restarts"] >= 1.0
+        assert rec["fault/stream_resumes"] >= 1.0
+    finally:
+        sup.stop()
         eng.stop()
 
 

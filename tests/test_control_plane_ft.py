@@ -1,0 +1,298 @@
+"""Control-plane fault-tolerance units: ManagerClient retry/backoff against
+a flaky HTTP stub, RemoteRollout stream-level resume against flaky stream
+stubs, and ManagerSupervisor respawn + /reconcile state replay against the
+real C++ binary (ARCHITECTURE.md "Fault-tolerance layers")."""
+
+import json
+import os
+import signal
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from types import SimpleNamespace
+
+import pytest
+
+from polyrl_tpu.manager.client import (ControlPlaneDown, GenerateResult,
+                                       ManagerClient, ManagerTransportError)
+from polyrl_tpu.manager.supervisor import ManagerSupervisor
+from polyrl_tpu.rollout.remote import RemoteRollout
+from polyrl_tpu.rollout.sampling import SamplingParams
+from tests.fake_engine import FakeEngine
+
+
+# -- flaky HTTP stub ---------------------------------------------------------
+
+
+class FlakyStub:
+    """HTTP server whose per-request behavior is scripted: 'drop' closes the
+    connection before any response bytes, '500'/'404' return that status,
+    'ok' serves a canned JSON body. A drained script serves 'ok'."""
+
+    def __init__(self, script):
+        self.script = list(script)
+        self.requests: list[tuple[str, str]] = []
+        self._lock = threading.Lock()
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet
+                pass
+
+            def _behave(self):
+                length = int(self.headers.get("Content-Length", 0))
+                if length:
+                    self.rfile.read(length)
+                with outer._lock:
+                    outer.requests.append((self.command, self.path))
+                    mode = outer.script.pop(0) if outer.script else "ok"
+                if mode == "drop":
+                    self.connection.close()
+                    return
+                if mode in ("500", "404"):
+                    body = b'{"error":"scripted"}'
+                    self.send_response(int(mode))
+                else:
+                    body = json.dumps({"status": "ok", "instances": [],
+                                       "weight_version": 0}).encode()
+                    self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            do_GET = do_POST = do_PUT = _behave
+
+        self.server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.port = self.server.server_address[1]
+        threading.Thread(target=self.server.serve_forever, daemon=True).start()
+
+    @property
+    def endpoint(self) -> str:
+        return f"127.0.0.1:{self.port}"
+
+    def stop(self):
+        self.server.shutdown()
+
+
+def _client(stub, **kw):
+    kw.setdefault("backoff_base_s", 0.01)
+    kw.setdefault("backoff_max_s", 0.05)
+    return ManagerClient(stub.endpoint, **kw)
+
+
+def test_idempotent_call_retries_through_500s():
+    stub = FlakyStub(["500", "500"])
+    try:
+        client = _client(stub)
+        out = client.get_instances_status()
+        assert out["status"] == "ok"
+        assert client.retry_count == 2
+        assert len(stub.requests) == 3
+    finally:
+        stub.stop()
+
+
+def test_idempotent_call_retries_through_dropped_connections():
+    stub = FlakyStub(["drop", "drop"])
+    try:
+        client = _client(stub)
+        assert client.update_metrics(step_time_s=1.0) == {
+            "status": "ok", "instances": [], "weight_version": 0}
+        assert client.retry_count == 2
+    finally:
+        stub.stop()
+
+
+def test_retry_budget_exhausts_with_typed_error():
+    stub = FlakyStub(["500"] * 20)
+    try:
+        client = _client(stub, max_retries=3, retry_deadline_s=5.0)
+        with pytest.raises(ManagerTransportError):
+            client.get_instances_status()
+        assert client.retry_count == 4  # 1 initial + 3 retries, then typed
+    finally:
+        stub.stop()
+
+
+def test_non_idempotent_call_fails_fast():
+    stub = FlakyStub(["drop"] * 5)
+    try:
+        client = _client(stub)
+        t0 = time.monotonic()
+        with pytest.raises(ManagerTransportError):
+            client.generate("r1", [1, 2], {"max_new_tokens": 2})
+        assert time.monotonic() - t0 < 2.0  # no backoff loop
+        assert client.retry_count == 0
+        assert len(stub.requests) == 1  # exactly one wire attempt
+    finally:
+        stub.stop()
+
+
+def test_4xx_propagates_without_retry():
+    import urllib.error
+
+    stub = FlakyStub(["404"])
+    try:
+        client = _client(stub)
+        with pytest.raises(urllib.error.HTTPError):
+            client.get_instances_status()
+        assert client.retry_count == 0
+    finally:
+        stub.stop()
+
+
+# -- stream-level resume -----------------------------------------------------
+
+
+def _mk(rid, n=3):
+    return GenerateResult(rid=rid, success=True,
+                          output_token_ids=list(range(n)),
+                          output_token_logprobs=[-0.1] * n,
+                          finish_reason="stop")
+
+
+class _FlakyStreamManager:
+    """Serves batch streams, dying after ``fail_after`` results on the first
+    ``fail_times`` calls; later calls serve every requested rid."""
+
+    def __init__(self, fail_after, fail_times=1, healthy=True):
+        self.fail_after = fail_after
+        self.fail_times = fail_times
+        self.healthy = healthy
+        self.calls: list[list[str]] = []
+
+    def health(self):
+        return self.healthy
+
+    def resume_local_instances(self):
+        return {}
+
+    def batch_generate_stream(self, requests, max_local_gen_s=None):
+        self.calls.append([r["rid"] for r in requests])
+        failing = len(self.calls) <= self.fail_times
+        n = self.fail_after if failing else len(requests)
+        for r in requests[:n]:
+            yield _mk(r["rid"])
+        if failing:
+            raise ManagerTransportError("injected stream failure")
+
+
+def test_stream_resume_reissues_only_pending_rids():
+    mgr = _FlakyStreamManager(fail_after=3)
+    rr = RemoteRollout(mgr, resume_budget=2, resume_wait_s=5.0)
+    chunks = list(rr.generate_stream(
+        [[1]] * 8, SamplingParams(max_new_tokens=4), group_size=2, min_emit=2))
+    got = [i for c in chunks for i, _ in c]
+    assert sorted(got) == list(range(8))
+    assert len(set(got)) == len(got)  # exactly once
+    assert rr.stream_resumes == 1
+    assert len(mgr.calls) == 2
+    # the re-issue carried ONLY the rids without a terminal result
+    assert len(mgr.calls[0]) == 8
+    assert sorted(mgr.calls[1]) == sorted(set(mgr.calls[0]) - set(mgr.calls[0][:3]))
+
+
+def test_stream_resume_budget_exhaustion_raises_control_plane_down():
+    mgr = _FlakyStreamManager(fail_after=1, fail_times=99, healthy=False)
+    rr = RemoteRollout(mgr, resume_budget=2, resume_wait_s=0.1)
+    with pytest.raises(ControlPlaneDown):
+        list(rr.generate_stream([[1]] * 4, SamplingParams(max_new_tokens=4),
+                                group_size=2, min_emit=2))
+
+
+def test_stream_falls_back_to_colocated_engine():
+    class _LocalEngine:
+        def __init__(self):
+            self.generated = []
+
+        def resume_memory(self):
+            pass
+
+        def release_memory(self):
+            pass
+
+        def generate(self, prompts, sampling, **kw):
+            self.generated.extend(prompts)
+            return [{"token_ids": [7, 8], "logprobs": [-0.1, -0.2],
+                     "finish_reason": "stop"} for _ in prompts]
+
+    eng = _LocalEngine()
+    mgr = _FlakyStreamManager(fail_after=2, fail_times=99, healthy=False)
+    rr = RemoteRollout(mgr, local_server=SimpleNamespace(engine=eng),
+                       resume_budget=1, resume_wait_s=0.1)
+    chunks = list(rr.generate_stream(
+        [[1]] * 6, SamplingParams(max_new_tokens=4), group_size=2, min_emit=2))
+    got = [i for c in chunks for i, _ in c]
+    assert sorted(got) == list(range(6))
+    assert rr.local_fallbacks == 1
+    assert len(eng.generated) == 4  # only the rids the manager never finished
+    assert rr.fault_counters()["fault/local_fallbacks"] == 1.0
+
+
+def test_fault_counters_flow_into_metrics_gauges():
+    from polyrl_tpu.utils.metrics import MetricsTracker
+
+    rr = RemoteRollout(_FlakyStreamManager(fail_after=0))
+    rr.stream_resumes = 2
+    mt = MetricsTracker()
+    mt.update_gauge(rr.fault_counters())
+    mt.update_gauge(rr.fault_counters())  # gauges are last-value, not averaged
+    out = mt.as_dict()
+    assert out["fault/stream_resumes"] == 2.0
+    assert out["fault/dropped_groups"] == 0.0
+
+
+# -- supervisor respawn + replay (real C++ binary) ---------------------------
+
+_FAST_ARGS = ["--health-check-interval-s", "0.1",
+              "--stats-poll-interval-s", "0.2",
+              "--generate-timeout-ms", "10000",
+              "--schedule-wait-timeout-ms", "3000"]
+
+
+def _wait_active(client, n, deadline=10.0):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < deadline:
+        try:
+            st = client.get_instances_status()
+        except Exception:  # noqa: BLE001 — mid-respawn
+            st = {"instances": []}
+        if len([i for i in st["instances"] if i["healthy"]]) >= n:
+            return
+        time.sleep(0.1)
+    raise TimeoutError(client.get_instances_status())
+
+
+def test_supervisor_respawns_and_replays_state():
+    sup = ManagerSupervisor(
+        bind_addr="127.0.0.1:0", extra_args=_FAST_ARGS,
+        health_interval_s=0.2, health_failures=2,
+        respawn_backoff_s=0.1, respawn_backoff_max_s=0.5).start()
+    client = sup.client()
+    eng = FakeEngine().start()
+    try:
+        client.wait_healthy()
+        assert os.path.exists(sup.log_path)  # stderr teed, not DEVNULL
+        client.register_rollout_instance(eng.endpoint)
+        _wait_active(client, 1)
+        assert client.update_weight_version() == 1
+        assert client.update_weight_version() == 2
+
+        os.kill(sup.proc.pid, signal.SIGKILL)
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < 15 and sup.restarts < 1:
+            time.sleep(0.1)
+        assert sup.restarts >= 1
+        client.wait_healthy(15.0)
+        # replayed: instance registered again and promoted healthy, weight
+        # version restored to the floor (not reset to 0)
+        _wait_active(client, 1)
+        st = client.get_instances_status()
+        assert [i["endpoint"] for i in st["instances"]] == [eng.endpoint]
+        assert st["weight_version"] == 2
+        res = client.generate("sv1", [1, 2], {"max_new_tokens": 3})
+        assert res.success, res.error
+    finally:
+        sup.stop()
+        eng.stop()

@@ -140,6 +140,42 @@ def test_weight_version_orchestration(manager):
         eng.stop()
 
 
+def test_reconcile_is_idempotent_and_never_rewinds(manager):
+    """POST /reconcile (supervisor replay): already-registered endpoints are
+    kept (no pending reset, no double registration) and the weight version
+    is a floor — a stale replay can raise it but never rewind it."""
+    eng = FakeEngine().start()
+    try:
+        manager.register_rollout_instance(eng.endpoint)
+        wait_active(manager, 1)
+        assert manager.update_weight_version() == 1
+        assert manager.update_weight_version() == 2
+        # stale replay (version 1) must not rewind or duplicate
+        out = manager.reconcile([eng.endpoint], [], [], 1, 1)
+        assert out["kept"] == 1 and out["added_remote"] == 0
+        assert out["weight_version"] == 2
+        st = manager.get_instances_status()
+        assert len(st["instances"]) == 1
+        # the kept instance stays ACTIVE: served without a fresh health cycle
+        res = manager.generate("rc1", [1], {"max_new_tokens": 2})
+        assert res.success, res.error
+        # a higher floor applies without draining the pool
+        out2 = manager.reconcile([], [], [], 1, 10)
+        assert out2["weight_version"] == 10
+        res2 = manager.generate("rc2", [1], {"max_new_tokens": 2})
+        assert res2.success, res2.error
+        # new endpoints go through the normal register + health-check path
+        eng2 = FakeEngine().start()
+        try:
+            out3 = manager.reconcile([eng2.endpoint], [], [], 1, 0)
+            assert out3["added_remote"] == 1
+            wait_active(manager, 2)
+        finally:
+            eng2.stop()
+    finally:
+        eng.stop()
+
+
 def test_local_instance_time_slicing(manager):
     """Local instances leave the active pool after max_local_gen_s and get
     an abort; batch still completes on the remote instance."""

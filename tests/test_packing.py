@@ -284,3 +284,28 @@ def test_trainer_e2e_remove_padding_gae_critic():
     assert len(history) == 1
     assert "critic/vf_loss" in history[0]
     assert np.isfinite(history[0]["critic/vf_loss"])
+
+
+def test_pack_geometry_budget_vs_shard_floor_raises():
+    """_pack_geometry must fail loudly (not silently exceed the HBM guard)
+    when the one-row-per-batch-shard floor would push the packed micro past
+    micro_token_budget (advisor r5)."""
+    from types import SimpleNamespace
+
+    import pytest
+
+    from polyrl_tpu.trainer.stream_trainer import StreamRLTrainer, TrainerConfig
+
+    def geometry(budget, pack_len, dp, fsdp):
+        fake = SimpleNamespace(
+            cfg=TrainerConfig(use_remove_padding=True,
+                              micro_token_budget=budget, pack_len=pack_len),
+            actor=SimpleNamespace(mesh=SimpleNamespace(
+                shape={"dp": dp, "fsdp": fsdp})))
+        return StreamRLTrainer._pack_geometry(fake)
+
+    # budget fits one row per shard: floor applies, no error
+    assert geometry(256, 32, 2, 4) == (32, 8)
+    # budget < dp*fsdp*pack_len: the floor would exceed the guard → raise
+    with pytest.raises(ValueError, match="micro_token_budget"):
+        geometry(32, 32, 2, 4)

@@ -23,13 +23,21 @@ from polyrl_tpu.utils.tokenizer import ByteTokenizer
 
 class _StubManager:
     """Yields canned results in a given order (simulating out-of-order
-    completion across the pool)."""
+    completion across the pool). Echoes the caller's actual rids like the
+    real manager does — the stream-resume layer tracks pending rids by
+    exact round-trip, so a result whose rid does not match a request would
+    read as a truncated stream."""
 
     def __init__(self, results):
         self.results = results
 
     def batch_generate_stream(self, requests, max_local_gen_s=None):
-        yield from self.results
+        import dataclasses
+
+        rid_by_idx = {int(r["rid"].rsplit(":", 1)[-1]): r["rid"]
+                      for r in requests}
+        for res in self.results:
+            yield dataclasses.replace(res, rid=rid_by_idx[int(res.rid)])
 
 
 def _res(i, ok=True, n_tok=3):

@@ -595,3 +595,74 @@ def test_streaming_push_fans_out_to_multiple_receivers():
         for rx in rxs:
             rx.stop()
         sender.stop()
+
+
+def test_completion_tail_survives_same_version_repush():
+    """Regression (advisor r5): a SAME-version re-push arming mid-tail must
+    not let the tail emit buffer bytes the retry's streams are overwriting.
+    The old tail checked sockets._round only on its first iteration and its
+    supersede guard compared versions, so a retry round (same version, new
+    round id) could land garbage under tensors still being emitted. The
+    fixed tail re-checks the round under the lock every iteration and gates
+    emission on the new round's landed coverage."""
+    params = small_params(7)
+    layout = build_layout(params)
+    rx = ReceiverAgent(layout, "inst-tail", "127.0.0.1:9",
+                       num_streams=1, listen_host="127.0.0.1",
+                       advertise_host="127.0.0.1")
+    # NOT started: the test drives receiver state directly, playing the
+    # control-channel roles (prepare/transfer_done) itself
+    total = layout.total_bytes
+    pattern_a, pattern_b = 0xA5, 0x5A
+    rx.buffer[:] = pattern_a
+    # a completed round 1: full coverage, version 1 installed
+    rx.sockets.arm(1)
+    with rx.sockets._lock:
+        rx.sockets._progress = {0: total}
+    with rx._version_cv:
+        rx._armed_version = 1
+        rx.version = 1
+
+    emitted: list[tuple[str, bytes]] = []
+    first_emit = threading.Event()
+
+    def on_tensor(e, raw):
+        emitted.append((e.name, bytes(raw)))
+        first_emit.set()
+        time.sleep(0.05)  # open a window for the re-push to arm mid-tail
+
+    def repush():
+        first_emit.wait(timeout=5.0)
+        # the prepare handler's exact sequence: take the install lock,
+        # re-arm the SAME version under a new round id (coverage resets)
+        with rx._install_lock:
+            with rx._version_cv:
+                rx._armed_version = 1
+            rx.sockets.arm(2)
+        rx.buffer[:] = 0  # garbage: round-2 bytes start landing
+        time.sleep(0.25)  # tail must stall here, not emit zeros
+        rx.buffer[:] = pattern_b
+        with rx.sockets._lock:
+            rx.sockets._progress = {0: total}  # round 2 fully landed
+        with rx._version_cv:  # transfer_done for the re-push
+            rx.version = 1
+            rx._version_cv.notify_all()
+
+    t = threading.Thread(target=repush, daemon=True)
+    t.start()
+    try:
+        final = rx.wait_for_version(1, timeout=10.0, on_tensor=on_tensor)
+        t.join(timeout=5.0)
+        assert final == 1
+        names = [n for n, _ in emitted]
+        # every entry installed at least once AFTER the re-push restart
+        assert names[-len(layout.entries):] == [e.name for e in layout.entries]
+        for name, raw in emitted:
+            vals = set(raw)
+            assert vals <= {pattern_a} or vals <= {pattern_b}, (
+                f"{name} emitted torn/garbage bytes: {sorted(vals)[:5]}")
+        # the final install is the re-push's bytes
+        for name, raw in emitted[-len(layout.entries):]:
+            assert set(raw) <= {pattern_b}, name
+    finally:
+        rx.stop()
