@@ -299,18 +299,28 @@ def bench_cb(cfg, params, batch, prompt_len, new_tokens, max_slots=64,
     engine.flush_prefix_cache()
 
     # serving: concurrent requests through the production HTTP surface
+    from polyrl_tpu.obs.histogram import Histogram
+
     server = RolloutServer(engine, host="127.0.0.1", port=0).start()
     counts = [0] * batch
     errs: list[str] = []
 
     ttfts = [0.0] * batch
+    # end-to-end request latency distribution under the full concurrent
+    # load (obs log2 histogram: the same summary the trainer's step
+    # records carry for remote rollout)
+    req_hist = Histogram()
+    hist_lock = threading.Lock()
 
     def worker(lo: int, hi: int) -> None:
         for i in range(lo, hi):
+            t_req = time.monotonic()
             try:
                 counts[i], ttfts[i] = _http_generate(
                     server.endpoint, f"bench-{i}", serve_prompts[i],
                     new_tokens)
+                with hist_lock:
+                    req_hist.observe(time.monotonic() - t_req)
             except Exception as exc:  # noqa: BLE001
                 errs.append(str(exc))
 
@@ -366,6 +376,11 @@ def bench_cb(cfg, params, batch, prompt_len, new_tokens, max_slots=64,
         if ttft_ok else 0.0,
         "ttft_p95_ms": round(float(np.percentile(ttft_ok, 95)) * 1e3, 1)
         if ttft_ok else 0.0,
+        # full request wall (admission + queue + decode), log2-histogram
+        # percentiles — the serving-tail KPI next to the TTFT numbers
+        "req_p50_s": round(req_hist.percentile(50.0), 3),
+        "req_p95_s": round(req_hist.percentile(95.0), 3),
+        "req_p99_s": round(req_hist.percentile(99.0), 3),
     }
 
 

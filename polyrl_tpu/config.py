@@ -140,6 +140,19 @@ class LoggingSection:
 
 
 @dataclass
+class ObsSection:
+    """Observability knobs (ARCHITECTURE.md "Observability"): span tracing
+    with cross-process propagation + Perfetto export, and the per-step
+    manager /metrics scrape."""
+    trace: bool = False                   # span tracer on/off
+    trace_dir: str = ""                   # spans.jsonl + trace.json dump dir
+    trace_buffer: int = 4096              # ring-buffer span capacity
+    # wrap trainer phases in jax.profiler.TraceAnnotation so device traces
+    # (trainer.profile_steps) line up with host spans
+    jax_annotations: bool = False
+
+
+@dataclass
 class RunConfig:
     model: ModelSection = field(default_factory=ModelSection)
     tokenizer: TokenizerSection = field(default_factory=TokenizerSection)
@@ -151,6 +164,7 @@ class RunConfig:
     actor: ActorConfig = field(default_factory=ActorConfig)
     critic: CriticConfig = field(default_factory=CriticConfig)
     logging: LoggingSection = field(default_factory=LoggingSection)
+    obs: ObsSection = field(default_factory=ObsSection)
 
 
 # -- dict ⇄ dataclass -------------------------------------------------------

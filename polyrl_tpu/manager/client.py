@@ -32,6 +32,8 @@ import urllib.request
 from dataclasses import dataclass
 from typing import Any, Iterator
 
+from polyrl_tpu import obs
+
 _CPP_DIR = os.path.join(os.path.dirname(__file__), "cpp")
 _BINARY = os.path.join(_CPP_DIR, "polyrl-manager")
 
@@ -52,9 +54,17 @@ class ControlPlaneDown(ManagerError):
 
 
 def build_manager(force: bool = False) -> str:
-    """Build the C++ manager if needed; returns the binary path."""
-    if force or not os.path.exists(_BINARY):
-        subprocess.run(["make", "-C", _CPP_DIR], check=True, capture_output=True)
+    """(Re)build the C++ manager; returns the binary path. Always runs
+    ``make`` — its dependency check is a no-op when the binary is fresh,
+    and a checked-in binary must not shadow newer sources."""
+    try:
+        subprocess.run(["make", "-C", _CPP_DIR], check=True,
+                       capture_output=True)
+    except (OSError, subprocess.CalledProcessError):
+        # no toolchain on this box: fall back to a prebuilt binary
+        if not force and os.path.exists(_BINARY):
+            return _BINARY
+        raise
     return _BINARY
 
 
@@ -149,14 +159,29 @@ class ManagerClient:
     def _call_once(self, method: str, path: str, payload: dict | None = None,
                    timeout: float | None = None) -> dict:
         data = json.dumps(payload or {}).encode()
+        headers = {"Content-Type": "application/json"}
+        # cross-process trace propagation: the manager echoes the pair in
+        # its request log/response and forwards it to the engines it routes
+        # to, so one request is followable trainer→manager→engine
+        headers.update(obs.trace_headers())
         req = urllib.request.Request(
-            self.endpoint + path, data=data, method=method,
-            headers={"Content-Type": "application/json"})
+            self.endpoint + path, data=data, method=method, headers=headers)
+        t0 = time.monotonic()
         with urllib.request.urlopen(req, timeout=timeout or self.timeout_s) as r:
-            return json.loads(r.read() or b"{}")
+            out = json.loads(r.read() or b"{}")
+        obs.observe("manager/rtt_s", time.monotonic() - t0)
+        return out
 
     def _call(self, method: str, path: str, payload: dict | None = None,
               timeout: float | None = None, idempotent: bool = False) -> dict:
+        with obs.span("manager" + path):
+            return self._call_retrying(method, path, payload, timeout,
+                                       idempotent)
+
+    def _call_retrying(self, method: str, path: str,
+                       payload: dict | None = None,
+                       timeout: float | None = None,
+                       idempotent: bool = False) -> dict:
         attempt = 0
         deadline = time.monotonic() + self.retry_deadline_s
         while True:
@@ -257,6 +282,13 @@ class ManagerClient:
     def update_metrics(self, **stats) -> dict:
         return self._call("POST", "/update_metrics", stats, idempotent=True)
 
+    def metrics_text(self, timeout: float = 5.0) -> str:
+        """Raw Prometheus text from GET /metrics (the trainer scrapes this
+        once per step and merges it into the step record as manager/*)."""
+        req = urllib.request.Request(self.endpoint + "/metrics", method="GET")
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.read().decode()
+
     def shutdown_instances(self, skip_if_updating_weights: bool = False) -> dict:
         return self._call("POST", "/shutdown_instances",
                           {"skip_if_updating_weights": skip_if_updating_weights})
@@ -295,12 +327,16 @@ class ManagerClient:
         payload: dict[str, Any] = {"requests": requests}
         if max_local_gen_s is not None:
             payload["max_local_gen_s"] = max_local_gen_s
+        headers = {"Content-Type": "application/json"}
+        headers.update(obs.trace_headers())
         req = urllib.request.Request(
             self.endpoint + "/batch_generate_requests",
             data=json.dumps(payload).encode(), method="POST",
-            headers={"Content-Type": "application/json"})
+            headers=headers)
         try:
-            with urllib.request.urlopen(req, timeout=self.timeout_s) as r:
+            with obs.span("manager/batch_generate_requests",
+                          n=len(requests)), \
+                    urllib.request.urlopen(req, timeout=self.timeout_s) as r:
                 for raw in r:
                     line = raw.decode().strip()
                     if not line:

@@ -45,11 +45,15 @@ class ResponseWriter {
   int status = 200;
   std::string content_type = "application/json";
   std::string body;
+  // extra response headers, each a full "Name: value\r\n" line (e.g. the
+  // X-Trace-Id echo); emitted by both the plain and the streaming path
+  std::string extra_headers;
 
   bool start_stream() {
     if (streaming_) return true;
     std::string head = "HTTP/1.1 200 OK\r\nContent-Type: " + content_type +
-                       "\r\nTransfer-Encoding: chunked\r\nConnection: close\r\n\r\n";
+                       "\r\nTransfer-Encoding: chunked\r\nConnection: close\r\n" +
+                       extra_headers + "\r\n";
     if (!write_all(head.data(), head.size())) return false;
     streaming_ = true;
     return true;
@@ -71,9 +75,10 @@ class ResponseWriter {
       char head[256];
       const char* status_text = status == 200 ? "OK" : (status == 404 ? "Not Found" : (status == 403 ? "Forbidden" : (status >= 500 ? "Internal Server Error" : "Bad Request")));
       snprintf(head, sizeof(head),
-               "HTTP/1.1 %d %s\r\nContent-Type: %s\r\nContent-Length: %zu\r\nConnection: close\r\n\r\n",
+               "HTTP/1.1 %d %s\r\nContent-Type: %s\r\nContent-Length: %zu\r\nConnection: close\r\n",
                status, status_text, content_type.c_str(), body.size());
-      write_all(head, strlen(head));
+      std::string full = std::string(head) + extra_headers + "\r\n";
+      write_all(full.data(), full.size());
       write_all(body.data(), body.size());
     }
   }
@@ -109,6 +114,10 @@ class Server {
   void route(const std::string& method, const std::string& path, Handler h) {
     routes_[method + " " + path] = std::move(h);
   }
+
+  // Invoked for every request BEFORE the handler runs (request counting,
+  // trace echo). Set once before serve(); runs on worker threads.
+  void set_observer(Handler fn) { observer_ = std::move(fn); }
 
   // bind+listen; returns the bound port (for port 0 = ephemeral) or -1.
   int listen(const std::string& host, int port) {
@@ -167,6 +176,7 @@ class Server {
     req.peer_ip = peer_ip;
     if (read_request(fd, req)) {
       ResponseWriter rw(fd);
+      if (observer_) observer_(req, rw);
       auto it = routes_.find(req.method + " " + req.path);
       if (it == routes_.end()) {
         rw.status = 404;
@@ -242,6 +252,7 @@ class Server {
   }
 
   std::map<std::string, Handler> routes_;
+  Handler observer_;
   int listen_fd_ = -1;
   std::atomic<bool> running_{false};
   size_t workers_;

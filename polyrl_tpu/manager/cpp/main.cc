@@ -43,6 +43,27 @@ static void log_line(const std::string& msg) {
   fprintf(stderr, "[manager %s] %s\n", buf, msg.c_str());
 }
 
+// Trace-context propagation (obs/trace.py): the trainer's client sends
+// X-Trace-Id/X-Span-Id; the value is sanitized hard (it rides into log
+// lines, response headers, and forwarded JSON) — anything outside
+// [A-Za-z0-9._-] is dropped, length capped.
+static std::string sanitize_trace(const std::string& raw) {
+  std::string out;
+  out.reserve(raw.size());
+  for (char c : raw) {
+    if (isalnum(static_cast<unsigned char>(c)) || c == '.' || c == '_' ||
+        c == '-')
+      out += c;
+    if (out.size() >= 64) break;
+  }
+  return out;
+}
+
+static std::string header_of(const phttp::Request& req, const std::string& key) {
+  auto it = req.headers.find(key);  // parsed keys are lowercased
+  return it == req.headers.end() ? std::string() : sanitize_trace(it->second);
+}
+
 class Manager {
  public:
   explicit Manager(Config cfg)
@@ -57,10 +78,22 @@ class Manager {
   // ---- generation with eviction + token-level continuation -------------
   // (reference process_single_generate_request, handlers.rs:330-418)
 
-  Value process_generate(const Value& request, int want_local = -1) {
+  Value process_generate(const Value& request, int want_local = -1,
+                         const std::string& trace_id = std::string(),
+                         const std::string& parent_span = std::string()) {
     std::string rid = request["rid"].as_str();
     PartialResponse acc;
-    Value current = request;
+    // inject the trainer's trace context into the request we forward (and
+    // into every continuation built from it) so the engine's spans join
+    // the same trace the trainer opened
+    Value base = request;
+    if (!trace_id.empty()) {
+      pjson::Object o = base.as_obj();
+      o["trace_id"] = Value(trace_id);
+      o["parent_span"] = Value(parent_span);
+      base = Value(std::move(o));
+    }
+    Value current = base;
     for (int attempt = 0; attempt < cfg_.max_generate_attempts; ++attempt) {
       InstancePtr inst = state_.next_instance(want_local,
                                               cfg_.schedule_wait_timeout_ms);
@@ -101,7 +134,7 @@ class Manager {
         std::thread([ep] { phttp::request("POST", ep, "/shutdown", "{}", 2000); }).detach();
       }
       if (!acc.token_ids.empty()) {
-        current = build_continuation_request(request, acc);
+        current = build_continuation_request(base, acc);
       }
     }
     if (!acc.token_ids.empty()) {
@@ -162,7 +195,9 @@ class Manager {
   // ---- batch generate: NDJSON stream with time-sliced local engines ----
   // (reference timed_batch_generate_requests, handlers.rs:442-564)
 
-  void batch_generate(const Value& body, phttp::ResponseWriter& rw) {
+  void batch_generate(const Value& body, phttp::ResponseWriter& rw,
+                      const std::string& trace_id = std::string(),
+                      const std::string& parent_span = std::string()) {
     const Array& requests = body["requests"].as_arr();
     double max_local_gen_s = body["max_local_gen_s"].is_num()
                                  ? body["max_local_gen_s"].as_num()
@@ -209,8 +244,9 @@ class Manager {
     // which the drain loop waits for before returning.
     for (const auto& r : requests) {
       bool ok = gen_pool_.submit(
-          [this, r, &mu, &cv, &ready, &remaining, &total_resp_tokens] {
-            Value resp = process_generate(r);
+          [this, r, trace_id, parent_span, &mu, &cv, &ready, &remaining,
+           &total_resp_tokens] {
+            Value resp = process_generate(r, -1, trace_id, parent_span);
             total_resp_tokens += resp["completion_tokens"].as_int();
             std::lock_guard<std::mutex> g(mu);
             ready.push_back(resp.dump() + "\n");
@@ -297,11 +333,25 @@ class Manager {
     if (stats_thread_.joinable()) stats_thread_.join();
   }
 
+  // ---- request accounting (per-route totals for /metrics) --------------
+
+  void count_request(const std::string& path) {
+    std::lock_guard<std::mutex> g(hits_mu_);
+    ++route_hits_[path];
+  }
+
+  std::map<std::string, long> route_hits() {
+    std::lock_guard<std::mutex> g(hits_mu_);
+    return route_hits_;
+  }
+
  private:
   Config cfg_;
   AppState state_;
   phttp::WorkerPool gen_pool_;
   std::thread stats_thread_;
+  std::map<std::string, long> route_hits_;
+  std::mutex hits_mu_;
 };
 
 // ---- route registration ----------------------------------------------------
@@ -320,6 +370,20 @@ void register_routes(phttp::Server& server, Manager& mgr) {
     rw.body = "{\"error\":\"sender ip not in allowed_sender_ips\"}";
     return true;
   };
+
+  // request observer: per-route totals (exposed at /metrics) + trace-id
+  // echo into the response headers + request log, so a trainer-side span
+  // can be matched against the manager's own log without guessing.
+  server.set_observer([&mgr](const phttp::Request& req,
+                             phttp::ResponseWriter& rw) {
+    mgr.count_request(req.path);
+    std::string trace = header_of(req, "x-trace-id");
+    if (trace.empty()) return;
+    rw.extra_headers += "X-Trace-Id: " + trace + "\r\n";
+    if (req.path == "/generate" || req.path == "/batch_generate_requests" ||
+        req.path == "/update_weight_version")
+      log_line(req.method + " " + req.path + " trace=" + trace);
+  });
 
   server.route("GET", "/health", [](const phttp::Request&, phttp::ResponseWriter& rw) {
     rw.body = "{\"status\":\"ok\"}";
@@ -402,6 +466,19 @@ void register_routes(phttp::Server& server, Manager& mgr) {
     body += "# TYPE polyrl_mgr_instance_running_reqs gauge\n";
     body += "# TYPE polyrl_mgr_instance_queued_reqs gauge\n";
     body += per;
+    long total_reqs = 0;
+    std::string per_route;
+    for (const auto& kv : mgr.route_hits()) {
+      total_reqs += kv.second;
+      per_route += "polyrl_mgr_requests_total{path=\"" + esc(kv.first) +
+                   "\"} " + std::to_string(kv.second) + "\n";
+    }
+    // unlabeled total: the trainer's per-step scrape merges only unlabeled
+    // series into step records (obs/scrape.py)
+    body += "# TYPE polyrl_mgr_requests counter\npolyrl_mgr_requests " +
+            std::to_string(total_reqs) + "\n";
+    body += "# TYPE polyrl_mgr_requests_total counter\n";
+    body += per_route;
     rw.content_type = "text/plain; version=0.0.4";
     rw.body = body;
   });
@@ -480,13 +557,15 @@ void register_routes(phttp::Server& server, Manager& mgr) {
   server.route("POST", "/generate",
                [&](const phttp::Request& req, phttp::ResponseWriter& rw) {
     Value body = pjson::Parser::parse(req.body);
-    rw.body = mgr.process_generate(body).dump();
+    rw.body = mgr.process_generate(body, -1, header_of(req, "x-trace-id"),
+                                   header_of(req, "x-span-id")).dump();
   });
 
   server.route("POST", "/batch_generate_requests",
                [&](const phttp::Request& req, phttp::ResponseWriter& rw) {
     Value body = pjson::Parser::parse(req.body);
-    mgr.batch_generate(body, rw);
+    mgr.batch_generate(body, rw, header_of(req, "x-trace-id"),
+                       header_of(req, "x-span-id"));
   });
 
   server.route("POST", "/update_weight_version",

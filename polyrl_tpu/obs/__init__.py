@@ -1,0 +1,77 @@
+"""Observability subsystem: span tracing + histogram metrics + scraping.
+
+The pieces (ARCHITECTURE.md "Observability"):
+
+- :mod:`polyrl_tpu.obs.trace` — ``Span``/``Tracer`` with thread-local
+  context, a bounded ring buffer, and Chrome-trace/Perfetto JSON export.
+  Cross-process propagation rides ``X-Trace-Id``/``X-Span-Id`` HTTP headers
+  (ManagerClient → C++ manager → rollout server) so one rollout request can
+  be followed trainer→manager→engine in a single Perfetto timeline.
+- :mod:`polyrl_tpu.obs.histogram` — fixed-bucket log2 ``Histogram``
+  (p50/p95/p99/max) plus a process-global registry any component can
+  ``observe()`` into; the trainer drains it into each step record.
+- :mod:`polyrl_tpu.obs.scrape` — Prometheus text-exposition parser for the
+  manager's ``GET /metrics``, merged into step records as ``manager/*``.
+
+Everything here is import-light (no jax at module load) and no-op-cheap
+when tracing is disabled, so hot paths can call into it unconditionally.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+from polyrl_tpu.obs.histogram import (Histogram, drain_histograms,  # noqa: F401
+                                      observe)
+from polyrl_tpu.obs.scrape import (manager_gauges,  # noqa: F401
+                                   parse_prometheus_text)
+from polyrl_tpu.obs.trace import Tracer, get_tracer  # noqa: F401
+
+_jax_annotations = False
+
+
+def configure(trace: bool | None = None, max_spans: int | None = None,
+              out_dir: str | None = None,
+              jax_annotations: bool | None = None,
+              reset: bool = False) -> Tracer:
+    """Configure the process-global tracer (and the jax-annotation toggle).
+    ``None`` leaves a setting unchanged; ``reset`` clears the span ring
+    buffer and the histogram registry (test isolation / fresh runs)."""
+    global _jax_annotations
+    tracer = get_tracer()
+    if trace is not None:
+        tracer.enabled = trace
+    if max_spans is not None:
+        tracer.set_capacity(max_spans)
+    if out_dir is not None:
+        tracer.out_dir = out_dir or None
+    if jax_annotations is not None:
+        _jax_annotations = jax_annotations
+    if reset:
+        tracer.clear()
+        drain_histograms()
+    return tracer
+
+
+def span(name: str, **attrs):
+    """Open a span on the global tracer (no-op when tracing is disabled)."""
+    return get_tracer().span(name, **attrs)
+
+
+def trace_headers() -> dict[str, str]:
+    """HTTP headers carrying the current trace context ({} when none)."""
+    return get_tracer().headers()
+
+
+def phase_annotation(name: str):
+    """Optional ``jax.profiler.TraceAnnotation`` so device traces line up
+    with host spans (configure(jax_annotations=True)); nullcontext
+    otherwise — jax is only imported when the feature is on."""
+    if not _jax_annotations:
+        return contextlib.nullcontext()
+    try:
+        import jax
+
+        return jax.profiler.TraceAnnotation(name)
+    except Exception:  # noqa: BLE001 — annotation is best-effort
+        return contextlib.nullcontext()

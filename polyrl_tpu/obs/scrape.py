@@ -1,0 +1,42 @@
+"""Prometheus text-exposition parsing for the manager /metrics scrape.
+
+The C++ manager (and the rollout servers) already expose Prometheus text;
+the trainer scrapes the manager once per step and merges the unlabeled
+series into the step record as ``manager/*`` gauges — pool health, queue
+depths, and per-route request totals become greppable next to the
+training metrics instead of needing a separate Prometheus deployment.
+"""
+
+from __future__ import annotations
+
+
+def parse_prometheus_text(text: str) -> dict[str, float]:
+    """Unlabeled ``name value`` series → {name: value}. Labeled series
+    (``name{...}``) are per-instance breakdowns whose label values (raw
+    endpoints) don't fit the flat ``area/name`` step-record namespace —
+    they stay on the /metrics surface for real scrapers."""
+    out: dict[str, float] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        name, _, value = line.partition(" ")
+        if not name or "{" in name:
+            continue
+        try:
+            out[name] = float(value)
+        except ValueError:
+            continue
+    return out
+
+
+def manager_gauges(text: str, strip: str = "polyrl_mgr_",
+                   prefix: str = "manager/") -> dict[str, float]:
+    """Scraped manager metrics → step-record gauge keys
+    (``polyrl_mgr_running_reqs`` → ``manager/running_reqs``)."""
+    out = {}
+    for name, value in parse_prometheus_text(text).items():
+        if name.startswith(strip):
+            name = name[len(strip):]
+        out[prefix + name] = value
+    return out

@@ -50,6 +50,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from polyrl_tpu import obs
 from polyrl_tpu.models import decoder
 from polyrl_tpu.rollout.engine import next_bucket
 from polyrl_tpu.rollout.prefix_cache import PrefixCache
@@ -124,6 +125,7 @@ class _Request:
     sampling: SamplingParams
     out: queue.Queue
     abort: Any  # threading.Event-like or None
+    t_submit: float = 0.0  # admission timestamp (per-request latency obs)
 
 
 @dataclasses.dataclass
@@ -951,7 +953,8 @@ class CBEngine:
     def submit(self, rid: str, input_ids: list[int], sampling: SamplingParams,
                out: queue.Queue | None = None, abort=None) -> queue.Queue:
         out = out if out is not None else queue.Queue()
-        self._queue.put(_Request(rid, list(input_ids), sampling, out, abort))
+        self._queue.put(_Request(rid, list(input_ids), sampling, out, abort,
+                                 time.monotonic()))
         self.num_queued = self._queue.qsize() + len(self._pending)
         return out
 
@@ -1873,6 +1876,15 @@ class CBEngine:
             self.allocator.free(info.pages)
             if self.prefix_cache is not None and info.cache_entries:
                 self.prefix_cache.release(info.cache_entries)
+            # per-request serving telemetry: submit→finalize wall and the
+            # request's effective decode rate (continuous batching means
+            # every request has its OWN elapsed time, unlike the bucketed
+            # engine's shared batch clock)
+            dt = time.monotonic() - info.req.t_submit
+            n = int(self._n_generated[slot])
+            if dt > 0 and n > 0:
+                obs.observe("rollout/decode_tok_s", n / dt)
+                obs.observe("rollout/request_s", dt)
         self._slots[slot] = None
         self._page_table[slot] = 0
         self._seq_lens[slot] = 0

@@ -30,6 +30,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from polyrl_tpu import obs
 from polyrl_tpu.models import decoder
 from polyrl_tpu.rollout.sampling import SamplingParams, sample_token
 
@@ -225,6 +226,14 @@ class RolloutEngine:
                 )
             )
         dt = time.monotonic() - t0
+        if dt > 0:
+            # per-request decode rate distribution (one batch dispatch →
+            # every request shares the wall clock; the spread comes from
+            # early-stopping rows finishing with fewer tokens)
+            for r in results:
+                if r.completion_tokens:
+                    obs.observe("rollout/decode_tok_s",
+                                r.completion_tokens / dt)
         self.last_gen_throughput = total_new / dt if dt > 0 else 0.0
         self.num_running = 0
         return results

@@ -32,6 +32,7 @@ from typing import Any, Iterator
 
 import numpy as np
 
+from polyrl_tpu import obs
 from polyrl_tpu.manager.client import (ControlPlaneDown, GenerateResult,
                                        ManagerClient, ManagerTransportError)
 from polyrl_tpu.rollout.sampling import SamplingParams
@@ -251,9 +252,17 @@ class RemoteRollout:
                     f"resumes; {len(pending)} requests outstanding"
                 ) from failure
 
+        # trace hand-off: the reader drains in its own thread, so the span
+        # context active HERE (the trainer's step span) is captured and
+        # adopted there — the stream and its manager calls nest under the
+        # step instead of starting orphan traces
+        trace_ctx = obs.get_tracer().capture()
+
         def reader() -> None:
             try:
-                run_stream()
+                with obs.get_tracer().adopt(trace_ctx), \
+                        obs.span("rollout/stream", n=len(reqs)):
+                    run_stream()
                 gen_end[0] = time.monotonic()
                 q.put(None)
             except Exception as exc:  # noqa: BLE001
@@ -297,6 +306,15 @@ class RemoteRollout:
                     groups.pop(g, None)
                     self.dropped_groups += 1
                     continue
+                # per-request distribution telemetry (trainer-side view):
+                # time from batch submission to this result, and the
+                # request's effective decode rate over that window — the
+                # tail the balancer reacts to, invisible in step averages
+                lat = time.monotonic() - gen_t0
+                obs.observe("rollout/latency_s", lat)
+                if res.output_token_ids and lat > 0:
+                    obs.observe("rollout/decode_tok_s",
+                                len(res.output_token_ids) / lat)
                 n_tokens += len(res.output_token_ids)
                 groups.setdefault(g, []).append((idx, res))
                 if len(groups[g]) == group_size:
@@ -341,6 +359,19 @@ class RemoteRollout:
             self.local_server.engine.update_weights(
                 engine_copy, version=self.weight_version)
         return self.weight_version
+
+    def scrape_manager_metrics(self) -> dict[str, float]:
+        """One scrape of the manager's GET /metrics, as ``manager/*`` gauge
+        keys for the step record. Best-effort: a scrape miss (manager
+        respawning, stub manager in tests) returns {}."""
+        metrics_text = getattr(self.manager, "metrics_text", None)
+        if metrics_text is None:
+            return {}
+        try:
+            return obs.manager_gauges(metrics_text())
+        except Exception:  # noqa: BLE001 — telemetry must not fail a step
+            log.warning("manager /metrics scrape failed", exc_info=True)
+            return {}
 
     def update_metrics(self, **stats) -> dict:
         """Feed step stats to the manager's adaptive balancer; returns its

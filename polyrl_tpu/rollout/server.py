@@ -37,6 +37,7 @@ from typing import Any
 import jax
 import numpy as np
 
+from polyrl_tpu import obs
 from polyrl_tpu.rollout.cb_engine import STREAM_END
 from polyrl_tpu.rollout.sampling import SamplingParams
 from polyrl_tpu.rollout.stepper import StepDecoder
@@ -157,6 +158,20 @@ class RolloutServer:
                 rid = str(body.get("rid", f"req-{time.monotonic_ns()}"))
                 input_ids = [int(t) for t in body.get("input_ids", [])]
                 sp = SamplingParams.from_dict(body.get("sampling_params", {}))
+                # cross-process trace adoption: the manager injects the
+                # trainer's (trace_id, span_id) into the forwarded request,
+                # so this engine span joins the trainer's trace — the last
+                # hop of trainer→manager→engine
+                trace_ctx = None
+                if body.get("trace_id"):
+                    trace_ctx = (str(body["trace_id"]),
+                                 str(body.get("parent_span") or ""))
+                tracer = obs.get_tracer()
+                with tracer.adopt(trace_ctx), \
+                        tracer.span("engine/generate", rid=rid):
+                    self._stream_generate(rid, input_ids, sp)
+
+            def _stream_generate(self, rid, input_ids, sp) -> None:
                 out_q = outer.submit(rid, input_ids, sp)
 
                 self.send_response(200)

@@ -15,6 +15,7 @@ from __future__ import annotations
 import argparse
 import importlib.util
 import logging
+import os
 import sys
 
 from polyrl_tpu.config import RunConfig, load_config, to_dict
@@ -239,6 +240,18 @@ def build_trainer(cfg: RunConfig, cleanup: list | None = None):
     from polyrl_tpu.utils.metrics import Tracking
 
     cleanup = [] if cleanup is None else cleanup
+    # observability first: spans opened during bring-up (manager spawn,
+    # fabric registration) should already land in the ring buffer. The
+    # trace dir defaults next to the JSONL metrics so the Perfetto dump
+    # sits beside the run's step records.
+    from polyrl_tpu import obs
+
+    trace_dir = cfg.obs.trace_dir
+    if not trace_dir and cfg.obs.trace and cfg.logging.path:
+        trace_dir = os.path.dirname(os.path.abspath(cfg.logging.path))
+    obs.configure(trace=cfg.obs.trace, max_spans=cfg.obs.trace_buffer,
+                  out_dir=trace_dir or None,
+                  jax_annotations=cfg.obs.jax_annotations)
     tokenizer = build_tokenizer(cfg)
     mesh = _build_mesh(cfg)
     mcfg, params = _build_model(cfg)

@@ -30,6 +30,7 @@ from typing import Any, Callable
 import jax
 import numpy as np
 
+from polyrl_tpu import obs
 from polyrl_tpu.data.batch import TensorBatch
 from polyrl_tpu.models import decoder
 from polyrl_tpu.ops import core_algos
@@ -796,6 +797,90 @@ class StreamRLTrainer:
         with marked_timer("testing", metrics):
             metrics.update(self._validate())
 
+    # -- one training batch (stream → micros → opt steps) -----------------
+
+    def _train_one_batch(self, records: list[dict], gen_rng,
+                         metrics: MetricsTracker) -> dict:
+        """Stream ibatches for one training batch through the per-ibatch
+        pipeline and the cum-minibatch update micros (reference
+        stream_ray_trainer.py:500-568); returns the stream-accounting
+        state (``processed`` / ``n_tokens`` / ``bubble``)."""
+        cfg = self.cfg
+        # stream accounting: ibatches arrive (possibly overlapping
+        # generation); opt step when the cumulative trajectory count
+        # crosses each minibatch boundary, plus a final flush on the last
+        # micro so dropped groups never strand accumulated grads
+        msize = cfg.ppo_mini_batch_size
+        state = {"processed": 0, "n_tokens": 0, "bubble": 0.0}
+
+        def micro_stream():
+            it = self._ibatch_iter(records, gen_rng, metrics)
+            while True:
+                wait_t0 = time.monotonic()
+                try:
+                    ibatch = next(it)
+                except StopIteration:
+                    return
+                # time blocked on rollout = the trainer bubble the
+                # balancer minimizes (stream_ray_trainer.py:694-700)
+                state["bubble"] += time.monotonic() - wait_t0
+                ibatch = self._process_ibatch(ibatch, metrics)
+                state["n_tokens"] += int(
+                    np.asarray(ibatch["attention_mask"]).sum())
+                if cfg.use_remove_padding:
+                    yield from self._packed_micros(ibatch)
+                else:
+                    for m in ibatch.split(cfg.micro_batch_size):
+                        yield m, len(m)
+
+        def train_micro(micro, n_traj):
+            # boundary-CROSSING, not exact multiples: ragged micro sizes
+            # (packed micros, or streaming with adv estimators that allow
+            # min_stream_batch_size % rollout_n != 0) may step over an
+            # exact multiple and must still trigger the opt step
+            prev = state["processed"]
+            state["processed"] += n_traj
+            is_opt = state["processed"] // msize > prev // msize
+            # loss scale = the micro's trajectory share of the minibatch
+            # (1/grad_steps for fixed micros; ragged micros still sum to
+            # 1 over a full minibatch — reference loss_scale_factor)
+            scale = n_traj / msize
+            if isinstance(micro, dict):  # packed feed, actor-ready
+                feed = micro
+            else:
+                feed = {k: micro[k] for k in (
+                    "input_ids", "positions", "attention_mask", "responses",
+                    "response_mask", "advantages", "old_log_probs")}
+                if "ref_log_probs" in micro:
+                    feed["ref_log_probs"] = micro["ref_log_probs"]
+            with marked_timer("update_actor", metrics):
+                m = self.actor.update_stream(feed, is_opt, loss_scale=scale)
+                metrics.update({k: float(v) for k, v in m.items()})
+            if self.critic is not None:
+                if isinstance(micro, dict):  # packed feed: critic-ready
+                    cfeed = micro
+                else:
+                    cfeed = {k: micro[k] for k in (
+                        "input_ids", "positions", "attention_mask",
+                        "responses", "response_mask", "returns", "values")}
+                with marked_timer("update_critic", metrics):
+                    cm = self.critic.update_stream(
+                        cfeed, is_opt, loss_scale=scale)
+                    metrics.update({k: float(v) for k, v in cm.items()})
+
+        # micros train the moment they exist (never idle behind the
+        # blocking ibatch wait); if a short batch (dropped groups) ends
+        # mid-minibatch, flush the accumulated grads afterwards
+        for micro, n_traj in micro_stream():
+            train_micro(micro, n_traj)
+        if state["processed"] % msize != 0 and state["processed"] > 0:
+            metrics.update({k: float(v) for k, v in
+                            self.actor.flush_opt_step().items()})
+            if self.critic is not None:
+                metrics.update({k: float(v) for k, v in
+                                self.critic.flush_opt_step().items()})
+        return state
+
     # -- fit --------------------------------------------------------------
 
     def fit(self) -> list[dict]:
@@ -826,83 +911,14 @@ class StreamRLTrainer:
             # replays the same sampling stream (keys need not be saved)
             gen_rng = jax.random.fold_in(base_rng, self.global_step)
 
-            # stream accounting: ibatches arrive (possibly overlapping
-            # generation); opt step when the cumulative trajectory count
-            # crosses each minibatch boundary, plus a final flush on the last
-            # micro so dropped groups never strand accumulated grads
-            # (reference cum-minibatch logic, stream_ray_trainer.py:500-568).
-            msize = cfg.ppo_mini_batch_size
-            state = {"processed": 0, "n_tokens": 0, "bubble": 0.0}
-
-            def micro_stream():
-                it = self._ibatch_iter(records, gen_rng, metrics)
-                while True:
-                    wait_t0 = time.monotonic()
-                    try:
-                        ibatch = next(it)
-                    except StopIteration:
-                        return
-                    # time blocked on rollout = the trainer bubble the
-                    # balancer minimizes (stream_ray_trainer.py:694-700)
-                    state["bubble"] += time.monotonic() - wait_t0
-                    ibatch = self._process_ibatch(ibatch, metrics)
-                    state["n_tokens"] += int(
-                        np.asarray(ibatch["attention_mask"]).sum())
-                    if cfg.use_remove_padding:
-                        yield from self._packed_micros(ibatch)
-                    else:
-                        for m in ibatch.split(cfg.micro_batch_size):
-                            yield m, len(m)
-
-            def train_micro(micro, n_traj):
-                # boundary-CROSSING, not exact multiples: ragged micro sizes
-                # (packed micros, or streaming with adv estimators that allow
-                # min_stream_batch_size % rollout_n != 0) may step over an
-                # exact multiple and must still trigger the opt step
-                prev = state["processed"]
-                state["processed"] += n_traj
-                is_opt = state["processed"] // msize > prev // msize
-                # loss scale = the micro's trajectory share of the minibatch
-                # (1/grad_steps for fixed micros; ragged micros still sum to
-                # 1 over a full minibatch — reference loss_scale_factor)
-                scale = n_traj / msize
-                if isinstance(micro, dict):  # packed feed, actor-ready
-                    feed = micro
-                else:
-                    feed = {k: micro[k] for k in (
-                        "input_ids", "positions", "attention_mask", "responses",
-                        "response_mask", "advantages", "old_log_probs")}
-                    if "ref_log_probs" in micro:
-                        feed["ref_log_probs"] = micro["ref_log_probs"]
-                with marked_timer("update_actor", metrics):
-                    m = self.actor.update_stream(feed, is_opt, loss_scale=scale)
-                    metrics.update({k: float(v) for k, v in m.items()})
-                if self.critic is not None:
-                    if isinstance(micro, dict):  # packed feed: critic-ready
-                        cfeed = micro
-                    else:
-                        cfeed = {k: micro[k] for k in (
-                            "input_ids", "positions", "attention_mask",
-                            "responses", "response_mask", "returns", "values")}
-                    with marked_timer("update_critic", metrics):
-                        cm = self.critic.update_stream(
-                            cfeed, is_opt, loss_scale=scale)
-                        metrics.update({k: float(v) for k, v in cm.items()})
-
-            # micros train the moment they exist (never idle behind the
-            # blocking ibatch wait); if a short batch (dropped groups) ends
-            # mid-minibatch, flush the accumulated grads afterwards
-            for micro, n_traj in micro_stream():
-                train_micro(micro, n_traj)
-            if state["processed"] % msize != 0 and state["processed"] > 0:
-                metrics.update({k: float(v) for k, v in
-                                self.actor.flush_opt_step().items()})
-                if self.critic is not None:
-                    metrics.update({k: float(v) for k, v in
-                                    self.critic.flush_opt_step().items()})
-
-            with marked_timer("update_weight", metrics):
-                self._push_weights()
+            # root span: every phase span, manager call, engine span, and
+            # fabric push within the step shares this trace_id — one step,
+            # one Perfetto timeline row group (ARCHITECTURE.md
+            # "Observability")
+            with obs.span("trainer/step", step=self.global_step + 1):
+                state = self._train_one_batch(records, gen_rng, metrics)
+                with marked_timer("update_weight", metrics):
+                    self._push_weights()
             # free optimizer HBM for the generation phase (colocated
             # time-slicing; no-op unless actor.cfg.offload_optimizer)
             self.actor.offload_opt_state()
@@ -927,6 +943,10 @@ class StreamRLTrainer:
                 # retries, stream resumes): cumulative gauges, visible every
                 # step so a chaos event is observable in the step record
                 metrics.update_gauge(self.rollout.fault_counters())
+                # per-step scrape of the manager's /metrics: pool health +
+                # queue depths + request totals land in the step record as
+                # manager/* gauges (no separate Prometheus needed)
+                metrics.update_gauge(self.rollout.scrape_manager_metrics())
                 # actuating metrics: the balancer returns the next
                 # local-generation budget (handlers.rs:867-901)
                 resp = self.rollout.update_metrics(
@@ -946,11 +966,24 @@ class StreamRLTrainer:
             ):
                 with marked_timer("save_checkpoint", metrics):
                     self._save_checkpoint()
+            # distribution roll-up: drain the process-global histogram
+            # registry (rollout latency / decode rate, transfer push,
+            # manager RTT — observed by components with no tracker handle)
+            # into this step's record as p50/p95/p99/max summaries
+            metrics.merge_histograms(obs.drain_histograms())
+            if self.logger is not None:
+                metrics.update_gauge({"obs/log_errors": float(
+                    getattr(self.logger, "log_errors", 0))})
             record = metrics.as_dict()
             history.append(record)
             if self.logger is not None and self._is_main:
                 self.logger.log(record, step=self.global_step)
         self._profile_gate(-1)  # close any open trace
+        tracer = obs.get_tracer()
+        if tracer.enabled and self._is_main:
+            # per-run Perfetto dump next to the JSONL metrics (spans.jsonl
+            # + trace.json); no-op when no out_dir is configured
+            tracer.export_run()
         if self._ckpt is not None:
             self._ckpt.wait()
         return history

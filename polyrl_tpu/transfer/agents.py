@@ -33,6 +33,8 @@ from dataclasses import dataclass, field
 
 import numpy as np
 
+from polyrl_tpu import obs
+
 from .layout import ParamLayout, alloc_buffer
 from .tcp_engine import ReceiverSockets, TcpTransferEngine
 
@@ -613,6 +615,10 @@ class SenderAgent:
                                       "status": "success", "version": version})
             reg.pushed_version = version
             mbps = buffer.nbytes / max(dt, 1e-9) / 1e6
+            # per-instance push duration distribution: one slow receiver
+            # (bad NIC, busy engine) shows up as a p99/max outlier that the
+            # fleet-wide MB/s mean would average away
+            obs.observe("transfer/push_s", dt)
             log.info("pushed v%d to %s: %.0f MB/s", version, reg.instance, mbps)
             if self.manager is not None:
                 # async notify so the instance rejoins the pool without the

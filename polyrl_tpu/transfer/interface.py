@@ -23,6 +23,8 @@ from typing import Any
 
 import numpy as np
 
+from polyrl_tpu import obs
+
 from .agents import SenderAgent, SenderGroup
 from .layout import ParamLayout, alloc_buffer, build_layout, pack_params
 from .nic import pick_sender_ips
@@ -85,6 +87,16 @@ class TransferInterface:
         reach the CURRENT version, so a racing old-version push can never
         leave an instance serving stale weights.
         """
+        t0 = time.monotonic()
+        with obs.span("transfer/update_weights",
+                      mb=round(self.layout.total_bytes / 1e6, 1)):
+            version = self._update_weights_impl(params, streaming)
+        # trainer-side pack+signal time; the wire time per instance is
+        # observed sender-side as transfer/push_s (agents._push_one)
+        obs.observe("transfer/pack_s", time.monotonic() - t0)
+        return version
+
+    def _update_weights_impl(self, params: Any, streaming: bool) -> int:
         t0 = time.monotonic()
         if streaming and isinstance(self.sender, SenderAgent):
             from .layout import pack_params_streaming

@@ -1,0 +1,476 @@
+"""Observability subsystem (ISSUE 2): span tracer + cross-process trace
+propagation, log2 histogram metrics, manager /metrics scraping, Perfetto
+export, metric-name lint, and the hardened Tracking/marked_timer paths."""
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+import pytest
+
+from polyrl_tpu import obs
+from polyrl_tpu.obs.histogram import Histogram
+from polyrl_tpu.utils.metrics import MetricsTracker, Tracking, marked_timer
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture()
+def tracer():
+    """Enabled tracer with a clean ring buffer; restores defaults after."""
+    t = obs.configure(trace=True, max_spans=4096, reset=True)
+    yield t
+    obs.configure(trace=False, max_spans=4096, reset=True)
+
+
+# -- histogram math ----------------------------------------------------------
+
+
+@pytest.mark.parametrize("dist", ["lognormal", "uniform", "exponential"])
+def test_histogram_percentiles_vs_numpy(dist):
+    rng = np.random.default_rng(0)
+    vals = {"lognormal": rng.lognormal(0.0, 1.0, 5000),
+            "uniform": rng.uniform(0.01, 10.0, 5000),
+            "exponential": rng.exponential(2.0, 5000)}[dist]
+    h = Histogram()
+    for v in vals:
+        h.observe(v)
+    # log2 sub-buckets are ~9% wide: percentile lands within one bucket
+    for q in (50.0, 95.0, 99.0):
+        assert h.percentile(q) == pytest.approx(
+            float(np.percentile(vals, q)), rel=0.08)
+    assert h.vmax == vals.max()          # max is exact, not bucketed
+    assert h.mean == pytest.approx(float(vals.mean()), rel=1e-9)
+    assert h.count == len(vals)
+
+
+def test_histogram_merge_and_summary():
+    a, b = Histogram(), Histogram()
+    for v in (1.0, 2.0, 4.0):
+        a.observe(v)
+    for v in (8.0, 16.0):
+        b.observe(v)
+    a.merge(b)
+    assert a.count == 5 and a.vmax == 16.0
+    s = a.summary("x/y")
+    assert set(s) == {"x/y/p50", "x/y/p95", "x/y/p99", "x/y/max",
+                      "x/y/mean", "x/y/count"}
+    assert s["x/y/count"] == 5.0
+    assert Histogram().summary("x/y") == {}  # empty → no keys
+
+
+def test_histogram_nonpositive_and_registry():
+    h = Histogram()
+    for v in (0.0, -1.0, 2.0):
+        h.observe(v)
+    assert h.count == 3 and h.zeros == 2
+    assert h.percentile(50.0) <= 0.0     # median sits in non-positive mass
+    assert h.percentile(99.0) == 2.0
+    obs.drain_histograms()               # isolate from other tests
+    obs.observe("t/a", 1.0)
+    obs.observe("t/a", 2.0)
+    drained = obs.drain_histograms()
+    assert drained["t/a"].count == 2
+    assert obs.drain_histograms() == {}  # drain resets
+
+
+# -- tracker integration -----------------------------------------------------
+
+
+def test_tracker_histograms_and_counters():
+    t = MetricsTracker()
+    for v in (0.1, 0.2, 0.4):
+        t.observe("lat/s", v)
+    t.incr("gen/failed")
+    t.incr("gen/failed")
+    ext = Histogram()
+    ext.observe(0.8)
+    t.merge_histograms({"lat/s": ext, "rtt/s": ext})
+    d = t.as_dict()
+    assert d["lat/s/count"] == 4.0 and d["lat/s/max"] == 0.8
+    assert d["rtt/s/count"] == 1.0
+    assert d["gen/failed"] == 2.0        # raw count, not averaged
+
+
+def test_as_dict_collision_raises_under_pytest():
+    t = MetricsTracker()
+    t.update({"a/b": 1.0})
+    t.update_gauge({"a/b": 2.0})         # gauge silently overwrote before
+    with pytest.raises(ValueError, match="collision"):
+        t.as_dict()
+    t2 = MetricsTracker()
+    t2.update({"a/b": 1.0})
+    t2.add_timing("x", 0.5)              # emits timing_s/x: no clash
+    assert t2.as_dict()["timing_s/x"] == 0.5
+
+
+def test_marked_timer_records_failure():
+    t = MetricsTracker()
+    with pytest.raises(RuntimeError):
+        with marked_timer("gen", t):
+            time.sleep(0.01)
+            raise RuntimeError("phase died")
+    d = t.as_dict()
+    assert d["timing_s/gen"] >= 0.01     # timing survives the exception
+    assert d["gen/failed"] == 1.0
+
+
+def test_tracking_backend_failure_is_isolated(tmp_path):
+    t = Tracking(backends=("jsonl",), path=str(tmp_path / "m.jsonl"))
+    t.log({"a/b": 1.0}, step=1)
+    t._file.close()                      # simulate a dead backend mid-run
+    t.log({"a/b": 2.0}, step=2)          # must not raise
+    assert t.log_errors == 1
+    lines = (tmp_path / "m.jsonl").read_text().strip().splitlines()
+    assert len(lines) == 1
+
+
+# -- tracer ------------------------------------------------------------------
+
+
+def test_ring_buffer_bounded_eviction(tracer):
+    obs.configure(max_spans=8)
+    for i in range(20):
+        with obs.span(f"t/s{i}"):
+            pass
+    recs = tracer.records()
+    assert len(recs) == 8                # bounded: oldest 12 evicted
+    assert tracer.dropped == 12
+    assert [r["name"] for r in recs] == [f"t/s{i}" for i in range(12, 20)]
+    # and memory cannot creep past the bound on further traffic
+    for i in range(100):
+        with obs.span("t/more"):
+            pass
+    assert len(tracer.records()) == 8
+
+
+def test_span_nesting_and_cross_thread_adoption(tracer):
+    with obs.span("t/root") as root_id:
+        ctx = tracer.capture()
+
+        def worker():
+            with tracer.adopt(ctx), obs.span("t/child"):
+                pass
+        th = threading.Thread(target=worker)
+        th.start()
+        th.join()
+    recs = {r["name"]: r for r in tracer.records()}
+    child, root = recs["t/child"], recs["t/root"]
+    assert child["trace_id"] == root["trace_id"]
+    assert child["parent_id"] == root_id == root["span_id"]
+    assert root["parent_id"] == ""
+    # disabled tracer: span is a no-op and leaves no context
+    obs.configure(trace=False)
+    with obs.span("t/off") as sid:
+        assert sid is None
+        assert obs.trace_headers() == {}
+
+
+def test_chrome_export_roundtrip(tracer, tmp_path):
+    with obs.span("t/outer", step=3):
+        with obs.span("t/inner"):
+            pass
+    jsonl, trace = tracer.export_run(str(tmp_path))
+    spans = [json.loads(line) for line in open(jsonl)]
+    assert {s["name"] for s in spans} == {"t/outer", "t/inner"}
+    data = json.loads(open(trace).read())
+    evs = [e for e in data["traceEvents"] if e.get("ph") == "X"]
+    by_name = {e["name"]: e for e in evs}
+    assert by_name["t/inner"]["args"]["parent_id"] == \
+        by_name["t/outer"]["args"]["span_id"]
+    assert by_name["t/outer"]["args"]["step"] == 3
+    assert by_name["t/outer"]["dur"] >= by_name["t/inner"]["dur"]
+
+
+# -- header round-trip through a stub manager --------------------------------
+
+
+class _EchoStub:
+    """Stub manager: records request headers, echoes X-Trace-Id back."""
+
+    def __init__(self):
+        seen = self.seen = []
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _respond(self):
+                n = int(self.headers.get("Content-Length", 0))
+                if n:
+                    self.rfile.read(n)
+                seen.append({k: v for k, v in self.headers.items()})
+                body = b'{"status": "ok", "instances": []}'
+                self.send_response(200)
+                if self.headers.get("X-Trace-Id"):
+                    self.send_header("X-Trace-Id",
+                                     self.headers["X-Trace-Id"])
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            do_GET = do_POST = _respond
+
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.port = self.httpd.server_address[1]
+        threading.Thread(target=self.httpd.serve_forever, daemon=True).start()
+
+    def stop(self):
+        self.httpd.shutdown()
+
+
+def test_trace_header_roundtrip_stub_manager(tracer):
+    from polyrl_tpu.manager.client import ManagerClient
+
+    stub = _EchoStub()
+    try:
+        client = ManagerClient(f"127.0.0.1:{stub.port}")
+        with obs.span("t/step") as step_id:
+            trace_id = tracer.current()[0]
+            client.get_instances_status()
+        sent = stub.seen[-1]
+        assert sent["X-Trace-Id"] == trace_id
+        # the span_id on the wire is the manager-call span (a child of
+        # t/step), so the receiver's spans parent under the true caller
+        call = [r for r in tracer.records()
+                if r["name"] == "manager/get_instances_status"]
+        assert call and sent["X-Span-Id"] == call[0]["span_id"]
+        assert call[0]["parent_id"] == step_id
+        # tracing off → no trace headers on the wire
+        obs.configure(trace=False)
+        client.get_instances_status()
+        assert "X-Trace-Id" not in stub.seen[-1]
+    finally:
+        stub.stop()
+
+
+def test_trace_echo_and_request_counters_cpp_manager():
+    """The real C++ manager echoes X-Trace-Id and exposes per-route
+    request totals on /metrics."""
+    from polyrl_tpu.manager.client import spawn_rollout_manager
+
+    proc, port = spawn_rollout_manager("127.0.0.1:0")
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/get_instances_status", data=b"{}",
+            method="GET", headers={"X-Trace-Id": "abc123",
+                                   "X-Span-Id": "1.2"})
+        with urllib.request.urlopen(req, timeout=10) as r:
+            assert r.headers["X-Trace-Id"] == "abc123"
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=10) as r:
+            body = r.read().decode()
+        assert "polyrl_mgr_requests " in body
+        assert 'polyrl_mgr_requests_total{path="/get_instances_status"} 1' \
+            in body
+    finally:
+        proc.kill()
+
+
+# -- /metrics scrape parse + merge -------------------------------------------
+
+_PROM_TEXT = """\
+# TYPE polyrl_mgr_instances gauge
+polyrl_mgr_instances 3
+# TYPE polyrl_mgr_running_reqs gauge
+polyrl_mgr_running_reqs 7
+polyrl_mgr_instance_running_reqs{endpoint="127.0.0.1:9"} 2
+polyrl_mgr_max_local_gen_s 12.5
+garbage line without number x
+"""
+
+
+def test_prometheus_parse_and_gauge_merge():
+    parsed = obs.parse_prometheus_text(_PROM_TEXT)
+    assert parsed == {"polyrl_mgr_instances": 3.0,
+                      "polyrl_mgr_running_reqs": 7.0,
+                      "polyrl_mgr_max_local_gen_s": 12.5}  # labeled skipped
+    gauges = obs.manager_gauges(_PROM_TEXT)
+    assert gauges["manager/instances"] == 3.0
+    assert gauges["manager/max_local_gen_s"] == 12.5
+    t = MetricsTracker()
+    t.update({"perf/step_time_s": 1.0})
+    t.update_gauge(gauges)
+    d = t.as_dict()
+    assert d["manager/running_reqs"] == 7.0
+    # every scraped key obeys the area/name convention
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    from check_metric_names import KEY_RE
+
+    assert all(KEY_RE.match(k) for k in gauges)
+
+
+def test_scrape_manager_metrics_best_effort():
+    from polyrl_tpu.rollout.remote import RemoteRollout
+
+    class _NoMetrics:  # stub manager without a metrics_text surface
+        pass
+
+    assert RemoteRollout(_NoMetrics()).scrape_manager_metrics() == {}
+
+    class _Broken:
+        def metrics_text(self):
+            raise ConnectionError("down")
+
+    assert RemoteRollout(_Broken()).scrape_manager_metrics() == {}
+
+
+# -- metric-name lint (CI wiring) --------------------------------------------
+
+
+def test_metric_name_lint_clean_tree():
+    spec = importlib.util.spec_from_file_location(
+        "check_metric_names", os.path.join(REPO, "tools",
+                                           "check_metric_names.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    violations = mod.check_tree(mod.default_roots())
+    assert violations == [], "\n".join(violations)
+    # and the lint actually bites: a bad literal is flagged
+    bad = os.path.join(REPO, "tests", "_lint_probe.py")
+    with open(bad, "w") as f:
+        f.write('tracker.observe("BadKey", 1.0)\n')
+    try:
+        assert mod.check_file(bad)
+    finally:
+        os.unlink(bad)
+
+
+# -- e2e: traced fit through the full disaggregated stack --------------------
+
+
+@pytest.fixture(scope="module")
+def stack():
+    """C++ manager + cb rollout server + fabric, tiny model (mirrors
+    tests/test_remote_rollout.stack)."""
+    from polyrl_tpu.manager.client import ManagerClient, spawn_rollout_manager
+    from polyrl_tpu.rollout.serve import create_server
+
+    srv = create_server(model="tiny", dtype="float32", host="127.0.0.1",
+                        backend="cb", page_size=8, max_slots=8,
+                        max_seq_len=256, prompt_buckets=(16, 32))
+    proc, port = spawn_rollout_manager(
+        "127.0.0.1:0",
+        extra_args=["--health-check-interval-s", "0.1",
+                    "--stats-poll-interval-s", "0.2"])
+    mgr = ManagerClient(f"127.0.0.1:{port}")
+    mgr.wait_healthy()
+    yield srv, mgr, proc
+    proc.kill()
+    srv.stop()
+
+
+def test_e2e_traced_fit(stack, tmp_path):
+    """Acceptance: a short traced fit produces (a) a valid Perfetto dump
+    with nested trainer/rollout spans sharing one trace_id — propagated
+    through the C++ manager to the engine, (b) rollout/latency_s/p95 and
+    manager/* gauges in the step record, (c) tracer memory within the
+    configured ring-buffer bound."""
+    import jax
+    import jax.numpy as jnp
+
+    from polyrl_tpu.data.dataset import (PromptDataLoader,
+                                         make_arithmetic_dataset)
+    from polyrl_tpu.models import decoder
+    from polyrl_tpu.rewards.manager import load_reward_manager
+    from polyrl_tpu.rollout.remote import RemoteRollout
+    from polyrl_tpu.rollout.serve import register_with_manager
+    from polyrl_tpu.trainer.actor import ActorConfig, StreamActor
+    from polyrl_tpu.trainer.stream_trainer import (StreamRLTrainer,
+                                                   TrainerConfig)
+    from polyrl_tpu.transfer import TransferInterface
+    from polyrl_tpu.utils.tokenizer import ByteTokenizer
+
+    srv, mgr, _ = stack
+    max_spans = 512
+    tracer = obs.configure(trace=True, max_spans=max_spans,
+                           out_dir=str(tmp_path), reset=True)
+    tok = ByteTokenizer()
+    cfg = decoder.get_config("tiny", dtype=jnp.float32)
+    params = decoder.init_params(jax.random.PRNGKey(1), cfg)
+    iface = TransferInterface(params, manager_client=mgr, num_streams=2,
+                              poll_s=0.1, advertise_host="127.0.0.1")
+    try:
+        register_with_manager(srv, mgr.endpoint.replace("http://", ""),
+                              transfer_streams=2)
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < 10:
+            if any(i["healthy"]
+                   for i in mgr.get_instances_status()["instances"]):
+                break
+            time.sleep(0.1)
+        remote = RemoteRollout(mgr, transfer=iface,
+                               pad_token_id=tok.pad_token_id)
+        tcfg = TrainerConfig(
+            train_batch_size=4, rollout_n=2, ppo_mini_batch_size=8,
+            micro_batch_size=4, min_stream_batch_size=4,
+            max_prompt_length=16, max_response_length=8,
+            adv_estimator="grpo", total_steps=1, temperature=1.0)
+        actor = StreamActor(cfg, ActorConfig(lr=1e-4, remat=False), params)
+        trainer = StreamRLTrainer(
+            tcfg, actor, remote, tok,
+            load_reward_manager("naive", tok, num_workers=1),
+            PromptDataLoader(make_arithmetic_dataset(16), 4))
+        history = trainer.fit()
+
+        h = history[-1]
+        # histogram summaries + scraped manager gauges in the step record
+        assert "rollout/latency_s/p95" in h
+        assert "rollout/latency_s/p50" in h
+        assert h["rollout/latency_s/count"] == 8.0
+        assert "manager/rtt_s/p95" in h
+        assert h["manager/instances"] >= 1.0
+        assert h["manager/requests"] >= 1.0
+        # no logger attached → no obs/log_errors gauge (and no drops)
+        assert h.get("obs/log_errors", 0.0) == 0.0
+
+        # bounded tracer memory
+        assert tracer.max_spans == max_spans
+        assert len(tracer.records()) <= max_spans
+
+        # Perfetto dump: valid JSON, nested spans, ONE trace id end-to-end
+        trace_path = tmp_path / "trace.json"
+        assert trace_path.exists()
+        data = json.loads(trace_path.read_text())
+        evs = [e for e in data["traceEvents"] if e.get("ph") == "X"]
+        by_name: dict = {}
+        for e in evs:
+            by_name.setdefault(e["name"], []).append(e)
+        step = by_name["trainer/step"][0]
+        trace_id = step["args"]["trace_id"]
+        stream = by_name["rollout/stream"][0]
+        assert stream["args"]["trace_id"] == trace_id
+        assert stream["args"]["parent_id"] == step["args"]["span_id"]
+        # engine spans adopted the trainer's trace THROUGH the C++ manager
+        # (client header → manager request injection → server adoption)
+        engines = by_name["engine/generate"]
+        assert engines and all(
+            e["args"]["trace_id"] == trace_id for e in engines)
+        assert "timing_s/update_weight" in h
+        # two pushes: the bootstrap (own trace, pre-step) and the in-step
+        # one that must join the step's trace
+        assert any(e["args"]["trace_id"] == trace_id
+                   for e in by_name["transfer/update_weights"])
+
+        # the merge tool accepts the per-run dump
+        out = tmp_path / "merged.json"
+        r = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "trace2perfetto.py"),
+             str(tmp_path), "-o", str(out)],
+            capture_output=True, text=True)
+        assert r.returncode == 0, r.stderr
+        assert json.loads(out.read_text())["traceEvents"]
+    finally:
+        obs.configure(trace=False, max_spans=4096, reset=True)
+        iface.close()
+        if srv.receiver is not None:
+            srv.receiver.stop()
+            srv.receiver = None

@@ -1,0 +1,142 @@
+#!/usr/bin/env python3
+"""Lint literal metric keys against the ``area/name`` naming convention.
+
+Convention (ARCHITECTURE.md "Observability"): every step-record metric key
+is ``area/name`` — lowercase ``[a-z0-9_]`` segments joined by ``/`` (later
+segments may also contain ``.``), i.e. ``^[a-z0-9_]+(/[a-z0-9_.]+)+$``.
+A key that breaks the convention fragments dashboards and defeats the
+``manager/*`` / ``fault/*`` / ``timing_s/*`` prefix grouping.
+
+Static coverage (AST, literals only — dynamic keys can't be checked):
+
+- first string argument of the metric APIs ``observe``/``incr``
+  (full-key check) and ``add_timing``/``marked_timer`` (checked with the
+  ``timing_s/`` prefix they are emitted under);
+- literal string keys containing ``/`` in dicts passed to
+  ``.update(...)`` / ``.update_gauge(...)`` / ``.log(...)`` calls;
+- literal string keys containing ``/`` in any dict literal with two or
+  more such keys (metric-dict heuristic — catches returned metric dicts
+  like ``fault_counters``);
+- the literal head of f-string keys in the above positions (prefix check).
+
+Run: ``python tools/check_metric_names.py [root ...]`` — exits 1 and lists
+violations. Wired into the quick test tier (tests/test_obs_tracing.py).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+import sys
+
+KEY_RE = re.compile(r"^[a-z0-9_]+(/[a-z0-9_.]+)+$")
+# a literal f-string head like "timing_s/" must be a valid key prefix
+PREFIX_RE = re.compile(r"^[a-z0-9_]+(/[a-z0-9_.]*)*$")
+
+# APIs whose first positional string argument IS a metric key
+_FULL_KEY_APIS = {"observe", "incr"}
+# APIs whose first argument is emitted under the timing_s/ prefix
+_TIMING_APIS = {"add_timing", "marked_timer"}
+# APIs taking a metrics dict as the first argument
+_DICT_APIS = {"update", "update_gauge", "log"}
+
+
+def _check_key(key: str, where: str, violations: list[str]) -> None:
+    if not KEY_RE.match(key):
+        violations.append(f"{where}: metric key {key!r} does not match "
+                          f"{KEY_RE.pattern}")
+
+
+def _check_fstring_head(node: ast.JoinedStr, where: str,
+                        violations: list[str]) -> None:
+    if not node.values or not isinstance(node.values[0], ast.Constant):
+        return  # no literal head to check
+    head = node.values[0].value
+    if isinstance(head, str) and head and not PREFIX_RE.match(head):
+        violations.append(f"{where}: metric key prefix {head!r} does not "
+                          f"match {PREFIX_RE.pattern}")
+
+
+def _dict_slash_keys(node: ast.Dict):
+    for key in node.keys:
+        if isinstance(key, ast.Constant) and isinstance(key.value, str) \
+                and "/" in key.value:
+            yield key.value
+
+
+def check_file(path: str) -> list[str]:
+    with open(path) as f:
+        src = f.read()
+    try:
+        tree = ast.parse(src, filename=path)
+    except SyntaxError as exc:
+        return [f"{path}: syntax error: {exc}"]
+    violations: list[str] = []
+    metric_dicts: set[int] = set()
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and node.args:
+            name = (node.func.attr if isinstance(node.func, ast.Attribute)
+                    else node.func.id if isinstance(node.func, ast.Name)
+                    else "")
+            arg0 = node.args[0]
+            where = f"{path}:{node.lineno}"
+            if name in _FULL_KEY_APIS or name in _TIMING_APIS:
+                prefix = "timing_s/" if name in _TIMING_APIS else ""
+                if isinstance(arg0, ast.Constant) and isinstance(arg0.value, str):
+                    _check_key(prefix + arg0.value, where, violations)
+                elif isinstance(arg0, ast.JoinedStr) and not prefix:
+                    _check_fstring_head(arg0, where, violations)
+            elif name in _DICT_APIS and isinstance(arg0, ast.Dict):
+                metric_dicts.add(id(arg0))
+                for key in _dict_slash_keys(arg0):
+                    _check_key(key, where, violations)
+                for key in arg0.keys:
+                    if isinstance(key, ast.JoinedStr):
+                        _check_fstring_head(key, where, violations)
+        elif isinstance(node, ast.Dict) and id(node) not in metric_dicts:
+            # metric-dict heuristic: >= 2 literal slash keys
+            keys = list(_dict_slash_keys(node))
+            if len(keys) >= 2:
+                for key in keys:
+                    _check_key(key, f"{path}:{node.lineno}", violations)
+    return violations
+
+
+def check_tree(roots: list[str]) -> list[str]:
+    violations: list[str] = []
+    for root in roots:
+        if os.path.isfile(root):
+            violations += check_file(root)
+            continue
+        for dirpath, dirnames, filenames in os.walk(root):
+            dirnames[:] = [d for d in dirnames
+                           if d not in ("__pycache__", ".git")]
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    violations += check_file(os.path.join(dirpath, fn))
+    return violations
+
+
+def default_roots() -> list[str]:
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    return [os.path.join(repo, "polyrl_tpu"),
+            os.path.join(repo, "bench.py"),
+            os.path.join(repo, "tools")]
+
+
+def main(argv: list[str] | None = None) -> int:
+    roots = (argv if argv else default_roots())
+    violations = check_tree(roots)
+    for v in violations:
+        print(v)
+    if violations:
+        print(f"{len(violations)} metric-name violations", file=sys.stderr)
+        return 1
+    print("metric names ok")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:] or None))

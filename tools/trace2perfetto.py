@@ -1,0 +1,69 @@
+#!/usr/bin/env python3
+"""Convert span-record JSONL dumps into one Perfetto-loadable trace.
+
+Each process in a disaggregated run (trainer, rollout servers) dumps its
+own ``spans.jsonl`` (obs/trace.py ``export_run``). This tool merges any
+number of them into a single Chrome trace-event JSON that Perfetto
+(https://ui.perfetto.dev) or chrome://tracing loads directly; spans from
+different processes line up on the shared wall clock and carry their
+``trace_id`` in ``args`` so one rollout request can be followed
+trainer→manager→engine.
+
+Usage:
+    python tools/trace2perfetto.py run_a/spans.jsonl run_b/spans.jsonl \
+        -o trace.json
+    python tools/trace2perfetto.py trace_dir/        # finds spans.jsonl
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from polyrl_tpu.obs.trace import chrome_trace  # noqa: E402
+
+
+def load_spans(paths: list[str]) -> list[dict]:
+    records: list[dict] = []
+    for path in paths:
+        if os.path.isdir(path):
+            path = os.path.join(path, "spans.jsonl")
+        with open(path) as f:
+            for lineno, line in enumerate(f, 1):
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    records.append(json.loads(line))
+                except json.JSONDecodeError:
+                    print(f"warning: {path}:{lineno}: bad span line skipped",
+                          file=sys.stderr)
+    records.sort(key=lambda r: r.get("ts_us", 0))
+    return records
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("inputs", nargs="+",
+                        help="spans.jsonl files (or dirs containing one)")
+    parser.add_argument("-o", "--out", default="trace.json",
+                        help="output Chrome/Perfetto trace JSON")
+    args = parser.parse_args(argv)
+    records = load_spans(args.inputs)
+    if not records:
+        print("no spans found", file=sys.stderr)
+        return 1
+    with open(args.out, "w") as f:
+        json.dump(chrome_trace(records), f)
+    traces = {r.get("trace_id") for r in records}
+    print(f"{args.out}: {len(records)} spans, {len(traces)} traces — open "
+          "in https://ui.perfetto.dev")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
