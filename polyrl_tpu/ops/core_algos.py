@@ -237,6 +237,29 @@ def apply_kl_penalty(
     return token_level_rewards, masked_mean(kld, response_mask)
 
 
+def truncated_importance_weights(
+    old_log_probs: jnp.ndarray,
+    rollout_log_probs: jnp.ndarray,
+    response_mask: jnp.ndarray,
+    cap: float = 2.0,
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Per-token truncated importance-sampling (TIS) weights for off-policy
+    rollouts (the pipelined trainer's one-version-stale generations; OPPO
+    arxiv 2509.25762 / LlamaRL arxiv 2505.24034 both use this form):
+    ``w = min(exp(old_lp - rollout_lp), cap)`` where ``old_lp`` is the
+    CURRENT policy's logprob of the rollout token (recomputed at update
+    time) and ``rollout_lp`` is the behavior policy's logprob captured at
+    generation. Truncation at ``cap`` bounds the variance the reweighting
+    can inject. Returns ``(weights, mean_weight, clip_frac)`` with weights
+    zeroed outside the response mask."""
+    log_ratio = jnp.clip(old_log_probs - rollout_log_probs, -20.0, 20.0)
+    ratio = jnp.exp(log_ratio)
+    weights = jnp.minimum(ratio, cap) * response_mask
+    mean_w = masked_mean(weights, response_mask)
+    clip_frac = masked_mean((ratio > cap).astype(jnp.float32), response_mask)
+    return weights, mean_w, clip_frac
+
+
 # ---------------------------------------------------------------------------
 # loss aggregation (verl agg_loss; consumed at stream_dp_actor.py:178-193)
 # ---------------------------------------------------------------------------

@@ -63,9 +63,17 @@ class RemoteRollout:
         self.stream_resumes = 0
         self.local_fallbacks = 0
         # per-stream nonce keeps rids globally unique: concurrent streams
-        # (nested REMAX baselines, validation overlapping training) would
-        # otherwise collide on bare indices at the shared engines
+        # (nested REMAX baselines, validation overlapping training, and the
+        # pipelined trainer's prefetch lane) would otherwise collide on
+        # bare indices at the shared engines
         self._stream_seq = itertools.count()
+        # time-slice refcount: with the pipelined trainer a validation
+        # stream can overlap the prefetch lane's stream — the colocated
+        # engine's KV HBM is resumed by the FIRST active stream and
+        # released only when the LAST one ends (a per-stream release would
+        # yank pages out from under the other stream's requests)
+        self._ts_lock = threading.Lock()
+        self._ts_active = 0
 
     def fault_counters(self) -> dict[str, float]:
         """Cumulative control-plane fault metrics (supervisor restarts,
@@ -127,9 +135,16 @@ class RemoteRollout:
         released = threading.Event()
 
         def _release() -> None:
+            # per-stream idempotent; the engine's KV HBM is only handed
+            # back when the LAST concurrent stream releases (refcount)
             if released.is_set() or local_eng is None:
                 return
             released.set()
+            with self._ts_lock:
+                self._ts_active -= 1
+                last = self._ts_active == 0
+            if not last:
+                return
             try:
                 local_eng.release_memory()
             except Exception:  # noqa: BLE001 — time-slicing is best-effort
@@ -137,7 +152,10 @@ class RemoteRollout:
 
         window_timer: threading.Timer | None = None
         if local_eng is not None:
-            if hasattr(local_eng, "resume_memory"):
+            with self._ts_lock:
+                self._ts_active += 1
+                first = self._ts_active == 1
+            if first and hasattr(local_eng, "resume_memory"):
                 local_eng.resume_memory()
             # re-admit time-sliced-out locals to the manager's active pool:
             # the watchdog removed them at the last window expiry
@@ -344,21 +362,46 @@ class RemoteRollout:
             self.weight_version = self.transfer.update_weights_with_agent(params)
         else:
             self.weight_version = self.manager.update_weight_version()
-        if self.local_server is not None:
-            # colocated engine shares the chip but must own a COPY: the
-            # actor's opt step DONATES its param buffers while the engine
-            # may still be serving late groups (streaming overlap) — a
-            # by-reference swap leaves the engine on deleted buffers. The
-            # reference pays the same cost (the local SGLang process holds
-            # its own weights). No fabric hop either way; the manager
-            # re-adds locals to the pool on update_weight_version.
-            import jax
-            import jax.numpy as jnp
-
-            engine_copy = jax.tree_util.tree_map(jnp.copy, params)
-            self.local_server.engine.update_weights(
-                engine_copy, version=self.weight_version)
+        self._update_local_copy(params)
         return self.weight_version
+
+    def update_weights_async(self, params: Any) -> int:
+        """Non-blocking flavor for the pipelined trainer: the manager
+        version bump (pool drain) and the colocated-engine copy happen
+        inline — both are cheap and/or jax work that belongs on the
+        trainer thread — while the fabric's pack/wire round completes in
+        the background. ``wait_pushed()`` is the fence. Falls back to the
+        synchronous push when no async-capable fabric is attached."""
+        if self.transfer is None or not hasattr(self.transfer,
+                                                "update_weights_async"):
+            return self.update_weights(params)
+        self.weight_version = self.transfer.update_weights_async(params)
+        self._update_local_copy(params)
+        return self.weight_version
+
+    def wait_pushed(self, timeout: float = 600.0) -> None:
+        """Block until the last async push's pack round has landed;
+        re-raises a background push failure. No-op with no fabric."""
+        if self.transfer is not None and hasattr(self.transfer,
+                                                 "wait_pushed"):
+            self.transfer.wait_pushed(timeout)
+
+    def _update_local_copy(self, params: Any) -> None:
+        if self.local_server is None:
+            return
+        # colocated engine shares the chip but must own a COPY: the
+        # actor's opt step DONATES its param buffers while the engine
+        # may still be serving late groups (streaming overlap) — a
+        # by-reference swap leaves the engine on deleted buffers. The
+        # reference pays the same cost (the local SGLang process holds
+        # its own weights). No fabric hop either way; the manager
+        # re-adds locals to the pool on update_weight_version.
+        import jax
+        import jax.numpy as jnp
+
+        engine_copy = jax.tree_util.tree_map(jnp.copy, params)
+        self.local_server.engine.update_weights(
+            engine_copy, version=self.weight_version)
 
     def scrape_manager_metrics(self) -> dict[str, float]:
         """One scrape of the manager's GET /metrics, as ``manager/*`` gauge

@@ -389,6 +389,16 @@ def build_trainer(cfg: RunConfig, cleanup: list | None = None):
     logger = Tracking(backends=tuple(cfg.logging.backends),
                       path=cfg.logging.path or None)
 
+    if cfg.trainer.pipeline_depth > 0:
+        # pipelined rollout (ARCHITECTURE.md "Pipeline overlap"): announce
+        # the mode + staleness handling up front, since the step records
+        # will look different (perf/pipeline_* keys, async weight pushes)
+        log.info(
+            "pipelined rollout enabled: depth=%d, stale-rollout IS "
+            "correction=%s (cap=%.2f)", cfg.trainer.pipeline_depth,
+            "on" if cfg.trainer.rollout_is_correction else "OFF",
+            cfg.trainer.rollout_is_cap)
+
     val_dataset = build_dataset(cfg, "val")
     return StreamRLTrainer(
         cfg.trainer, actor, rollout, tokenizer, reward_manager, loader,

@@ -1,0 +1,247 @@
+"""RolloutPipeline — one-step-ahead asynchronous rollout production.
+
+The serial ``fit`` loop leaves the rollout plane idle through every
+update phase and the trainer idle through every generation ramp (the
+pipelining result in OPPO arxiv 2509.25762 / LlamaRL arxiv 2505.24034;
+ARCHITECTURE.md "Pipeline overlap"). This object splits the step into two
+lanes:
+
+- **producer lane** (one background thread, named ``rollout-pipeline``):
+  pulls the next batch of records from the dataloader (the 1-deep host-side
+  data prep prefetch), derives the per-step rng, and drives the trainer's
+  ``_ibatch_iter_local`` stream for up to ``depth`` steps ahead of training,
+  pushing assembled ibatches into a bounded queue. Before each step's first
+  generation request it takes the trainer's ``wait_pushed()`` fence, so a
+  stream never races a half-landed weight push. The per-step manager
+  ``/metrics`` scrape and the ``update_metrics`` balancer round-trip also
+  run here, off the foreground hot path.
+- **consumer lane** (the trainer's foreground thread): drains the queue via
+  :meth:`step_ibatches` and runs reward → logprob → advantage → update as
+  today. In multi-host runs the foreground re-broadcasts each ibatch, so
+  jax collectives keep a single, identical issue order on every process —
+  the producer lane is strictly control-plane + generation.
+
+Flow control is a step-credit semaphore: the producer needs one credit per
+step and the consumer grants one when it *starts* a step, so the producer
+runs at most ``depth`` steps ahead of the step being trained; within a
+step the bounded queue gives item-level backpressure. Staleness follows:
+with ``depth=1`` a stream launched mid-step-N generates with the weights of
+step N-1 — one version stale — which ``rollout_is_correction`` compensates
+with truncated importance reweighting (ops/core_algos.py).
+
+Errors on either lane propagate: a producer failure is queued as a sentinel
+and re-raised on the foreground (whose multi-host wrapper broadcasts it to
+every process); a consumer failure closes the pipeline, which unblocks a
+producer parked on the queue or the credit semaphore and joins the thread.
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+import time
+
+from polyrl_tpu import obs
+from polyrl_tpu.utils.metrics import MetricsTracker
+
+log = logging.getLogger(__name__)
+
+
+class PipelineClosed(RuntimeError):
+    """The pipeline stopped without finishing the requested step."""
+
+
+class RolloutPipeline:
+    def __init__(self, trainer, depth: int, base_rng):
+        if depth < 1:
+            raise ValueError(f"pipeline depth must be >= 1, got {depth}")
+        self.trainer = trainer
+        self.depth = depth
+        self.base_rng = base_rng
+        cfg = trainer.cfg
+        per_step = max(
+            1, -(-cfg.train_batch_size * cfg.rollout_n
+                 // max(cfg.min_stream_batch_size, 1)))
+        # depth+1 steps may be in flight (the one being trained plus depth
+        # prefetched); +depth+2 covers the end sentinels without ever
+        # blocking a producer that the credit gate already admitted
+        self._q: queue.Queue = queue.Queue(
+            maxsize=(self.depth + 1) * per_step + self.depth + 2)
+        self._credits = threading.Semaphore(self.depth)
+        self._stats_q: queue.Queue = queue.Queue()
+        self._gauges: dict[str, float] = {}
+        self._gauges_lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        # producer spans adopt the fit-level context so the prefetch lane
+        # shows up in the same Perfetto trace (its own tid = its own track)
+        self._trace_ctx = obs.get_tracer().capture()
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self, start_step: int, total_steps: int) -> "RolloutPipeline":
+        self._thread = threading.Thread(
+            target=self._run, args=(start_step, total_steps),
+            name="rollout-pipeline", daemon=True)
+        self._thread.start()
+        return self
+
+    def close(self, timeout: float = 30.0) -> None:
+        """Stop the producer and join it. Safe to call from the foreground's
+        error path: a producer blocked on the queue or the credit gate polls
+        the stop flag and exits; an abandoned generate_stream generator's
+        own ``finally`` releases any engine resources it held."""
+        self._stop.set()
+        self._credits.release()  # unblock a producer parked on the gate
+        t = self._thread
+        if t is not None and t.is_alive():
+            t.join(timeout)
+            if t.is_alive():
+                log.warning("rollout-pipeline thread did not stop in %.0fs",
+                            timeout)
+
+    # -- producer lane ------------------------------------------------------
+
+    def _run(self, start_step: int, total_steps: int) -> None:
+        import jax
+
+        trainer = self.trainer
+        with obs.get_tracer().adopt(self._trace_ctx):
+            for step in range(start_step, total_steps):
+                if not self._acquire_credit():
+                    return
+                # off-hot-path control-plane work between streams: manager
+                # /metrics scrape + balancer update_metrics for any step the
+                # foreground finished since the last stream started
+                self._drain_stats()
+                prod_metrics = MetricsTracker()
+                try:
+                    # fence: the previous async push must have fully landed
+                    # before this stream's first request, or the pool could
+                    # serve a version the pack is still writing
+                    t_fence = time.monotonic()
+                    trainer._wait_pushed()
+                    prod_metrics.add_timing("prefetch_fence",
+                                            time.monotonic() - t_fence)
+                    version = trainer._push_count
+                    gen_t0 = time.monotonic()
+                    with obs.span("trainer/prefetch", step=step + 1):
+                        records = next(trainer.dataloader)
+                        rng = jax.random.fold_in(self.base_rng, step)
+                        for ib in trainer._ibatch_iter_local(
+                                records, rng, prod_metrics):
+                            if not self._put(("ibatch", step, ib)):
+                                return
+                except BaseException as exc:  # noqa: BLE001 — re-raised on
+                    # the foreground (and broadcast to non-main hosts there)
+                    log.exception("rollout pipeline producer failed at "
+                                  "step %d", step + 1)
+                    self._put(("error", step, exc))
+                    return
+                self._put(("end", step, {
+                    "gen_t0": gen_t0, "gen_t1": time.monotonic(),
+                    "weight_version": version, "metrics": prod_metrics}))
+
+    def _acquire_credit(self) -> bool:
+        while not self._stop.is_set():
+            if self._credits.acquire(timeout=0.2):
+                return True
+        return False
+
+    def _put(self, item) -> bool:
+        while not self._stop.is_set():
+            try:
+                self._q.put(item, timeout=0.2)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    # -- consumer lane ------------------------------------------------------
+
+    def step_ibatches(self, step: int, metrics: MetricsTracker):
+        """Yield the ibatches of ``step`` from the queue; on the step's end
+        sentinel, fold the producer's metrics plus the overlap/staleness/
+        queue-depth gauges into ``metrics`` and return. Granting the step
+        credit HERE (at consume start) is what lets the producer run ahead
+        while this step trains."""
+        self._credits.release()
+        consume_t0 = time.monotonic()
+        while True:
+            item = self._get()
+            kind, item_step, payload = item
+            if kind == "error":
+                raise payload
+            if item_step != step:
+                raise PipelineClosed(
+                    f"pipeline out of sync: expected step {step + 1}, got "
+                    f"{item_step + 1} (a previous step was abandoned "
+                    f"mid-stream)")
+            if kind == "end":
+                # overlap = the slice of this step's generation that had
+                # already happened before the foreground even began the
+                # step — the serial loop's per-step gain
+                overlap = max(0.0, min(payload["gen_t1"], consume_t0)
+                              - payload["gen_t0"])
+                metrics.update({"perf/pipeline_overlap_s": overlap})
+                metrics.update_gauge({
+                    "perf/pipeline_queue_depth": float(self._q.qsize()),
+                    "perf/weight_staleness": float(
+                        self.trainer._push_count
+                        - payload["weight_version"]),
+                })
+                metrics.merge(payload["metrics"])
+                self._fold_gauges(metrics)
+                return
+            yield payload
+
+    def _get(self):
+        t = self._thread
+        while True:
+            try:
+                return self._q.get(timeout=0.5)
+            except queue.Empty:
+                if self._stop.is_set() or t is None or not t.is_alive():
+                    raise PipelineClosed(
+                        "rollout pipeline stopped mid-step") from None
+
+    # -- off-hot-path control plane ----------------------------------------
+
+    def submit_step_stats(self, **stats) -> None:
+        """Foreground hands a finished step's stats over; the producer runs
+        the manager scrape + balancer call before its next stream, and the
+        resulting gauges land in the NEXT consumed step's record (gauges,
+        so one step of lag is benign)."""
+        self._stats_q.put(stats)
+
+    def _drain_stats(self) -> None:
+        trainer = self.trainer
+        while True:
+            try:
+                stats = self._stats_q.get_nowait()
+            except queue.Empty:
+                return
+            gauges: dict[str, float] = {}
+            try:
+                gauges.update(trainer.rollout.scrape_manager_metrics())
+                resp = trainer.rollout.update_metrics(**stats)
+                if resp.get("max_local_gen_s"):
+                    # the balancer's next local-generation budget feeds the
+                    # producer's own next generate_stream directly
+                    trainer._max_local_gen_s = float(resp["max_local_gen_s"])
+                    gauges["training/max_local_gen_s"] = \
+                        trainer._max_local_gen_s
+                    gauges["training/num_rollout_instances"] = float(
+                        resp.get("num_instances", 0))
+            except Exception:  # noqa: BLE001 — telemetry must not kill a lane
+                log.exception("pipeline balancer round failed")
+            if gauges:
+                with self._gauges_lock:
+                    self._gauges.update(gauges)
+
+    def _fold_gauges(self, metrics: MetricsTracker) -> None:
+        with self._gauges_lock:
+            gauges, self._gauges = self._gauges, {}
+        if gauges:
+            metrics.update_gauge(gauges)

@@ -92,6 +92,18 @@ class TrainerConfig:
     # actor.lora_rank > 0 and rollout workers serving --lora-rank) —
     # ~rank/hidden of the bytes per sync
     weight_sync: str = "full"
+    # pipelined rollout (trainer/pipeline.py; ARCHITECTURE.md "Pipeline
+    # overlap"): 0 = the serial loop, bitwise-identical to the pre-pipeline
+    # behavior; N >= 1 lets a background lane generate up to N steps ahead
+    # of training — rollouts then arrive up to one weight-version stale
+    # (see rollout_is_correction) and the per-step weight push goes async
+    # behind a wait_pushed() fence
+    pipeline_depth: int = 0
+    # truncated importance-sampling correction for stale rollouts: scale
+    # advantages by min(exp(old_log_probs - rollout_log_probs),
+    # rollout_is_cap) per token (core_algos.truncated_importance_weights)
+    rollout_is_correction: bool = False
+    rollout_is_cap: float = 2.0
     # run
     total_steps: int = 10
     seed: int = 0
@@ -134,6 +146,12 @@ class TrainerConfig:
             raise ValueError("mini batch not divisible by micro batch")
         if self.min_stream_batch_size % self.micro_batch_size != 0:
             raise ValueError("stream batch not divisible by micro batch")
+        if self.pipeline_depth < 0:
+            raise ValueError(
+                f"pipeline_depth must be >= 0, got {self.pipeline_depth}")
+        if self.rollout_is_cap <= 0:
+            raise ValueError(
+                f"rollout_is_cap must be > 0, got {self.rollout_is_cap}")
         if self.adv_estimator in ("grpo", "rloo") and (
             self.min_stream_batch_size % self.rollout_n != 0
         ):
@@ -181,6 +199,15 @@ class StreamRLTrainer:
         # local-generation budget from the manager's balancer (None until the
         # first update_metrics round trip; manager default applies)
         self._max_local_gen_s: float | None = None
+        # weight pushes initiated so far; a prefetched stream records the
+        # count at its generation start, so the gap at consume time IS the
+        # perf/weight_staleness gauge
+        self._push_count = 0
+        if cfg.pipeline_depth > 0 and not cfg.rollout_is_correction:
+            log.warning(
+                "pipeline_depth=%d without rollout_is_correction: rollouts "
+                "arrive up to one weight-version stale and advantages are "
+                "NOT importance-corrected", cfg.pipeline_depth)
         if cfg.adv_estimator == "gae" and critic is None:
             raise ValueError("GAE requires a critic")
         self._ckpt = (
@@ -316,13 +343,20 @@ class StreamRLTrainer:
         Multi-host: process 0 streams from the manager and broadcasts each
         ibatch; the other hosts replay the broadcast (their jitted updates
         then shard the same global batch over the mesh)."""
-        cfg = self.cfg
+        yield from self._ibatch_fanout(
+            lambda: self._ibatch_iter_local(records, rng, metrics), metrics)
+
+    def _ibatch_fanout(self, make_local_iter: Callable, metrics: MetricsTracker):
+        """Multi-host fan-out wrapper around a local ibatch source (either
+        the direct ``_ibatch_iter_local`` stream or the pipeline's queue in
+        pipelined mode — the broadcast collectives always run on THIS
+        foreground thread so every process issues them in one order)."""
         if self._multi:
             if self._is_main:
                 # error sentinel: if the control plane raises mid-stream the
                 # other hosts must be released from their blocking collective
                 # (they'd otherwise hang in broadcast_one_to_all forever)
-                it = self._ibatch_iter_local(records, rng, metrics)
+                it = make_local_iter()
                 while True:
                     try:
                         ib = next(it)
@@ -345,7 +379,7 @@ class StreamRLTrainer:
                         raise RuntimeError(f"main-process rollout failed: {ib}")
                     yield ib
             return
-        yield from self._ibatch_iter_local(records, rng, metrics)
+        yield from make_local_iter()
 
     def _ibatch_iter_local(self, records: list[dict], rng,
                            metrics: MetricsTracker):
@@ -374,12 +408,39 @@ class StreamRLTrainer:
             batch = self._assemble_batch(prompts, gts, sources, outs, group_ids)
             yield from batch.split(cfg.min_stream_batch_size)
 
-    def _push_weights(self) -> None:
+    def _push_weights(self, block: bool = True) -> None:
         """Push actor weights to the rollout plane. The push itself is
         control-plane (process 0 / no-op NullRollout elsewhere), but
         GATHERING cross-host-sharded params is collective — every host
         allgathers to host numpy first, or pack_params on process 0 would
-        raise on non-addressable shards."""
+        raise on non-addressable shards.
+
+        ``block=False`` (pipelined mode): the version bump and the host
+        gather still happen inline (the gather is collective, and the
+        host copy detaches the payload from the actor's donated buffers),
+        but the pack/wire round completes on a background thread — the
+        pipeline's ``wait_pushed()`` fence joins it before the next
+        generation stream (ARCHITECTURE.md "Pipeline overlap")."""
+        params = self._gather_push_params()
+        if not block and hasattr(self.rollout, "update_weights_async"):
+            # snapshot to host NOW: the actor's next opt step donates the
+            # param buffers, and the background pack must never read a
+            # donated (deleted) buffer. Multi-host gathers already
+            # produced host numpy; asarray is free there.
+            params = jax.tree_util.tree_map(lambda x: np.asarray(x), params)
+            self.rollout.update_weights_async(params)
+        else:
+            self.rollout.update_weights(params)
+        self._push_count += 1
+
+    def _wait_pushed(self) -> None:
+        """Fence on the last ``update_weights_async``: returns when its
+        pack round has fully landed (no-op for synchronous rollouts)."""
+        fn = getattr(self.rollout, "wait_pushed", None)
+        if fn is not None:
+            fn()
+
+    def _gather_push_params(self):
         if self.cfg.weight_sync == "lora_delta":
             # delta sync: only the adapters ride the wire; workers hold the
             # frozen base and install a/b in place
@@ -400,8 +461,7 @@ class StreamRLTrainer:
                         params["layers"]),
                     base_stats=np.asarray(params["base_stats"]),
                     alpha=np.asarray(params["alpha"]))
-            self.rollout.update_weights(params)
-            return
+            return params
         else:
             # export: LoRA actors merge adapters into the plain layout here
             # — the wire format and the engines never see wrapper nodes
@@ -414,7 +474,7 @@ class StreamRLTrainer:
             params = jax.tree_util.tree_map(
                 lambda x: np.asarray(mhu.process_allgather(x, tiled=True)),
                 params)
-        self.rollout.update_weights(params)
+        return params
 
     def _to_host(self, x) -> np.ndarray:
         """jit output → host numpy. Multi-host: jitted outputs are GLOBAL
@@ -537,6 +597,19 @@ class StreamRLTrainer:
                 raise NotImplementedError(est)
             ibatch.tensors["advantages"] = np.asarray(adv)
             ibatch.tensors["returns"] = np.asarray(ret)
+            if cfg.rollout_is_correction:
+                # stale-rollout correction (pipelined mode generates one
+                # weight-version behind the update): truncated importance
+                # reweighting of the generation-time behavior policy
+                # (rollout_log_probs) against the recomputed current-policy
+                # old_log_probs — OPPO/LlamaRL's bounded-staleness recipe
+                w, mean_w, clip_frac = core_algos.truncated_importance_weights(
+                    ibatch["old_log_probs"], ibatch["rollout_log_probs"],
+                    ibatch["response_mask"], cap=cfg.rollout_is_cap)
+                ibatch.tensors["advantages"] = (
+                    ibatch.tensors["advantages"] * np.asarray(w))
+                metrics.update({"actor/tis_weight_mean": float(mean_w),
+                                "actor/tis_clip_frac": float(clip_frac)})
         return ibatch
 
     # -- packed-sequence (remove-padding) path ---------------------------
@@ -799,12 +872,15 @@ class StreamRLTrainer:
 
     # -- one training batch (stream → micros → opt steps) -----------------
 
-    def _train_one_batch(self, records: list[dict], gen_rng,
+    def _train_one_batch(self, ibatch_source: Callable,
                          metrics: MetricsTracker) -> dict:
         """Stream ibatches for one training batch through the per-ibatch
         pipeline and the cum-minibatch update micros (reference
         stream_ray_trainer.py:500-568); returns the stream-accounting
-        state (``processed`` / ``n_tokens`` / ``bubble``)."""
+        state (``processed`` / ``n_tokens`` / ``bubble``).
+        ``ibatch_source`` is a zero-arg callable returning the step's
+        ibatch iterator — the direct ``_ibatch_iter`` in the serial loop,
+        or the prefetch queue drain in pipelined mode."""
         cfg = self.cfg
         # stream accounting: ibatches arrive (possibly overlapping
         # generation); opt step when the cumulative trajectory count
@@ -814,7 +890,7 @@ class StreamRLTrainer:
         state = {"processed": 0, "n_tokens": 0, "bubble": 0.0}
 
         def micro_stream():
-            it = self._ibatch_iter(records, gen_rng, metrics)
+            it = ibatch_source()
             while True:
                 wait_t0 = time.monotonic()
                 try:
@@ -902,82 +978,134 @@ class StreamRLTrainer:
             if self.logger is not None:
                 self.logger.log(rec, step=self.global_step)
 
-        while self.global_step < cfg.total_steps:
-            self._profile_gate(self.global_step + 1)
-            metrics = MetricsTracker()
-            step_t0 = time.monotonic()
-            records = next(self.dataloader)
-            # per-step rng derived from the step index so a resumed run
-            # replays the same sampling stream (keys need not be saved)
-            gen_rng = jax.random.fold_in(base_rng, self.global_step)
+        # pipelined mode (cfg.pipeline_depth >= 1): a background lane
+        # generates up to depth steps ahead while this thread trains —
+        # see trainer/pipeline.py and ARCHITECTURE.md "Pipeline overlap".
+        # The lane only runs where local production happens (process 0 /
+        # single-host); other hosts keep replaying foreground broadcasts.
+        pipeline = None
+        if cfg.pipeline_depth > 0 and (not self._multi or self._is_main):
+            from polyrl_tpu.trainer.pipeline import RolloutPipeline
 
-            # root span: every phase span, manager call, engine span, and
-            # fabric push within the step shares this trace_id — one step,
-            # one Perfetto timeline row group (ARCHITECTURE.md
-            # "Observability")
-            with obs.span("trainer/step", step=self.global_step + 1):
-                state = self._train_one_batch(records, gen_rng, metrics)
-                with marked_timer("update_weight", metrics):
-                    self._push_weights()
-            # free optimizer HBM for the generation phase (colocated
-            # time-slicing; no-op unless actor.cfg.offload_optimizer)
-            self.actor.offload_opt_state()
+            pipeline = RolloutPipeline(self, cfg.pipeline_depth,
+                                       base_rng).start(
+                self.global_step, cfg.total_steps)
+        try:
+            while self.global_step < cfg.total_steps:
+                self._profile_gate(self.global_step + 1)
+                metrics = MetricsTracker()
+                step_t0 = time.monotonic()
+                if pipeline is None and cfg.pipeline_depth > 0:
+                    # non-main host of a pipelined run: ibatches arrive via
+                    # the foreground broadcast plane exactly as in the
+                    # serial loop
+                    source = lambda: self._ibatch_fanout(None, metrics)  # noqa: E731
+                elif pipeline is None:
+                    records = next(self.dataloader)
+                    # per-step rng derived from the step index so a resumed
+                    # run replays the same sampling stream (keys need not be
+                    # saved)
+                    gen_rng = jax.random.fold_in(base_rng, self.global_step)
+                    source = lambda: self._ibatch_iter(  # noqa: E731
+                        records, gen_rng, metrics)
+                else:
+                    step = self.global_step
+                    source = lambda: self._ibatch_fanout(  # noqa: E731
+                        lambda: pipeline.step_ibatches(step, metrics),
+                        metrics)
 
-            self.global_step += 1
-            step_time = time.monotonic() - step_t0
-            throughput = state["n_tokens"] / step_time if step_time else 0.0
-            n_traj = max(state["processed"], 1)
-            metrics.update({
-                "training/global_step": self.global_step,
-                "perf/step_time_s": step_time,
-                "perf/trainer_bubble_s": state["bubble"],
-                "perf/throughput_tokens_per_s": throughput,
-                "perf/throughput_tok_s_per_chip":
-                    throughput / max(jax.device_count(), 1),
-                "perf/rollout_throughput_tok_s": self.rollout.last_gen_throughput,
-            })
-            metrics.update(self._flops.step_metrics(
-                state["n_tokens"], state["n_tokens"] / n_traj, step_time))
-            if isinstance(self.rollout, RemoteRollout):
-                # control-plane fault counters (supervisor restarts, client
-                # retries, stream resumes): cumulative gauges, visible every
-                # step so a chaos event is observable in the step record
-                metrics.update_gauge(self.rollout.fault_counters())
-                # per-step scrape of the manager's /metrics: pool health +
-                # queue depths + request totals land in the step record as
-                # manager/* gauges (no separate Prometheus needed)
-                metrics.update_gauge(self.rollout.scrape_manager_metrics())
-                # actuating metrics: the balancer returns the next
-                # local-generation budget (handlers.rs:867-901)
-                resp = self.rollout.update_metrics(
-                    step_time_s=step_time, trainer_bubble_s=state["bubble"],
-                    throughput=throughput)
-                if resp.get("max_local_gen_s"):
-                    self._max_local_gen_s = float(resp["max_local_gen_s"])
-                    metrics.update({
-                        "training/max_local_gen_s": self._max_local_gen_s,
-                        "training/num_rollout_instances":
-                            float(resp.get("num_instances", 0))})
-            self._maybe_validate(metrics,
-                                 force=self.global_step >= cfg.total_steps)
-            if self._ckpt is not None and ckpt_lib.should_save_checkpoint(
-                self.global_step, cfg.total_steps, cfg.save_freq,
-                esi_expiry_ts=self._esi_expiry, esi_margin_s=cfg.esi_margin_s,
-            ):
-                with marked_timer("save_checkpoint", metrics):
-                    self._save_checkpoint()
-            # distribution roll-up: drain the process-global histogram
-            # registry (rollout latency / decode rate, transfer push,
-            # manager RTT — observed by components with no tracker handle)
-            # into this step's record as p50/p95/p99/max summaries
-            metrics.merge_histograms(obs.drain_histograms())
-            if self.logger is not None:
-                metrics.update_gauge({"obs/log_errors": float(
-                    getattr(self.logger, "log_errors", 0))})
-            record = metrics.as_dict()
-            history.append(record)
-            if self.logger is not None and self._is_main:
-                self.logger.log(record, step=self.global_step)
+                # root span: every phase span, manager call, engine span,
+                # and fabric push within the step shares this trace_id —
+                # one step, one Perfetto timeline row group
+                # (ARCHITECTURE.md "Observability")
+                with obs.span("trainer/step", step=self.global_step + 1):
+                    state = self._train_one_batch(source, metrics)
+                    with marked_timer("update_weight", metrics):
+                        # pipelined: version bump + host gather inline, the
+                        # pack/wire round in the background — the pipeline
+                        # fences on wait_pushed() before its next stream
+                        self._push_weights(block=cfg.pipeline_depth == 0)
+                # free optimizer HBM for the generation phase (colocated
+                # time-slicing; no-op unless actor.cfg.offload_optimizer)
+                self.actor.offload_opt_state()
+
+                self.global_step += 1
+                step_time = time.monotonic() - step_t0
+                throughput = state["n_tokens"] / step_time if step_time else 0.0
+                n_traj = max(state["processed"], 1)
+                metrics.update({
+                    "training/global_step": self.global_step,
+                    "perf/step_time_s": step_time,
+                    "perf/trainer_bubble_s": state["bubble"],
+                    "perf/throughput_tokens_per_s": throughput,
+                    "perf/throughput_tok_s_per_chip":
+                        throughput / max(jax.device_count(), 1),
+                    "perf/rollout_throughput_tok_s":
+                        self.rollout.last_gen_throughput,
+                })
+                metrics.update(self._flops.step_metrics(
+                    state["n_tokens"], state["n_tokens"] / n_traj, step_time))
+                if isinstance(self.rollout, RemoteRollout):
+                    # control-plane fault counters (supervisor restarts,
+                    # client retries, stream resumes): cumulative gauges,
+                    # visible every step so a chaos event is observable in
+                    # the step record
+                    metrics.update_gauge(self.rollout.fault_counters())
+                    if pipeline is not None:
+                        # scrape + balancer round-trip ride the pipeline
+                        # thread (off the hot path); their gauges land in
+                        # the next consumed step's record
+                        pipeline.submit_step_stats(
+                            step_time_s=step_time,
+                            trainer_bubble_s=state["bubble"],
+                            throughput=throughput)
+                    else:
+                        # per-step scrape of the manager's /metrics: pool
+                        # health + queue depths + request totals land in the
+                        # step record as manager/* gauges (no separate
+                        # Prometheus needed)
+                        metrics.update_gauge(
+                            self.rollout.scrape_manager_metrics())
+                        # actuating metrics: the balancer returns the next
+                        # local-generation budget (handlers.rs:867-901)
+                        resp = self.rollout.update_metrics(
+                            step_time_s=step_time,
+                            trainer_bubble_s=state["bubble"],
+                            throughput=throughput)
+                        if resp.get("max_local_gen_s"):
+                            self._max_local_gen_s = float(
+                                resp["max_local_gen_s"])
+                            metrics.update({
+                                "training/max_local_gen_s":
+                                    self._max_local_gen_s,
+                                "training/num_rollout_instances":
+                                    float(resp.get("num_instances", 0))})
+                self._maybe_validate(metrics,
+                                     force=self.global_step >= cfg.total_steps)
+                if self._ckpt is not None and ckpt_lib.should_save_checkpoint(
+                    self.global_step, cfg.total_steps, cfg.save_freq,
+                    esi_expiry_ts=self._esi_expiry,
+                    esi_margin_s=cfg.esi_margin_s,
+                ):
+                    with marked_timer("save_checkpoint", metrics):
+                        self._save_checkpoint()
+                # distribution roll-up: drain the process-global histogram
+                # registry (rollout latency / decode rate, transfer push,
+                # manager RTT — observed by components with no tracker
+                # handle) into this step's record as p50/p95/p99/max
+                metrics.merge_histograms(obs.drain_histograms())
+                if self.logger is not None:
+                    metrics.update_gauge({"obs/log_errors": float(
+                        getattr(self.logger, "log_errors", 0))})
+                record = metrics.as_dict()
+                history.append(record)
+                if self.logger is not None and self._is_main:
+                    self.logger.log(record, step=self.global_step)
+        finally:
+            if pipeline is not None:
+                pipeline.close()
+        # drain the last async push before teardown can stop the sender
+        self._wait_pushed()
         self._profile_gate(-1)  # close any open trace
         tracer = obs.get_tracer()
         if tracer.enabled and self._is_main:

@@ -18,6 +18,7 @@ Two paths:
 from __future__ import annotations
 
 import logging
+import threading
 import time
 from typing import Any
 
@@ -62,6 +63,10 @@ class TransferInterface:
                                       advertise_host=advertise_host)
             endpoints = [self.sender.endpoint]
         self.manager = manager_client
+        # async push state: at most ONE background round in flight; a new
+        # async push (or close) first fences on the previous one
+        self._push_thread: threading.Thread | None = None
+        self._push_err: BaseException | None = None
         self.sender.start()
         if manager_client is not None:
             manager_client.update_weight_senders(
@@ -131,7 +136,83 @@ class TransferInterface:
                  self.layout.total_bytes / 1e6, time.monotonic() - t0)
         return version
 
+    def update_weights_async(self, params: Any) -> int:
+        """Non-blocking streamed push (the pipelined trainer's path): the
+        manager version bump happens INLINE — it must drain the active pool
+        before any instance could observe mixed versions, exactly like the
+        sync path — and the pack/wire round (signal + streaming pack behind
+        the watermark) completes on a background ``weight-push`` thread.
+        ``wait_pushed()`` is the fence; callers MUST pass host-resident
+        arrays (the trainer snapshots via ``np.asarray`` first) so the
+        background pack never touches a donated device buffer.
+
+        Multi-NIC ``SenderGroup`` keeps its serial double-buffer round and
+        degrades to the synchronous call (its pack already overlaps any
+        in-flight previous round via the back buffer)."""
+        self.wait_pushed()
+        if not isinstance(self.sender, SenderAgent):
+            return self.update_weights_with_agent(params)
+        if self.manager is not None:
+            version = self.manager.update_weight_version()
+        else:
+            version = self.sender.version + 1
+        ctx = obs.get_tracer().capture()
+        t0 = time.monotonic()
+
+        def _bg() -> None:
+            try:
+                with obs.get_tracer().adopt(ctx), \
+                        obs.span("transfer/update_weights",
+                                 mb=round(self.layout.total_bytes / 1e6, 1),
+                                 mode="async"):
+                    from .layout import pack_params_streaming
+                    from .tcp_engine import Watermark
+
+                    wm = Watermark(self.layout.total_bytes)
+                    self.sender.signal_update_streaming(wm, version)
+                    try:
+                        pack_params_streaming(params, self.layout,
+                                              self.sender.buffer, wm.advance)
+                    except BaseException as exc:
+                        wm.fail(str(exc))
+                        self.sender.mark_push_failed(version)
+                        raise
+                    wm.finish()
+                obs.observe("transfer/pack_s", time.monotonic() - t0)
+                log.info("async-packed weights v%d (%.0f MB) in %.2fs",
+                         version, self.layout.total_bytes / 1e6,
+                         time.monotonic() - t0)
+            except BaseException as exc:  # noqa: BLE001 — re-raised by fence
+                self._push_err = exc
+
+        self._push_thread = threading.Thread(target=_bg, name="weight-push",
+                                             daemon=True)
+        self._push_thread.start()
+        return version
+
+    def wait_pushed(self, timeout: float = 600.0) -> None:
+        """Fence on the last async push: returns once its pack round has
+        fully landed (the point the SYNC path returns at — receivers
+        version-gate behind the manager, so instance re-activation needs
+        no trainer-side wait), re-raising any background failure."""
+        t = self._push_thread
+        if t is not None:
+            t.join(timeout)
+            if t.is_alive():
+                raise TimeoutError(
+                    f"async weight push still running after {timeout:.0f}s")
+            self._push_thread = None
+        if self._push_err is not None:
+            err, self._push_err = self._push_err, None
+            raise RuntimeError("async weight push failed") from err
+
     def close(self) -> None:
+        try:
+            # a push mid-flight holds the sender's buffer/round state;
+            # give it a bounded window before tearing the agent down
+            self.wait_pushed(timeout=30.0)
+        except Exception:  # noqa: BLE001 — teardown must proceed
+            log.exception("async weight push failed during close")
         self.sender.stop()
 
 

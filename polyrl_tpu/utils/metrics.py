@@ -80,6 +80,22 @@ class MetricsTracker:
             hist = self._hists[name] = Histogram()
         hist.observe(value)
 
+    def merge(self, other: "MetricsTracker") -> None:
+        """Fold another tracker in, kind-by-kind (averaged keys keep their
+        sample counts so the merged mean is the pooled mean). Used to land a
+        pipeline-thread producer's per-step metrics in the foreground step
+        record once that step is consumed (trainer/pipeline.py) — the
+        hand-off is by ownership transfer through the queue, so no lock."""
+        for k, v in other._sums.items():
+            self._sums[k] += v
+            self._counts[k] += other._counts[k]
+        for k, v in other._timings.items():
+            self._timings[k] += v
+        self._gauges.update(other._gauges)
+        for k, v in other._counters.items():
+            self._counters[k] += v
+        self.merge_histograms(other._hists)
+
     def merge_histograms(self, hists: dict[str, Histogram]) -> None:
         """Fold externally collected histograms in (the trainer drains the
         obs process-global registry into each step record)."""

@@ -50,8 +50,60 @@ from polyrl_tpu.utils.xla_cache import cpu_feature_cache_dir  # noqa: E402
 jax.config.update("jax_compilation_cache_dir", cpu_feature_cache_dir())
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
+import threading  # noqa: E402
+import time  # noqa: E402
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
+
+# Background-lane thread names that must NEVER survive a completed fit:
+# the pipelined trainer's producer (trainer/pipeline.py) and the async
+# weight-push round (transfer/interface.py + fake rollouts in tests/bench).
+_LANE_THREAD_PREFIXES = ("rollout-pipeline", "weight-push")
+# Long-lived NON-daemon pools owned by libraries, kept alive by design:
+# concurrent.futures executors (reward managers, senders' notify pools)
+# and orbax's per-process checkpoint machinery (metadata_store_*, the
+# *_ch_* per-item handler commit threads). Not leaks — excluded from the
+# new-non-daemon check (the named lane check above stays unconditional).
+def _infra_thread(name: str) -> bool:
+    return (name.startswith(("ThreadPoolExecutor", "metadata_store"))
+            or "_ch_" in name)
+
+
+@pytest.fixture(autouse=True)
+def _thread_leak_guard(request):
+    """Post-test leak guard (quick tier): the pipelined trainer added
+    background lanes, and a lane leaking across tests would serialize the
+    whole suite behind a stray generation or poison a later fit. Fails the
+    test if, after a short drain grace, (a) any named pipeline/push-lane
+    thread is still alive, or (b) a NEW non-daemon thread created during
+    the test survived it (ThreadPoolExecutor workers excepted — reward
+    managers and orbax keep idle non-daemon pools by design)."""
+    before = set(threading.enumerate())
+    yield
+    if request.node.get_closest_marker("quick") is None:
+        return
+
+    def leaked() -> list:
+        out = []
+        for t in threading.enumerate():
+            if not t.is_alive() or t is threading.main_thread():
+                continue
+            if t.name.startswith(_LANE_THREAD_PREFIXES):
+                out.append(t)
+            elif (t not in before and not t.daemon
+                  and not _infra_thread(t.name)):
+                out.append(t)
+        return out
+
+    stray = leaked()
+    deadline = time.monotonic() + 2.0
+    while stray and time.monotonic() < deadline:
+        time.sleep(0.05)
+        stray = leaked()
+    assert not stray, (
+        "background threads leaked past the test: "
+        f"{[(t.name, 'daemon' if t.daemon else 'non-daemon') for t in stray]}")
 
 
 @pytest.fixture(scope="session")

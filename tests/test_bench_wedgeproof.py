@@ -64,6 +64,36 @@ def test_parent_polls_cheaply_when_relay_down(tmp_path):
 
 
 @pytest.mark.quick
+def test_relay_down_budget_fails_fast(tmp_path):
+    """A dead relay must not ride the WALL budget to a harness SIGTERM
+    (every r0* round died rc=124 mid-poll): past --relay-down-budget-s of
+    cumulative downtime the parent emits the failed JSON itself and exits
+    0, well before the wall budget."""
+    env = dict(os.environ)
+    env.update({
+        "PALLAS_AXON_POOL_IPS": "",
+        "POLYRL_BENCH_RELAY_REQUIRED": "1",
+        "POLYRL_BENCH_RELAY_PORT": "1",       # nothing listens on :1
+        "POLYRL_BENCH_BUDGET": "120",          # wall budget NOT the limiter
+        "POLYRL_BENCH_RELAY_POLL": "1",
+        "POLYRL_BENCH_STATE": str(tmp_path / "state.json"),
+    })
+    t0 = time.monotonic()
+    proc = subprocess.run(
+        [sys.executable, BENCH, "--relay-down-budget-s", "2"],
+        capture_output=True, text=True, timeout=60, env=env, cwd=REPO)
+    wall = time.monotonic() - t0
+    assert proc.returncode == 0
+    assert wall < 30, f"fail-fast should cost ~budget seconds, took {wall:.0f}s"
+    lines = [ln for ln in proc.stdout.splitlines() if ln.strip()]
+    assert len(lines) == 1, f"exactly one driver JSON line, got: {lines}"
+    result = json.loads(lines[0])
+    assert result["metric"] == "bench_failed"
+    assert "failing fast" in result["extra"]["bench_incomplete"]
+    assert "relay-down budget" in proc.stderr
+
+
+@pytest.mark.quick
 def test_refund_unfinished_attempts(tmp_path, monkeypatch):
     """Attempts for phases WITHOUT results are refunded (tunnel death is a
     relay failure, not a phase failure); finished phases keep theirs —

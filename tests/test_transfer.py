@@ -501,6 +501,44 @@ def test_streaming_interface_update():
         iface.close()
 
 
+def test_async_interface_update_and_fence():
+    """update_weights_async (the pipelined trainer's push path): returns
+    immediately with the bumped version while the pack/wire round rides the
+    ``weight-push`` background thread; wait_pushed() fences, the receiver
+    lands the exact bytes, and a pack failure surfaces ON THE FENCE, not
+    silently on the background thread."""
+    from polyrl_tpu.transfer.interface import TransferInterface
+
+    params = jax.tree_util.tree_map(np.asarray, small_params(31))
+    iface = TransferInterface(params, manager_client=None, num_streams=2,
+                              poll_s=0.05, advertise_host="127.0.0.1")
+    rx = ReceiverAgent(iface.layout, "inst-async", iface.sender.endpoint,
+                       num_streams=2, listen_host="127.0.0.1",
+                       advertise_host="127.0.0.1")
+    rx.start()
+    try:
+        v = iface.update_weights_async(params)
+        iface.wait_pushed(timeout=30.0)
+        rx.wait_for_version(v, timeout=30.0)
+        got = unflatten_like(params, unpack_params(rx.buffer, rx.layout))
+        assert_tree_equal(params, got)
+        # back-to-back async rounds fence on each other
+        params2 = jax.tree_util.tree_map(np.asarray, small_params(32))
+        v2 = iface.update_weights_async(params2)
+        assert v2 == v + 1
+        iface.wait_pushed(timeout=30.0)
+        rx.wait_for_version(v2, timeout=30.0)
+        got2 = unflatten_like(params2, unpack_params(rx.buffer, rx.layout))
+        assert_tree_equal(params2, got2)
+        # a poisoned pack (wrong tree) fails the NEXT fence loudly
+        iface.update_weights_async({"not": np.zeros(3, np.float32)})
+        with pytest.raises(RuntimeError, match="async weight push failed"):
+            iface.wait_pushed(timeout=30.0)
+    finally:
+        rx.stop()
+        iface.close()
+
+
 def test_back_to_back_streaming_installs_are_never_torn():
     """A second push arriving while an incremental installer is still
     emitting must never produce a mixed-version tree: the tail re-checks
