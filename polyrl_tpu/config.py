@@ -18,6 +18,7 @@ import typing
 from dataclasses import dataclass, field
 from typing import Any
 
+from polyrl_tpu.rollout.faults import FaultInjectionConfig
 from polyrl_tpu.trainer.actor import ActorConfig
 from polyrl_tpu.trainer.critic import CriticConfig
 from polyrl_tpu.trainer.stream_trainer import TrainerConfig
@@ -86,6 +87,18 @@ class RolloutSection:
     # engine finishes the batch, else ControlPlaneDown surfaces
     resume_budget: int = 3
     resume_wait_s: float = 60.0
+    # token-level continuous generation (ARCHITECTURE.md "Token-level
+    # continuous generation"): aborts/preemptions/shutdowns flush partials
+    # instead of dropping decoded tokens, the manager forwards per-token
+    # progress, and a mid-stream resume re-issues only the SUFFIX
+    # (prompt+salvaged re-prefilled, budget decremented) with the stitched
+    # sequence re-decoding nothing. False reverts to from-token-0 resume.
+    salvage_partials: bool = True
+    # fault-injection harness (rollout/faults.py): kill-after-N-tokens,
+    # chunk corruption, stalls, /drain triggers, and worst-moment manager
+    # stream kills — for chaos tests and `bench.py --chaos`
+    fault_injection: FaultInjectionConfig = field(
+        default_factory=FaultInjectionConfig)
     transfer_streams: int = 4
     advertise_host: str = "127.0.0.1"
     # multi-NIC weight push (transfer/nic.py): >1 runs one sender agent per

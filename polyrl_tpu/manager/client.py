@@ -29,7 +29,7 @@ import tempfile
 import time
 import urllib.error
 import urllib.request
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from typing import Any, Iterator
 
 from polyrl_tpu import obs
@@ -118,6 +118,23 @@ class GenerateResult:
     output_token_logprobs: list[float]
     finish_reason: str
     error: str = ""
+    # per-token engine weight version (token-level continuation: a resume
+    # stitched across a weight push carries tokens sampled under different
+    # policies). Empty when the manager/engine predates the field; -1 for
+    # tokens whose engine did not report one.
+    output_token_weight_versions: list[int] = field(default_factory=list)
+
+
+@dataclass
+class GenerateProgress:
+    """One token-level progress chunk forwarded by the manager mid-stream
+    (``{"type":"progress"}`` NDJSON lines): the salvage ledger's feed.
+    Tokens reported here are NOT final — the terminal
+    :class:`GenerateResult` for the rid repeats them authoritatively."""
+    rid: str
+    token_ids: list[int]
+    logprobs: list[float]
+    weight_version: int = -1
 
 
 # transport-level failures worth retrying (connection refused/reset,
@@ -350,6 +367,18 @@ class ManagerClient:
                             f"truncated stream line: {exc}") from exc
                     if obj.get("type") == "notifier":
                         continue
+                    if obj.get("type") == "progress":
+                        # token-level progress: feed for the caller's
+                        # salvage ledger (rollout/remote.py). Not terminal.
+                        yield GenerateProgress(
+                            rid=obj.get("rid", ""),
+                            token_ids=[int(t) for t in
+                                       obj.get("token_ids", [])],
+                            logprobs=[float(x) for x in
+                                      obj.get("logprobs", [])],
+                            weight_version=int(obj.get("weight_version",
+                                                       -1)))
+                        continue
                     yield self._to_result(obj)
         except urllib.error.HTTPError:
             raise
@@ -366,4 +395,6 @@ class ManagerClient:
             output_token_logprobs=[float(x) for x in out.get("output_token_logprobs", [])],
             finish_reason=out.get("finish_reason", ""),
             error=out.get("error", ""),
+            output_token_weight_versions=[
+                int(v) for v in out.get("output_token_weight_versions", [])],
         )

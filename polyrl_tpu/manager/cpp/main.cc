@@ -17,6 +17,7 @@
 #include <condition_variable>
 #include <cstdio>
 #include <deque>
+#include <functional>
 #include <mutex>
 #include <thread>
 
@@ -78,9 +79,17 @@ class Manager {
   // ---- generation with eviction + token-level continuation -------------
   // (reference process_single_generate_request, handlers.rs:330-418)
 
+  // Per-chunk progress hook (token-level continuous generation): invoked
+  // with each merged engine chunk so the batch stream can forward decoded
+  // tokens to the trainer AS THEY ARRIVE. Without it, tokens accumulated
+  // here die with this process on a SIGKILL and the trainer restarts the
+  // whole request from token 0.
+  using ProgressFn = std::function<void(const Value& chunk)>;
+
   Value process_generate(const Value& request, int want_local = -1,
                          const std::string& trace_id = std::string(),
-                         const std::string& parent_span = std::string()) {
+                         const std::string& parent_span = std::string(),
+                         const ProgressFn& progress = ProgressFn()) {
     std::string rid = request["rid"].as_str();
     PartialResponse acc;
     // inject the trainer's trace context into the request we forward (and
@@ -117,7 +126,8 @@ class Manager {
       req_obj["rid"] = Value(rid + "#a" + std::to_string(attempt));
       Value attempt_req(std::move(req_obj));
       bool request_error = false;
-      bool finished = stream_from_instance(inst, attempt_req, acc, request_error);
+      bool finished = stream_from_instance(inst, attempt_req, acc,
+                                           request_error, progress);
       // assigned_batches is a RATE quota: incremented on assignment, zeroed
       // by the stats tick — never decremented (reference state.rs:84-147).
       state_.notify_available();
@@ -150,7 +160,8 @@ class Manager {
   // ``request_error`` is set when the ENGINE reported a request-level error
   // (finish_reason=error) — the instance itself is healthy.
   bool stream_from_instance(const InstancePtr& inst, const Value& request,
-                            PartialResponse& acc, bool& request_error) {
+                            PartialResponse& acc, bool& request_error,
+                            const ProgressFn& progress = ProgressFn()) {
     std::string host;
     int port;
     if (!phttp::split_endpoint(inst->endpoint, host, port)) return false;
@@ -173,7 +184,10 @@ class Manager {
       Value chunk = pjson::Parser::parse(line, &ok);
       if (!ok) return false;  // decode error → eviction path
       if (chunk["finish_reason"].as_str() == "abort") {
+        // abort = preemption; the terminal line may CARRY salvaged tokens
+        // (a salvage-enabled engine drains its pipeline into the partial)
         merge_chunk(acc, chunk);
+        if (progress && !chunk["token_ids"].as_arr().empty()) progress(chunk);
         acc.finished = false;  // abort = time-slice preemption → continue elsewhere
         acc.finish_reason.clear();
         return false;
@@ -187,6 +201,7 @@ class Manager {
         return false;
       }
       merge_chunk(acc, chunk);
+      if (progress && !chunk["token_ids"].as_arr().empty()) progress(chunk);
       if (acc.finished) return true;
     }
     return acc.finished;
@@ -246,7 +261,24 @@ class Manager {
       bool ok = gen_pool_.submit(
           [this, r, trace_id, parent_span, &mu, &cv, &ready, &remaining,
            &total_resp_tokens] {
-            Value resp = process_generate(r, -1, trace_id, parent_span);
+            // token-level progress forwarding: every merged engine chunk
+            // becomes a {"type":"progress"} NDJSON line on the trainer
+            // stream, so the trainer's salvage ledger survives a manager
+            // death — it re-issues prompt+salvaged instead of re-decoding
+            const std::string rid = r["rid"].as_str();
+            ProgressFn progress = [rid, &mu, &cv, &ready](const Value& chunk) {
+              Object o;
+              o["type"] = Value("progress");
+              o["rid"] = Value(rid);
+              o["token_ids"] = chunk["token_ids"];
+              o["logprobs"] = chunk["logprobs"];
+              o["weight_version"] = Value(chunk["weight_version"].as_int(-1));
+              std::lock_guard<std::mutex> g(mu);
+              ready.push_back(Value(std::move(o)).dump() + "\n");
+              cv.notify_all();
+            };
+            Value resp = process_generate(r, -1, trace_id, parent_span,
+                                          progress);
             total_resp_tokens += resp["completion_tokens"].as_int();
             std::lock_guard<std::mutex> g(mu);
             ready.push_back(resp.dump() + "\n");

@@ -19,15 +19,24 @@ namespace manager {
 struct PartialResponse {
   std::vector<int64_t> token_ids;
   std::vector<double> logprobs;
+  // per-token engine weight version (token-level continuous generation:
+  // a resume that crosses a weight push stitches tokens sampled under
+  // DIFFERENT policies — the trainer's truncated-importance correction
+  // needs to know which). -1 = engine did not report one.
+  std::vector<int64_t> weight_versions;
   std::string finish_reason;  // "" until finished
   bool finished = false;
 };
 
 // Fold one streamed chunk ({"token_ids":[...], "logprobs":[...],
-// "finished":bool, "finish_reason":str}) into the accumulator.
+// "finished":bool, "finish_reason":str, "weight_version":int?}) into the
+// accumulator.
 inline void merge_chunk(PartialResponse& acc, const pjson::Value& chunk) {
-  for (const auto& t : chunk["token_ids"].as_arr())
+  int64_t wv = chunk["weight_version"].as_int(-1);
+  for (const auto& t : chunk["token_ids"].as_arr()) {
     acc.token_ids.push_back(t.as_int());
+    acc.weight_versions.push_back(wv);
+  }
   for (const auto& l : chunk["logprobs"].as_arr())
     acc.logprobs.push_back(l.as_num());
   if (chunk["finished"].as_bool()) {
@@ -61,14 +70,16 @@ inline pjson::Value build_continuation_request(const pjson::Value& orig_request,
 // Final response for the trainer: all attempts' tokens/logprobs merged.
 inline pjson::Value build_final_response(const std::string& rid,
                                          const PartialResponse& acc) {
-  pjson::Array ids, lps;
+  pjson::Array ids, lps, wvs;
   for (int64_t t : acc.token_ids) ids.push_back(pjson::Value(t));
   for (double l : acc.logprobs) lps.push_back(pjson::Value(l));
+  for (int64_t v : acc.weight_versions) wvs.push_back(pjson::Value(v));
   pjson::Object o;
   o["rid"] = pjson::Value(rid);
   o["success"] = pjson::Value(true);
   o["output_token_ids"] = pjson::Value(std::move(ids));
   o["output_token_logprobs"] = pjson::Value(std::move(lps));
+  o["output_token_weight_versions"] = pjson::Value(std::move(wvs));
   o["finish_reason"] =
       pjson::Value(acc.finish_reason.empty() ? "abort" : acc.finish_reason);
   o["completion_tokens"] = pjson::Value(static_cast<int64_t>(acc.token_ids.size()));

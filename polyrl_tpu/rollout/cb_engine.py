@@ -135,6 +135,13 @@ class _SlotInfo:
     stop_set: set
     cache_entries: list = dataclasses.field(default_factory=list)
     # prefix-cache refs (released on finalize; cache owns those pages)
+    # tokens already streamed to the client (partial-rollout salvage: the
+    # abort path publishes prompt+emitted pages so a continuation landing
+    # back on this engine re-uses the decoded KV) + the weight version the
+    # slot was admitted under (KV written across a swap must not be
+    # published — the cache flush on update_weights would be defeated)
+    emitted: list = dataclasses.field(default_factory=list)
+    admit_version: int = 0
 
 
 class PageAllocator:
@@ -182,6 +189,7 @@ class CBEngine:
         trace: bool | None = None,
         spec_tokens: int = 0,
         spec_rounds: int = 2,
+        salvage_partials: bool = True,
     ):
         if any(b % page_size for b in prompt_buckets):
             raise ValueError("prompt buckets must be page-aligned")
@@ -330,6 +338,16 @@ class CBEngine:
         self.spec_emitted = 0     # tokens emitted by spec dispatches
         self.spec_dispatches = 0  # spec dispatch count (acceptance telemetry)
         self.chunk_dispatches = 0  # chunked-prefill extend dispatch count
+
+        # token-level continuous generation (partial-rollout salvage): on
+        # abort/preempt/shutdown the run-ahead pipeline is DRAINED into the
+        # stream instead of dropped, the terminal line is a partial the
+        # manager/trainer resume from, and the decoded pages are published
+        # to the prefix cache so a continuation landing back here re-uses
+        # the KV. False restores fastest-abort semantics (drop in-flight).
+        self.salvage_partials = bool(salvage_partials)
+        self.tokens_salvaged = 0   # tokens flushed into abort partials
+        self.salvage_published_pages = 0  # decoded pages kept via the cache
 
         # serving telemetry (server_info contract)
         self.weight_version = 0
@@ -976,6 +994,16 @@ class CBEngine:
             with self._fetch_cv:
                 self._fetch_cv.notify_all()
             self._fetch_thread.join(timeout=10.0)
+        if self.salvage_partials and self._pools is not None:
+            # flush partials instead of dropping them: both engine threads
+            # are joined, so the drain's dead-fetcher path lands every
+            # dispatched output synchronously and the decoded tokens stream
+            # out before the terminal lines below. Best-effort — a poisoned
+            # pool must not wedge shutdown.
+            try:
+                self._drain_emit_q()
+            except Exception:  # noqa: BLE001
+                log.exception("shutdown salvage drain failed")
         with self._fetch_cv:
             self._fetch_epoch += 1  # orphan anything a hung get still holds
             self._emit_q.clear()
@@ -984,8 +1012,18 @@ class CBEngine:
         self._inflight_tok[:] = 0
         self._invalidate_dev_state()
         # every in-flight and queued request must still see a terminal line +
-        # STREAM_END or its HTTP handler thread blocks forever
-        self._fail_all("engine shutdown")
+        # STREAM_END or its HTTP handler thread blocks forever. With salvage
+        # on, in-flight requests end in a PARTIAL (abort) — the manager's
+        # continuation resumes them elsewhere from the last streamed token —
+        # instead of an error that would discard the decoded prefix.
+        self._fail_all("engine shutdown",
+                       finish_reason="abort" if self.salvage_partials
+                       else "error")
+        if self.prefix_cache is not None:
+            # a stopped engine's cached KV (including salvage-published
+            # pages) is dead weight: hand every unreferenced page back so
+            # page accounting balances after shutdown
+            self.prefix_cache.flush()
         while self._chunk_jobs:
             job = self._chunk_jobs.popleft()
             self._emit_error(job["req"], "engine shutdown")
@@ -1338,12 +1376,14 @@ class CBEngine:
             self._top_ks[slot] = sp.top_k
             self._stop_table[slot] = stops
             self._slots[slot] = _SlotInfo(req, private, set(sp.stop_token_ids),
-                                          cache_entries=entries)
+                                          cache_entries=entries,
+                                          admit_version=self.weight_version)
             if self._hist is not None:
                 self._hist[slot] = list(req.input_ids)
             self._slot_gen[slot] += 1
             idxs.append((slot, int(self._slot_gen[slot])))
-        self._enqueue_output(("prefillb", (token, logp, done), idxs))
+        self._enqueue_output(("prefillb", (token, logp, done), idxs,
+                              self.weight_version))
 
     def _prefill_request(self, slot: int, req: _Request, pages: list[int],
                          budget: int, matched_pages: list[int] | None = None,
@@ -1425,12 +1465,14 @@ class CBEngine:
         self._top_ks[slot] = sp.top_k
         self._stop_table[slot] = stops
         self._slots[slot] = _SlotInfo(req, private, set(sp.stop_token_ids),
-                                      cache_entries=matched_entries)
+                                      cache_entries=matched_entries,
+                                      admit_version=self.weight_version)
         if self._hist is not None:
             self._hist[slot] = list(req.input_ids)
         self._slot_gen[slot] += 1
         self._enqueue_output(("prefill", (token, logp, done),
-                             (slot, int(self._slot_gen[slot]))))
+                             (slot, int(self._slot_gen[slot])),
+                             self.weight_version))
 
     # -- device-resident state + pipelined stepping --------------------------
 
@@ -1661,23 +1703,28 @@ class CBEngine:
                 if self._slot_gen[slot] == gen:
                     self._inflight_tok[slot] = max(
                         0, self._inflight_tok[slot] - entry[3])
+        # dispatch-time weight version tag (last tuple element): the chunk
+        # reports the policy that actually SAMPLED its tokens, not whatever
+        # version is live when the fetch lands steps later
+        wv = entry[-1]
         if kind == "step":
-            self._emit_fetched(*arrs, tail)
+            self._emit_fetched(*arrs, tail, wv=wv)
         elif kind == "spec":
             token, logp, done, emitted = arrs
-            self._emit_fetched(token, logp, done, tail, emitted=emitted)
+            self._emit_fetched(token, logp, done, tail, emitted=emitted,
+                               wv=wv)
         elif kind == "prefillb":
             # batched admission wave: one output row per real request
             token, logp, done = arrs
             for j, slot_gen in enumerate(tail):
                 self._emit_prefill(int(token[j]), float(logp[j]),
-                                   bool(done[j]), slot_gen)
+                                   bool(done[j]), slot_gen, wv)
         else:
             token, logp, done = arrs
-            self._emit_prefill(int(token), float(logp), bool(done), tail)
+            self._emit_prefill(int(token), float(logp), bool(done), tail, wv)
 
     def _emit_prefill(self, t: int, lp: float, device_done: bool,
-                      tail: tuple[int, int]) -> None:
+                      tail: tuple[int, int], wv: int) -> None:
         """Deliver an admitted request's first token (deferred from the
         fused prefill dispatch)."""
         slot, gen = tail
@@ -1688,8 +1735,10 @@ class CBEngine:
         fin = device_done or stop_hit
         reason = "stop" if stop_hit else ("length" if fin else "")
         info.req.out.put({"token_ids": [t], "logprobs": [lp],
-                          "finished": fin, "finish_reason": reason})
+                          "finished": fin, "finish_reason": reason,
+                          "weight_version": wv})
         self._last_tokens[slot] = t
+        info.emitted.append(t)
         if self._hist is not None:
             self._hist[slot].append(t)
         self._count_tokens(1)
@@ -1701,7 +1750,8 @@ class CBEngine:
                 # stop token beyond the device table: device active is stale
                 self._invalidate_dev_state()
 
-    def _emit_fetched(self, token, logp, done, idxs, emitted=None) -> None:
+    def _emit_fetched(self, token, logp, done, idxs, emitted=None,
+                      wv: int = -1) -> None:
         """Stream one fetched dispatch ([k, slots] token/logp/done rows, one
         per fused step) to the requests; ``idxs`` is a list of (slot,
         generation) pairs and may be a superset of live slots (mirrors lag
@@ -1732,11 +1782,13 @@ class CBEngine:
                     reason = "stop" if t in info.stop_set else "length"
                 info.req.out.put({"token_ids": [t],
                                   "logprobs": [float(logp[r, i])],
-                                  "finished": fin, "finish_reason": reason})
+                                  "finished": fin, "finish_reason": reason,
+                                  "weight_version": wv})
                 n_emitted += 1
                 self._seq_lens[i] += 1
                 self._last_tokens[i] = t
                 self._n_generated[i] += 1
+                info.emitted.append(t)
                 if self._hist is not None:
                     self._hist[i].append(t)
                 if fin:
@@ -1764,33 +1816,10 @@ class CBEngine:
         if any(info is not None and self._active[i]
                and info.req.abort is not None and info.req.abort.is_set()
                for i, info in enumerate(self._slots)):
-            # emit the abort terminal FIRST and bump the slot generation so
-            # queued/in-flight results for the aborted stream are dropped at
-            # emission — the client is released after one loop iteration,
-            # not after the whole run-ahead pipeline streams out
-            aborted: list[int] = []
-            for i, info in enumerate(self._slots):
-                if info is None or not self._active[i]:
-                    continue
-                if info.req.abort is not None and info.req.abort.is_set():
-                    self._active[i] = False
-                    self._slot_gen[i] += 1
-                    self._emit_abort(info.req, emit_line=True)
-                    aborted.append(i)
-            if aborted:
-                # full barrier BEFORE freeing pages: in-flight dispatches
-                # still write KV through the old device page table; pages
-                # may only return to the pool once nothing references them.
-                # finally: a raising drain goes to _recover, which rebuilds
-                # the pools — the aborted slots must still be finalized or
-                # their slots+pages leak (recover's _fail_all only sweeps
-                # mirror-ACTIVE slots, and these were just marked inactive)
-                try:
-                    self._drain_emit_q()
-                finally:
-                    for i in aborted:
-                        self._finalize(i)
-                    self._invalidate_dev_state()
+            if self.salvage_partials:
+                self._abort_with_salvage()
+            else:
+                self._abort_fast()
 
         if not self._active.any():
             self._drain_emit_q()
@@ -1832,11 +1861,103 @@ class CBEngine:
         self._enqueue_output(("step", (token, logp, done),
                              [(int(i), int(self._slot_gen[i]))
                               for i in np.flatnonzero(self._active)],
-                             self.steps_per_dispatch))
+                             self.steps_per_dispatch, self.weight_version))
         # run ahead up to pipeline_depth dispatches: older outputs stream
         # out of the fetcher while the device computes, hiding the fetch
         # round trips entirely
         self._drain_emit_q(keep=self.pipeline_depth)
+
+    def _abort_fast(self) -> None:
+        # emit the abort terminal FIRST and bump the slot generation so
+        # queued/in-flight results for the aborted stream are dropped at
+        # emission — the client is released after one loop iteration,
+        # not after the whole run-ahead pipeline streams out
+        aborted: list[int] = []
+        for i, info in enumerate(self._slots):
+            if info is None or not self._active[i]:
+                continue
+            if info.req.abort is not None and info.req.abort.is_set():
+                self._active[i] = False
+                self._slot_gen[i] += 1
+                self._emit_abort(info.req, emit_line=True)
+                aborted.append(i)
+        if aborted:
+            # full barrier BEFORE freeing pages: in-flight dispatches
+            # still write KV through the old device page table; pages
+            # may only return to the pool once nothing references them.
+            # finally: a raising drain goes to _recover, which rebuilds
+            # the pools — the aborted slots must still be finalized or
+            # their slots+pages leak (recover's _fail_all only sweeps
+            # mirror-ACTIVE slots, and these were just marked inactive)
+            try:
+                self._drain_emit_q()
+            finally:
+                for i in aborted:
+                    self._finalize(i)
+                self._invalidate_dev_state()
+
+    def _abort_with_salvage(self) -> None:
+        """Partial-rollout salvage (token-level continuous generation): the
+        aborted slots stay active through a full pipeline drain, so every
+        token the in-flight dispatches already decoded streams out to the
+        client instead of being dropped, THEN the terminal abort (the
+        'partial' the manager's continuation and the trainer's salvage
+        ledger resume from) is emitted. Same wall cost as the fast path —
+        the full barrier was always needed before freeing pages — traded
+        against fast-path abort latency (the client waits out the drain).
+        Decoded full pages are published to the prefix cache so a
+        continuation re-dispatched to THIS engine re-uses the KV."""
+        aborted = [i for i, info in enumerate(self._slots)
+                   if info is not None and self._active[i]
+                   and info.req.abort is not None and info.req.abort.is_set()]
+        before = {i: len(self._slots[i].emitted) for i in aborted}
+        try:
+            self._drain_emit_q()
+        finally:
+            for i in aborted:
+                info = self._slots[i]
+                if info is None or not self._active[i]:
+                    continue  # finished (stop/budget) during the drain
+                # tokens the fast path would have dropped (decoded by
+                # in-flight dispatches, streamed out by the drain above)
+                self.tokens_salvaged += len(info.emitted) - before[i]
+                self._active[i] = False
+                self._slot_gen[i] += 1
+                self._emit_abort(info.req, emit_line=True)
+                self._salvage_publish(i, info)
+                self._finalize(i)
+            self._invalidate_dev_state()
+
+    def _salvage_publish(self, slot: int, info: _SlotInfo) -> None:
+        """Publish an aborted slot's full pages (prompt + generated tokens)
+        into the prefix cache: the continuation request's prompt IS this
+        token sequence, so its suffix prefill matches these pages and skips
+        recomputing the decoded KV. Decode-written KV equals prefill KV for
+        the same tokens/positions under the same weights; a slot admitted
+        under an older weight version is skipped (its KV predates the flush
+        a weight swap performs)."""
+        if (self.prefix_cache is None
+                or info.admit_version != self.weight_version
+                or not info.emitted):
+            return
+        seq = list(info.req.input_ids) + [int(t) for t in info.emitted]
+        n_full = max(0, (len(seq) - 1) // self.page_size)
+        if n_full == 0:
+            return
+        page_row = [int(p) for p in self._page_table[slot][:n_full]]
+        matched_pages, matched_entries = self.prefix_cache.match(seq)
+        published = self.prefix_cache.publish(
+            seq, page_row, n_cached=len(matched_pages),
+            matched_entries=matched_entries)
+        # ownership of published pages moves to the cache; the rest of the
+        # slot's private pages are freed by _finalize as usual
+        pub_pages = {e.page for _, e in published}
+        info.pages = [p for p in info.pages if p not in pub_pages]
+        self.salvage_published_pages += len(pub_pages)
+        # drop the refs this publish round took (match + publish): the
+        # entries stay resident, unreferenced, LRU-evictable — exactly the
+        # state admission-published pages reach after their slot finalizes
+        self.prefix_cache.release(matched_entries + [e for _, e in published])
 
     def _spec_step_once(self, use_filters: bool) -> None:
         """One speculative decode dispatch: spec_rounds fused rounds of
@@ -1867,7 +1988,7 @@ class CBEngine:
         self._enqueue_output(("spec", (token, logp, done, emitted),
                              [(int(i), int(self._slot_gen[i]))
                               for i in np.flatnonzero(self._active)],
-                             self.spec_rounds))
+                             self.spec_rounds, self.weight_version))
         self._drain_emit_q(keep=self.pipeline_depth)
 
     def _finalize(self, slot: int) -> None:
@@ -1908,12 +2029,15 @@ class CBEngine:
                      "finish_reason": "error", "error": msg})
         req.out.put(STREAM_END)
 
-    def _fail_all(self, msg: str) -> None:
+    def _fail_all(self, msg: str, finish_reason: str = "error") -> None:
         for i in np.flatnonzero(self._active):
             info = self._slots[i]
             self._active[i] = False
             if info is not None:
-                self._emit_error(info.req, msg)
+                if finish_reason == "abort":
+                    self._emit_abort(info.req)
+                else:
+                    self._emit_error(info.req, msg)
             self._finalize(i)
 
     def _count_tokens(self, n: int) -> None:

@@ -1,0 +1,189 @@
+"""Fault-injection harness for the rollout plane (token-level continuous
+generation's test surface: SURVEY.md §5.3 "no fault-injection harness
+exists; the build should add one").
+
+One :class:`FaultInjector` instance can be attached at two seams:
+
+- **engine/server side** (``RolloutServer.fault``): observes every
+  admission and every outgoing stream line. Configurable kill-after-N-tokens
+  (trips the request's abort event — with ``salvage_partials`` the engine
+  flushes a partial and the manager's continuation resumes it elsewhere),
+  chunk corruption (emits one unparseable line — the manager's decode-error
+  eviction path), stream stall, and a /drain trigger after N admissions
+  (graceful-preemption rehearsal).
+- **trainer/client side** (``RemoteRollout(fault_injector=...)``): wraps the
+  manager batch stream and raises a ``ManagerTransportError`` once every
+  still-pending rid has salvaged at least ``stream_kill_min_progress``
+  tokens — killing the stream at the worst possible moment so the salvage
+  ledger's suffix re-issue is exercised for EVERY request.
+
+Faults are keyed by the request's *base* rid (the manager appends ``#a<n>``
+per attempt), so ``once_per_request`` means once per logical request across
+every retry/continuation/suffix-resume, which keeps fault runs terminating.
+
+Driven from config (``rollout.fault_injection.*``), ``bench.py --chaos``,
+and tests (tests/test_token_salvage.py, tests/test_salvage_chaos.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import threading
+import time
+
+log = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class FaultInjectionConfig:
+    enabled: bool = False
+    # -- engine/server-side triggers (RolloutServer.fault) -----------------
+    kill_after_tokens: int = 0     # abort a request after N streamed tokens
+    kill_limit: int = -1           # total kill budget (-1 = unlimited)
+    once_per_request: bool = True  # at most one kill per logical rid
+    corrupt_after_tokens: int = 0  # replace the Nth line with garbage
+    corrupt_limit: int = 1         # total corrupted lines budget
+    stall_s: float = 0.0           # stall each stream once, this long,
+    stall_after_tokens: int = 1    #   after N tokens
+    drain_after_requests: int = 0  # POST /drain semantics after N admissions
+    # -- trainer/client-side trigger (RemoteRollout.fault_injector) --------
+    stream_kill_times: int = 0       # how many manager streams to kill
+    stream_kill_min_progress: int = 1  # fire only once EVERY pending rid
+    #                                    has salvaged >= this many tokens
+
+
+def base_rid(rid: str) -> str:
+    """Strip the manager's per-attempt ``#a<n>`` suffix: fault bookkeeping
+    must follow the logical request across retries and continuations."""
+    return rid.rsplit("#a", 1)[0]
+
+
+class FaultInjector:
+    """Config-driven fault source; all counters are cumulative and public
+    (tests and ``bench.py --chaos`` report them)."""
+
+    def __init__(self, cfg: FaultInjectionConfig | None = None, **overrides):
+        if cfg is None:
+            cfg = FaultInjectionConfig(enabled=True, **overrides)
+        elif overrides:
+            cfg = dataclasses.replace(cfg, **overrides)
+        self.cfg = cfg
+        self._lock = threading.Lock()
+        self._tokens: dict[str, int] = {}   # base rid -> streamed tokens
+        self._killed: set[str] = set()
+        self._stalled: set[str] = set()
+        self._admitted = 0
+        self._drained = False
+        # telemetry
+        self.kills = 0
+        self.corruptions = 0
+        self.stalls = 0
+        self.drains = 0
+        self.stream_kills = 0
+
+    def counters(self) -> dict[str, float]:
+        return {
+            "fault/injected_kills": float(self.kills),
+            "fault/injected_corruptions": float(self.corruptions),
+            "fault/injected_stalls": float(self.stalls),
+            "fault/injected_drains": float(self.drains),
+            "fault/injected_stream_kills": float(self.stream_kills),
+        }
+
+    # -- engine/server-side hooks -------------------------------------------
+
+    def on_submit(self, server, rid: str, abort_event) -> None:
+        """Called by ``RolloutServer.submit`` for every admission."""
+        if not self.cfg.enabled:
+            return
+        trigger_drain = False
+        with self._lock:
+            self._admitted += 1
+            if (self.cfg.drain_after_requests > 0 and not self._drained
+                    and self._admitted >= self.cfg.drain_after_requests):
+                self._drained = True
+                trigger_drain = True
+        if trigger_drain:
+            self.drains += 1
+            log.warning("fault injection: draining server after %d "
+                        "admissions", self._admitted)
+            server.drain()
+
+    def on_line(self, rid: str, line: dict, abort_event) -> str | None:
+        """Called by the server for every outgoing NDJSON line; returns a
+        replacement raw string (corruption) or None to serialize normally.
+        May set the abort event (kill) or sleep (stall) as a side effect."""
+        if not self.cfg.enabled:
+            return None
+        key = base_rid(rid)
+        n_tok = len(line.get("token_ids", ()))
+        with self._lock:
+            count = self._tokens.get(key, 0) + n_tok
+            self._tokens[key] = count
+            do_stall = (self.cfg.stall_s > 0 and key not in self._stalled
+                        and count >= self.cfg.stall_after_tokens)
+            if do_stall:
+                self._stalled.add(key)
+                self.stalls += 1
+            do_corrupt = (self.cfg.corrupt_after_tokens > 0
+                          and count >= self.cfg.corrupt_after_tokens
+                          and self.corruptions < self.cfg.corrupt_limit)
+            if do_corrupt:
+                self.corruptions += 1
+            do_kill = (self.cfg.kill_after_tokens > 0
+                       and count >= self.cfg.kill_after_tokens
+                       and abort_event is not None
+                       and not (self.cfg.once_per_request
+                                and key in self._killed)
+                       and (self.cfg.kill_limit < 0
+                            or self.kills < self.cfg.kill_limit))
+            if do_kill:
+                self._killed.add(key)
+                self.kills += 1
+        if do_stall:
+            time.sleep(self.cfg.stall_s)
+        if do_kill:
+            log.warning("fault injection: killing %s after %d tokens",
+                        rid, count)
+            abort_event.set()
+        if do_corrupt:
+            # unparseable JSON: exercises the manager's decode-error
+            # eviction path (stream_from_instance parse failure)
+            return '{"token_ids": [!corrupted-by-fault-injection\n'
+        return None
+
+    # -- trainer/client-side hook -------------------------------------------
+
+    def wrap_stream(self, stream, pending_rids: list[str]):
+        """Wrap ``ManagerClient.batch_generate_stream``: pass items through,
+        then raise a transport error once every still-pending rid has
+        reported >= ``stream_kill_min_progress`` salvageable tokens — the
+        worst-case manager death for the salvage ledger to recover from."""
+        if not self.cfg.enabled or self.cfg.stream_kill_times <= 0:
+            yield from stream
+            return
+        from polyrl_tpu.manager.client import (GenerateProgress,
+                                               ManagerTransportError)
+
+        progress = {r: 0 for r in pending_rids}
+        pending = set(pending_rids)
+        for item in stream:
+            if isinstance(item, GenerateProgress):
+                if item.rid in progress:
+                    progress[item.rid] += len(item.token_ids)
+            else:
+                pending.discard(getattr(item, "rid", None))
+            yield item
+            with self._lock:
+                armed = self.stream_kills < self.cfg.stream_kill_times
+                fire = (armed and pending
+                        and all(progress[r] >= self.cfg.stream_kill_min_progress
+                                for r in pending))
+                if fire:
+                    self.stream_kills += 1
+            if fire:
+                log.warning("fault injection: killing manager stream with "
+                            "%d rids pending", len(pending))
+                raise ManagerTransportError(
+                    "fault injection: stream kill")

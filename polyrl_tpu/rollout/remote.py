@@ -23,6 +23,7 @@ agent, returning the new version.
 
 from __future__ import annotations
 
+import dataclasses
 import itertools
 import logging
 import queue
@@ -33,11 +34,63 @@ from typing import Any, Iterator
 import numpy as np
 
 from polyrl_tpu import obs
-from polyrl_tpu.manager.client import (ControlPlaneDown, GenerateResult,
-                                       ManagerClient, ManagerTransportError)
+from polyrl_tpu.manager.client import (ControlPlaneDown, GenerateProgress,
+                                       GenerateResult, ManagerClient,
+                                       ManagerTransportError)
 from polyrl_tpu.rollout.sampling import SamplingParams
 
 log = logging.getLogger(__name__)
+
+
+class _SalvageLedger:
+    """Per-rid token progress across manager stream attempts (token-level
+    continuous generation).
+
+    ``base_*`` — tokens already folded into the re-issued request's prompt
+    (the salvaged prefix the target engine prefills instead of re-decoding);
+    ``cur_*`` — progress streamed since the last re-issue, folded into base
+    on the next failure. The terminal :class:`GenerateResult` of the CURRENT
+    request repeats cur's tokens authoritatively, so the stitched sequence
+    is always ``base + result`` — never ``base + cur + result``."""
+
+    __slots__ = ("base_t", "base_l", "base_v", "cur_t", "cur_l", "cur_v")
+
+    def __init__(self):
+        self.base_t: list[int] = []
+        self.base_l: list[float] = []
+        self.base_v: list[int] = []
+        self.cur_t: list[int] = []
+        self.cur_l: list[float] = []
+        self.cur_v: list[int] = []
+
+    def extend_cur(self, prog: GenerateProgress) -> None:
+        self.cur_t += [int(t) for t in prog.token_ids]
+        self.cur_l += [float(x) for x in prog.logprobs]
+        self.cur_v += [int(prog.weight_version)] * len(prog.token_ids)
+
+    def fold(self) -> int:
+        """Move cur into base (a re-issue is about to carry it in the
+        prompt); returns how many tokens were newly salvaged."""
+        n = len(self.cur_t)
+        self.base_t += self.cur_t
+        self.base_l += self.cur_l
+        self.base_v += self.cur_v
+        self.cur_t, self.cur_l, self.cur_v = [], [], []
+        return n
+
+    def stitch(self, res: GenerateResult) -> GenerateResult:
+        """Prepend the salvaged prefix to a terminal result."""
+        if not self.base_t or not res.success:
+            return res
+        wvs: list[int] = []
+        if self.base_v or res.output_token_weight_versions:
+            wvs = self.base_v + (res.output_token_weight_versions
+                                 or [-1] * len(res.output_token_ids))
+        return dataclasses.replace(
+            res,
+            output_token_ids=self.base_t + res.output_token_ids,
+            output_token_logprobs=self.base_l + res.output_token_logprobs,
+            output_token_weight_versions=wvs)
 
 
 class RemoteRollout:
@@ -49,6 +102,8 @@ class RemoteRollout:
         pad_token_id: int = 0,
         resume_budget: int = 3,      # mid-stream re-issues per batch
         resume_wait_s: float = 60.0,  # per-resume wait for manager recovery
+        salvage_partials: bool = True,  # token-level suffix resume
+        fault_injector=None,         # rollout/faults.py (tests, bench --chaos)
     ):
         self.manager = manager
         self.transfer = transfer
@@ -56,12 +111,21 @@ class RemoteRollout:
         self.pad_token_id = pad_token_id
         self.resume_budget = resume_budget
         self.resume_wait_s = resume_wait_s
+        self.salvage_partials = salvage_partials
+        self.fault_injector = fault_injector
         self.weight_version = 0
         self.last_gen_throughput = 0.0
         self.dropped_groups = 0
         # control-plane fault counters (cumulative; trainer gauges them)
         self.stream_resumes = 0
         self.local_fallbacks = 0
+        # token-level salvage counters: tokens carried across a resume
+        # instead of re-decoded, suffix re-issues performed, and the prefill
+        # length those re-issues paid (prompt + salvage — the recovery cost
+        # that replaces full re-decoding)
+        self.tokens_salvaged = 0
+        self.suffix_resumes = 0
+        self.resume_prefill_tokens = 0
         # per-stream nonce keeps rids globally unique: concurrent streams
         # (nested REMAX baselines, validation overlapping training, and the
         # pipelined trainer's prefetch lane) would otherwise collide on
@@ -82,6 +146,9 @@ class RemoteRollout:
             "fault/stream_resumes": float(self.stream_resumes),
             "fault/local_fallbacks": float(self.local_fallbacks),
             "fault/dropped_groups": float(self.dropped_groups),
+            "fault/tokens_salvaged": float(self.tokens_salvaged),
+            "fault/suffix_resumes": float(self.suffix_resumes),
+            "fault/resume_prefill_tokens": float(self.resume_prefill_tokens),
         }
         retries = getattr(self.manager, "retry_count", None)
         if retries is not None:
@@ -188,33 +255,49 @@ class RemoteRollout:
         # inflate elapsed in exactly the overlapped mode this measures
         gen_end = [gen_t0]
 
-        def finish_locally(pending: dict) -> None:
+        def finish_locally(pending: dict, ledger: dict) -> None:
             # last-resort degrade: the manager stayed down past the resume
             # budget but a colocated engine exists — finish the batch
             # in-process rather than losing it. The engine may have been
             # released by the window timer; resume for the fallback and
-            # hand the HBM back afterwards if so.
+            # hand the HBM back afterwards if so. Requests were already
+            # folded by fold_salvage, so their input_ids carry the salvaged
+            # prefix and their max_new_tokens the remaining budget — the
+            # degraded completion also resumes from the last token instead
+            # of re-decoding from zero.
             eng = self.local_server.engine
             was_released = released.is_set()
             if hasattr(eng, "resume_memory"):
                 eng.resume_memory()
             try:
-                items = list(pending.values())
-                outs = eng.generate([r["input_ids"] for r in items], sampling)
-                for r, o in zip(items, outs):
-                    if isinstance(o, dict):
-                        ids, lps = o["token_ids"], o["logprobs"]
-                        reason = o.get("finish_reason", "stop")
-                    else:
-                        ids = list(o.output_ids)
-                        lps = list(o.output_token_logprobs)
-                        reason = getattr(o, "finish_reason", "stop")
-                    q.put(GenerateResult(
-                        rid=r["rid"], success=reason != "error",
-                        output_token_ids=[int(t) for t in ids],
-                        output_token_logprobs=[float(x) for x in lps],
-                        finish_reason=reason,
-                        error="" if reason != "error" else "local fallback"))
+                # group by remaining budget: eng.generate takes ONE
+                # SamplingParams per call, and salvaged requests have
+                # per-rid decremented budgets (no salvage → one group,
+                # the pre-salvage behavior)
+                by_budget: dict[int, list[dict]] = {}
+                for r in pending.values():
+                    mnt = int(r["sampling_params"].get(
+                        "max_new_tokens", sampling.max_new_tokens))
+                    by_budget.setdefault(mnt, []).append(r)
+                for mnt, items in by_budget.items():
+                    sp = dataclasses.replace(sampling, max_new_tokens=mnt)
+                    outs = eng.generate([r["input_ids"] for r in items], sp)
+                    for r, o in zip(items, outs):
+                        if isinstance(o, dict):
+                            ids, lps = o["token_ids"], o["logprobs"]
+                            reason = o.get("finish_reason", "stop")
+                        else:
+                            ids = list(o.output_ids)
+                            lps = list(o.output_token_logprobs)
+                            reason = getattr(o, "finish_reason", "stop")
+                        res = GenerateResult(
+                            rid=r["rid"], success=reason != "error",
+                            output_token_ids=[int(t) for t in ids],
+                            output_token_logprobs=[float(x) for x in lps],
+                            finish_reason=reason,
+                            error="" if reason != "error" else "local fallback")
+                        led = ledger.get(r["rid"])
+                        q.put(led.stitch(res) if led is not None else res)
             finally:
                 if was_released and hasattr(eng, "release_memory"):
                     try:
@@ -222,23 +305,76 @@ class RemoteRollout:
                     except Exception:  # noqa: BLE001 — best-effort handback
                         log.exception("fallback release_memory failed")
 
+        def fold_salvage(pending: dict, ledger: dict) -> None:
+            """Token-level salvage after a stream failure: fold each pending
+            rid's streamed progress into its request so the re-issue (or the
+            local fallback) carries prompt+salvaged as the new prefill —
+            hitting the target engine's prefix cache — with the token budget
+            decremented. A rid whose salvaged prefix already hit a stop
+            token or exhausted its budget is completed right here."""
+            stops = set(sampling.stop_token_ids)
+            for rid in list(pending):
+                led = ledger.get(rid)
+                if led is None:
+                    continue
+                req = pending[rid]
+                sp = req["sampling_params"]
+                n_new = led.fold()
+                if n_new:
+                    self.tokens_salvaged += n_new
+                    req["input_ids"] = (list(req["input_ids"])
+                                        + led.base_t[-n_new:])
+                    sp["max_new_tokens"] = int(sp["max_new_tokens"]) - n_new
+                if not led.base_t:
+                    continue  # nothing salvaged: plain from-zero re-issue
+                if led.base_t[-1] in stops or int(sp["max_new_tokens"]) <= 0:
+                    # the salvage already completes the request — synthesize
+                    # the terminal result instead of re-issuing
+                    pending.pop(rid)
+                    q.put(GenerateResult(
+                        rid=rid, success=True,
+                        output_token_ids=list(led.base_t),
+                        output_token_logprobs=list(led.base_l),
+                        finish_reason=("stop" if led.base_t[-1] in stops
+                                       else "length"),
+                        output_token_weight_versions=list(led.base_v)))
+                    continue
+                self.suffix_resumes += 1
+                self.resume_prefill_tokens += len(req["input_ids"])
+
         def run_stream() -> None:
             # drains the NDJSON stream so the manager is never backpressured
             # by training compute (reference stream_batch_iter drain loop).
             # Stream-level resume: a mid-stream transport failure re-issues
             # ONLY the rids without a terminal result yet (completed ones
             # were already queued for group assembly) against the recovered
-            # manager, at most resume_budget times.
+            # manager, at most resume_budget times. Token-level salvage
+            # (salvage_partials): the manager forwards per-token progress
+            # lines; a re-issued rid carries prompt+salvaged as its prompt
+            # and the stitched result re-decodes NOTHING before the fault.
             pending = {r["rid"]: r for r in reqs}
+            ledger: dict[str, _SalvageLedger] = (
+                {r["rid"]: _SalvageLedger() for r in reqs}
+                if self.salvage_partials else {})
             budget = self.resume_budget
             while pending:
                 failure: ManagerTransportError | None = None
                 try:
-                    for res in self.manager.batch_generate_stream(
-                            list(pending.values()),
-                            max_local_gen_s=max_local_gen_s):
+                    stream = self.manager.batch_generate_stream(
+                        list(pending.values()),
+                        max_local_gen_s=max_local_gen_s)
+                    if self.fault_injector is not None:
+                        stream = self.fault_injector.wrap_stream(
+                            stream, list(pending))
+                    for res in stream:
+                        if isinstance(res, GenerateProgress):
+                            led = ledger.get(res.rid)
+                            if led is not None and res.rid in pending:
+                                led.extend_cur(res)
+                            continue
                         pending.pop(res.rid, None)
-                        q.put(res)
+                        led = ledger.get(res.rid)
+                        q.put(led.stitch(res) if led is not None else res)
                 except ManagerTransportError as exc:
                     failure = exc
                 if not pending:
@@ -251,6 +387,10 @@ class RemoteRollout:
                     # as EOF, not as an error
                     failure = ManagerTransportError(
                         f"stream ended with {len(pending)} rids unanswered")
+                if self.salvage_partials:
+                    fold_salvage(pending, ledger)
+                    if not pending:
+                        return  # salvage completed every remaining rid
                 log.warning(
                     "manager stream failed with %d/%d rids pending (%s); "
                     "attempting resume (%d left in budget)",
@@ -263,7 +403,7 @@ class RemoteRollout:
                     self.local_fallbacks += 1
                     log.warning("control plane down; finishing %d requests "
                                 "on the colocated engine", len(pending))
-                    finish_locally(pending)
+                    finish_locally(pending, ledger)
                     return
                 raise ControlPlaneDown(
                     f"manager unreachable after {self.resume_budget} stream "

@@ -45,7 +45,9 @@ def create_server(model: str, manager_endpoint: str | None = None,
                   spec_tokens: int = 0,
                   spec_rounds: int = 2,
                   lora_rank: int = 0,
-                  lora_alpha: float = 16.0):
+                  lora_alpha: float = 16.0,
+                  salvage_partials: bool = True,
+                  fault_injector=None):
     """Build engine + server, register with the manager, attach receiver.
 
     ``backend="cb"`` (default) serves with the paged continuous-batching
@@ -149,7 +151,8 @@ def create_server(model: str, manager_endpoint: str | None = None,
             prompt_buckets=tuple(prompt_buckets) if prompt_buckets
             else (128, 256, 512, 1024, 2048, 4096), seed=seed, mesh=mesh,
             prefill_chunk=prefill_chunk, spec_tokens=spec_tokens,
-            spec_rounds=spec_rounds, pipeline_depth=pipeline_depth)
+            spec_rounds=spec_rounds, pipeline_depth=pipeline_depth,
+            salvage_partials=salvage_partials)
     else:
         kwargs = {}
         if batch_buckets:
@@ -168,6 +171,7 @@ def create_server(model: str, manager_endpoint: str | None = None,
     server.weight_template = weight_template
     server.weight_preprocess = weight_preprocess
     server.weight_apply = weight_apply
+    server.fault = fault_injector
     server.start()
 
     if manager_endpoint:

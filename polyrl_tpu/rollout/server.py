@@ -77,6 +77,16 @@ class RolloutServer:
         self._aborts_lock = threading.Lock()
         self._stop = threading.Event()
         self._paused = threading.Event()  # release_memory_occupation
+        # graceful preemption (POST /drain): in-flight requests abort into
+        # PARTIALS (salvage-enabled engines flush decoded tokens first) and
+        # new submissions are refused with an immediate abort terminal so
+        # the manager's continuation re-routes them. One-way by design —
+        # a drained server is about to lose its host.
+        self._draining = threading.Event()
+        self.drain_count = 0  # requests aborted by /drain (telemetry)
+        # optional FaultInjector (rollout/faults.py): observes admissions
+        # and every outgoing stream line; can kill/corrupt/stall/drain
+        self.fault = None
         self.receiver = None  # ReceiverAgent, attached by serve.py
         # quantized serving (models/quant.py): the wire format stays the
         # trainer's bf16 tree — weight_template carries that tree's
@@ -114,8 +124,15 @@ class RolloutServer:
                 self._send(code, json.dumps(obj).encode(), "application/json")
 
             def do_GET(self):
-                if self.path in ("/health", "/health_generate"):
+                if self.path == "/health":
                     self._json(200, {"status": "ok"})
+                elif self.path == "/health_generate":
+                    # a draining server is alive but must not pass the
+                    # manager's serving health gate
+                    if outer._draining.is_set():
+                        self._json(503, {"status": "draining"})
+                    else:
+                        self._json(200, {"status": "ok"})
                 elif self.path == "/get_server_info":
                     self._json(200, outer.server_info())
                 elif self.path == "/metrics":
@@ -140,6 +157,8 @@ class RolloutServer:
                 elif self.path == "/abort_request":
                     outer.abort_request(body.get("rid"))
                     self._json(200, {"success": True})
+                elif self.path == "/drain":
+                    self._json(200, outer.drain())
                 elif self.path == "/flush_cache":
                     self._json(200, {"success": True})
                 elif self.path == "/release_memory_occupation":
@@ -172,7 +191,7 @@ class RolloutServer:
                     self._stream_generate(rid, input_ids, sp)
 
             def _stream_generate(self, rid, input_ids, sp) -> None:
-                out_q = outer.submit(rid, input_ids, sp)
+                out_q, abort_ev = outer.submit(rid, input_ids, sp)
 
                 self.send_response(200)
                 self.send_header("Content-Type", "application/x-ndjson")
@@ -206,13 +225,14 @@ class RolloutServer:
                                 done = True
                                 break
                         if items:
-                            chunk("".join(json.dumps(i) + "\n"
+                            chunk("".join(outer._serialize_line(rid, i,
+                                                                abort_ev)
                                           for i in items))
                     self.wfile.write(b"0\r\n\r\n")
                 except (BrokenPipeError, ConnectionResetError):
                     outer.abort_request(rid)
                 finally:
-                    outer._drop_abort(rid)
+                    outer._drop_abort(rid, abort_ev)
 
         # default request_queue_size (listen backlog) is 5: a burst of
         # concurrent clients (the manager fanning a batch out) gets
@@ -245,9 +265,21 @@ class RolloutServer:
     # -- request admission & batching loop ----------------------------------
 
     def submit(self, rid: str, input_ids: list[int],
-               sp: SamplingParams) -> queue.Queue:
+               sp: SamplingParams) -> tuple[queue.Queue, threading.Event]:
+        """Admit one request; returns (output queue, abort event). The
+        caller that registered the abort event must pass it back to
+        ``_drop_abort`` — cleanup is identity-checked so a retry that
+        re-used the rid cannot have its fresh event popped by the dying
+        first attempt's teardown."""
         out: queue.Queue = queue.Queue()
         abort = threading.Event()
+        if self._draining.is_set():
+            # graceful preemption: refuse with a partial-abort terminal —
+            # the manager's continuation layer re-routes the request
+            out.put({"token_ids": [], "logprobs": [], "finished": True,
+                     "finish_reason": "abort"})
+            out.put(_SENTINEL)
+            return out, abort
         # Duplicate in-flight rid: usually a manager retry racing the dying
         # first attempt (its handler thread drops the rid only after seeing
         # BrokenPipe on the next write). Abort the stale entry and give it a
@@ -266,13 +298,20 @@ class RolloutServer:
                          "finish_reason": "error",
                          "error": f"duplicate rid {rid!r} in flight"})
                 out.put(_SENTINEL)
-                return out
+                return out, abort
             time.sleep(0.01)
+        if self.fault is not None:
+            self.fault.on_submit(self, rid, abort)
+        if self._draining.is_set():
+            # drain landed between the admission check and event
+            # registration: its abort sweep missed this event — trip it
+            # ourselves so the engine aborts the request into a partial
+            abort.set()
         if self.cb:
             self.engine.submit(rid, input_ids, sp, out=out, abort=abort)
         else:
             self._queue.put(_PendingRequest(rid, input_ids, sp, out, abort))
-        return out
+        return out, abort
 
     def abort_request(self, rid: str | None) -> None:
         """Abort one request, or ALL running requests when rid is None/'' —
@@ -286,9 +325,34 @@ class RolloutServer:
                 for ev in self._aborts.values():
                     ev.set()
 
-    def _drop_abort(self, rid: str) -> None:
+    def drain(self) -> dict:
+        """POST /drain — graceful preemption: stop admitting (new requests
+        get an immediate partial-abort terminal), fail the serving health
+        gate, and abort every in-flight request. With a salvage-enabled
+        engine each abort flushes the tokens decoded so far as a partial,
+        so the manager's continuation (or the trainer's salvage ledger)
+        resumes them on another instance from the last token instead of
+        re-decoding from zero."""
+        self._draining.set()
         with self._aborts_lock:
-            self._aborts.pop(rid, None)
+            n = len(self._aborts)
+        self.drain_count += n
+        self.abort_request(None)
+        return {"success": True, "draining": True, "aborted": n}
+
+    def _serialize_line(self, rid: str, line: dict, abort_ev) -> str:
+        """One outgoing NDJSON line; the fault injector may replace it
+        (corruption), delay it (stall), or trip the abort event (kill)."""
+        if self.fault is not None:
+            replaced = self.fault.on_line(rid, line, abort_ev)
+            if replaced is not None:
+                return replaced
+        return json.dumps(line) + "\n"
+
+    def _drop_abort(self, rid: str, ev: threading.Event | None = None) -> None:
+        with self._aborts_lock:
+            if ev is None or self._aborts.get(rid) is ev:
+                self._aborts.pop(rid, None)
 
     def _batch_loop(self) -> None:
         # requests pulled but not matching the current batch's sampling
@@ -347,6 +411,9 @@ class RolloutServer:
         total = 0
         closed = [False] * len(batch)
         with self._weight_lock:
+            # tag each chunk with the weight version that sampled it: the
+            # whole batch runs under _weight_lock, so one capture suffices
+            wv = self.engine.weight_version
             stream = self.stepper.generate_stream(
                 prompts, batch[0].sampling, max_new=limits, abort_flags=flags)
             for ev in stream:
@@ -361,6 +428,7 @@ class RolloutServer:
                         "logprobs": [ev["logprob"]],
                         "finished": ev["done"],
                         "finish_reason": ev["finish_reason"],
+                        "weight_version": wv,
                     })
                 if ev["done"]:
                     req.out.put(_SENTINEL)
@@ -390,6 +458,14 @@ class RolloutServer:
         pc = getattr(self.engine, "prefix_cache", None)
         if pc is not None:
             info.update(pc.stats())
+        # partial-rollout salvage telemetry (cb engine); drained requests
+        # are a server-level count (the /drain preemption path)
+        if getattr(self.engine, "salvage_partials", False):
+            info["tokens_salvaged"] = self.engine.tokens_salvaged
+            info["salvage_published_pages"] = (
+                self.engine.salvage_published_pages)
+        if self.drain_count:
+            info["drained_requests"] = self.drain_count
         if getattr(self.engine, "spec_tokens", 0):
             # speculative acceptance telemetry: emitted/dispatch vs the
             # spec_tokens+1 ceiling says whether the lookup is paying off

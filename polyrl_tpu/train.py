@@ -114,7 +114,8 @@ def _build_rollout(cfg: RunConfig, mcfg, params, tokenizer, cleanup: list):
                 mcfg, params, pad_token_id=pad, kv_cache_dtype=kv_dtype,
                 max_slots=cfg.rollout.max_slots, page_size=cfg.rollout.page_size,
                 max_seq_len=cfg.rollout.max_seq_len,
-                prefill_chunk=cfg.rollout.prefill_chunk, **kwargs)
+                prefill_chunk=cfg.rollout.prefill_chunk,
+                salvage_partials=cfg.rollout.salvage_partials, **kwargs)
         from polyrl_tpu.rollout.engine import RolloutEngine
 
         kwargs = {}
@@ -132,6 +133,16 @@ def _build_rollout(cfg: RunConfig, mcfg, params, tokenizer, cleanup: list):
     from polyrl_tpu.manager.supervisor import ManagerSupervisor
     from polyrl_tpu.rollout.remote import RemoteRollout
     from polyrl_tpu.transfer import TransferInterface
+
+    fault = None
+    if cfg.rollout.fault_injection.enabled:
+        # chaos mode: one injector shared by the trainer-side stream
+        # wrapper and (below) the colocated local server
+        from polyrl_tpu.rollout.faults import FaultInjector
+
+        fault = FaultInjector(cfg.rollout.fault_injection)
+        log.warning("rollout fault injection ENABLED: %s",
+                    cfg.rollout.fault_injection)
 
     endpoint = cfg.rollout.manager_endpoint
     if not endpoint:
@@ -184,9 +195,12 @@ def _build_rollout(cfg: RunConfig, mcfg, params, tokenizer, cleanup: list):
             prefill_chunk=cfg.rollout.prefill_chunk,
             spec_tokens=cfg.rollout.spec_tokens,
             spec_rounds=cfg.rollout.spec_rounds,
+            salvage_partials=cfg.rollout.salvage_partials,
             **({"prompt_buckets": tuple(cfg.rollout.prompt_buckets)}
                if cfg.rollout.prompt_buckets else {}))
-        local_server = RolloutServer(eng, host="127.0.0.1", port=0).start()
+        local_server = RolloutServer(eng, host="127.0.0.1", port=0)
+        local_server.fault = fault
+        local_server.start()
         cleanup.append(local_server.stop)
         # register through the trainer's client (not a fresh one): the
         # supervisor then records the local endpoint for replay after a
@@ -197,7 +211,9 @@ def _build_rollout(cfg: RunConfig, mcfg, params, tokenizer, cleanup: list):
     return RemoteRollout(mgr, transfer=iface, local_server=local_server,
                          pad_token_id=pad,
                          resume_budget=cfg.rollout.resume_budget,
-                         resume_wait_s=cfg.rollout.resume_wait_s)
+                         resume_wait_s=cfg.rollout.resume_wait_s,
+                         salvage_partials=cfg.rollout.salvage_partials,
+                         fault_injector=fault)
 
 
 def _build_mesh(cfg: RunConfig):

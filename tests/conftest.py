@@ -130,6 +130,7 @@ _HEAVY_MODULES = {
     "test_examples",                # 8B recipe end-to-end at true width
     "test_multihost",               # 2- and 4-process jax.distributed fits
     "test_chaos",                   # cascading mid-stream death scenarios
+    "test_salvage_chaos",           # manager SIGKILL mid-decode + salvage
     "test_colocated_hybrid",        # time-slice release/resume cycles
     "test_rollout_server",          # serving stress + TTFT under load
 }

@@ -6,8 +6,15 @@ import time
 
 import pytest
 
-from polyrl_tpu.manager.client import ManagerClient, spawn_rollout_manager
+from polyrl_tpu.manager.client import (GenerateProgress, GenerateResult,
+                                       ManagerClient, spawn_rollout_manager)
 from tests.fake_engine import FakeEngine
+
+
+def _finals(stream):
+    """Terminal results only (the batch stream also carries token-level
+    GenerateProgress lines since the salvage protocol upgrade)."""
+    return [r for r in stream if isinstance(r, GenerateResult)]
 
 
 @pytest.fixture()
@@ -95,13 +102,22 @@ def test_batch_generate_stream(manager):
         wait_active(manager, 1)
         reqs = [{"rid": f"b{i}", "input_ids": [1] * (i + 1),
                  "sampling_params": {"max_new_tokens": 3}} for i in range(4)]
-        results = list(manager.batch_generate_stream(reqs, max_local_gen_s=30))
+        items = list(manager.batch_generate_stream(reqs, max_local_gen_s=30))
+        results = [r for r in items if isinstance(r, GenerateResult)]
         assert len(results) == 4
         assert all(r.success for r in results)
         rids = sorted(r.rid for r in results)
         assert rids == ["b0", "b1", "b2", "b3"]
         for r in results:
             assert len(r.output_token_ids) == 3
+        # token-level progress forwarding: every token also arrived as a
+        # progress line BEFORE its terminal result (the salvage feed)
+        prog: dict[str, list[int]] = {}
+        for it in items:
+            if isinstance(it, GenerateProgress):
+                prog.setdefault(it.rid, []).extend(it.token_ids)
+        for r in results:
+            assert prog.get(r.rid) == r.output_token_ids
     finally:
         eng.stop()
 
@@ -187,7 +203,8 @@ def test_local_instance_time_slicing(manager):
         wait_active(manager, 2)
         reqs = [{"rid": f"t{i}", "input_ids": [1, 2],
                  "sampling_params": {"max_new_tokens": 4}} for i in range(2)]
-        results = list(manager.batch_generate_stream(reqs, max_local_gen_s=1.0))
+        results = _finals(manager.batch_generate_stream(reqs,
+                                                        max_local_gen_s=1.0))
         assert len(results) == 2
         assert all(r.success for r in results)
         # the local engine was told to abort
@@ -259,7 +276,8 @@ def test_no_fabric_version_bump_keeps_remotes_serving(manager):
         # and batch streaming works too
         reqs = [{"rid": f"nf-b{i}", "input_ids": [1],
                  "sampling_params": {"max_new_tokens": 2}} for i in range(3)]
-        results = list(manager.batch_generate_stream(reqs, max_local_gen_s=30))
+        results = _finals(manager.batch_generate_stream(reqs,
+                                                        max_local_gen_s=30))
         assert len(results) == 3 and all(r.success for r in results)
     finally:
         eng.stop()
@@ -341,7 +359,8 @@ def test_bounded_generate_pool_completes_large_batch():
         wait_active(client, 1)
         reqs = [{"rid": f"bp{i}", "input_ids": [1, 2],
                  "sampling_params": {"max_new_tokens": 3}} for i in range(8)]
-        results = list(client.batch_generate_stream(reqs, max_local_gen_s=30))
+        results = _finals(client.batch_generate_stream(reqs,
+                                                       max_local_gen_s=30))
         assert len(results) == 8
         assert all(r.success for r in results)
     finally:

@@ -172,9 +172,18 @@ def test_manager_routes_through_real_server(server):
         reqs = [{"rid": f"mb{i}", "input_ids": [1, i + 1],
                  "sampling_params": {"max_new_tokens": 3, "temperature": 0.0}}
                 for i in range(3)]
-        results = list(mgr.batch_generate_stream(reqs, max_local_gen_s=60))
+        from polyrl_tpu.manager.client import GenerateProgress, GenerateResult
+
+        items = list(mgr.batch_generate_stream(reqs, max_local_gen_s=60))
+        results = [r for r in items if isinstance(r, GenerateResult)]
         assert len(results) == 3
         assert all(r.success for r in results)
+        # the real engine tags every chunk with its weight version; the
+        # manager carries it through progress lines AND the final result
+        assert any(isinstance(it, GenerateProgress)
+                   and it.weight_version >= 0 for it in items)
+        for r in results:
+            assert r.output_token_weight_versions == [0] * 3
     finally:
         proc.kill()
 

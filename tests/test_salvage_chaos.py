@@ -1,0 +1,130 @@
+"""Chaos tier for token-level continuous generation: SIGKILL the manager
+mid-decode and assert the salvage ledger carries every already-decoded
+token across the respawn — suffix-only re-issues, exact stitched
+sequences, fault/tokens_salvaged > 0 in the step-metric counters.
+
+Heavy module (tests/conftest.py _HEAVY_MODULES): real C++ binary under a
+supervisor, real SIGKILL, multi-second token delays."""
+
+import os
+import signal
+import time
+
+from polyrl_tpu.manager.supervisor import ManagerSupervisor
+from polyrl_tpu.rollout.remote import RemoteRollout
+from polyrl_tpu.rollout.sampling import SamplingParams
+from polyrl_tpu.utils.metrics import MetricsTracker
+from tests.fake_engine import FakeEngine
+
+_FAST_ARGS = ["--health-check-interval-s", "0.1",
+              "--stats-poll-interval-s", "0.2",
+              "--generate-timeout-ms", "10000",
+              "--schedule-wait-timeout-ms", "3000"]
+
+
+def _wait_active(client, n, deadline=15.0):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < deadline:
+        try:
+            st = client.get_instances_status()
+        except Exception:  # noqa: BLE001 — mid-respawn
+            st = {"instances": []}
+        if len([i for i in st["instances"] if i["healthy"]]) >= n:
+            return
+        time.sleep(0.1)
+    raise TimeoutError(client.get_instances_status())
+
+
+def test_manager_sigkill_mid_decode_salvages_tokens():
+    """kill -9 the manager while every request is mid-decode. The ledger
+    (fed by the manager's progress lines) must resume each pending rid
+    from its last token on the respawned manager: tokens_salvaged > 0,
+    suffix re-issues for the pending rids, and — because the fake engine
+    is deterministic given the continued input — the stitched sequences
+    are exactly the uninterrupted ones."""
+    sup = ManagerSupervisor(
+        bind_addr="127.0.0.1:0", extra_args=_FAST_ARGS,
+        health_interval_s=0.2, health_failures=2,
+        respawn_backoff_s=0.1, respawn_backoff_max_s=0.5).start()
+    client = sup.client()
+    # 50 ms/token x 12 tokens ≈ 0.6 s per request: the kill lands while
+    # most rids are mid-decode with several tokens already forwarded
+    eng = FakeEngine(token_delay_s=0.05, start_token=1000).start()
+    try:
+        client.wait_healthy()
+        client.register_rollout_instance(eng.endpoint)
+        _wait_active(client, 1)
+        rr = RemoteRollout(client, resume_budget=3, resume_wait_s=30.0)
+        n_prompts, group_size, max_new = 8, 2, 12
+        sampling = SamplingParams(max_new_tokens=max_new, stop_token_ids=())
+        got: list[int] = []
+        killed = False
+        victim_pid = sup.proc.pid
+        kill_at = time.monotonic() + 0.35  # mid-first-wave decode
+        for chunk in rr.generate_stream([[1, 2]] * n_prompts, sampling,
+                                        group_size=group_size,
+                                        min_emit=group_size):
+            for i, res in chunk:
+                got.append(i)
+                assert res.success
+                # deterministic continuation: a seamless resume reproduces
+                # the uninterrupted stream token-for-token
+                assert res.output_token_ids == [1000 + 2 + j
+                                                for j in range(max_new)]
+                assert len(res.output_token_logprobs) == max_new
+            if not killed and time.monotonic() >= kill_at:
+                os.kill(victim_pid, signal.SIGKILL)
+                killed = True
+        assert killed, "stream finished before the kill could land"
+        assert sorted(got) == list(range(n_prompts))
+        assert sup.restarts >= 1
+        assert rr.stream_resumes >= 1
+        counters = rr.fault_counters()
+        # the headline: decoded tokens survived the manager's death
+        assert counters["fault/tokens_salvaged"] > 0
+        assert counters["fault/suffix_resumes"] >= 1
+        assert counters["fault/resume_prefill_tokens"] > 0
+        assert counters["fault/dropped_groups"] == 0
+        # and they surface in a step metrics record via the gauge path
+        mt = MetricsTracker()
+        mt.update_gauge(counters)
+        rec = mt.as_dict()
+        assert rec["fault/tokens_salvaged"] > 0
+        assert rec["fault/suffix_resumes"] >= 1
+    finally:
+        sup.stop()
+        eng.stop()
+
+
+def test_engine_kill_mid_decode_continues_on_surviving_instance():
+    """Engine-tier chaos with salvage-aware accounting: a dying instance is
+    evicted mid-stream and the manager's continuation — now fed by the
+    partial-flushing wire protocol — finishes each request token-exactly on
+    the survivor, re-decoding nothing it already streamed."""
+    from polyrl_tpu.manager.client import ManagerClient, spawn_rollout_manager
+
+    proc, port = spawn_rollout_manager("127.0.0.1:0", extra_args=_FAST_ARGS)
+    client = ManagerClient(f"127.0.0.1:{port}")
+    dying = FakeEngine(die_after_tokens=3, start_token=1000).start()
+    healthy = FakeEngine(start_token=1000).start()
+    try:
+        client.wait_healthy()
+        for e in (dying, healthy):
+            client.register_rollout_instance(e.endpoint)
+        _wait_active(client, 2)
+        rr = RemoteRollout(client, resume_budget=2, resume_wait_s=10.0)
+        sampling = SamplingParams(max_new_tokens=8, stop_token_ids=())
+        got = []
+        for chunk in rr.generate_stream([[1, 2, 3]] * 6, sampling,
+                                        group_size=2, min_emit=2):
+            for i, res in chunk:
+                got.append(i)
+                assert res.success
+                assert res.output_token_ids == [1000 + 3 + j
+                                                for j in range(8)]
+        assert sorted(got) == list(range(6))
+        assert rr.dropped_groups == 0
+    finally:
+        proc.kill()
+        dying.stop()
+        healthy.stop()

@@ -1,0 +1,541 @@
+"""Token-level continuous generation (partial-rollout salvage): ledger
+fold/stitch units, suffix-only re-issue against a progress-streaming stub,
+greedy interrupt→resume bitwise determinism on the CB engine, /drain
+partials, manager progress forwarding (real C++ binary), the colocated
+degraded-completion path, rid-reuse abort cleanup, and a fault-injected
+fake-engine fit that must finish with zero dropped groups."""
+
+import dataclasses
+import json
+import queue
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from polyrl_tpu.manager.client import (GenerateProgress, GenerateResult,
+                                       ManagerClient, ManagerTransportError,
+                                       spawn_rollout_manager)
+from polyrl_tpu.rollout.faults import (FaultInjectionConfig, FaultInjector,
+                                       base_rid)
+from polyrl_tpu.rollout.remote import RemoteRollout, _SalvageLedger
+from polyrl_tpu.rollout.sampling import SamplingParams
+from tests.fake_engine import FakeEngine
+
+START = 100  # fake-engine arithmetic: token = START + len(input_ids) + i
+
+
+# -- ledger units ------------------------------------------------------------
+
+
+def test_ledger_fold_and_stitch():
+    led = _SalvageLedger()
+    led.extend_cur(GenerateProgress("r", [1, 2], [-0.1, -0.2],
+                                    weight_version=3))
+    led.extend_cur(GenerateProgress("r", [3], [-0.3], weight_version=4))
+    assert led.fold() == 3
+    assert led.base_t == [1, 2, 3]
+    assert led.base_v == [3, 3, 4]
+    assert led.cur_t == []
+    # progress after the re-issue, folded again
+    led.extend_cur(GenerateProgress("r", [4], [-0.4], weight_version=4))
+    assert led.fold() == 1
+    res = GenerateResult(rid="r", success=True, output_token_ids=[5, 6],
+                         output_token_logprobs=[-0.5, -0.6],
+                         finish_reason="stop",
+                         output_token_weight_versions=[5, 5])
+    out = led.stitch(res)
+    assert out.output_token_ids == [1, 2, 3, 4, 5, 6]
+    assert out.output_token_logprobs == [-0.1, -0.2, -0.3, -0.4, -0.5, -0.6]
+    # a resume crossing weight pushes keeps the per-token version tags
+    assert out.output_token_weight_versions == [3, 3, 4, 4, 5, 5]
+    # failed results are never stitched (the group is dropped whole)
+    bad = GenerateResult(rid="r", success=False, output_token_ids=[],
+                         output_token_logprobs=[], finish_reason="error")
+    assert led.stitch(bad) is bad
+
+
+def test_base_rid_strips_attempt_suffix():
+    assert base_rid("s1:3#a2") == "s1:3"
+    assert base_rid("s1:3") == "s1:3"
+    assert base_rid("x#a0#a1") == "x#a0"
+
+
+# -- suffix-only re-issue against a progress-streaming stub ------------------
+
+
+class _ProgressStreamManager:
+    """Streams ``progress_tokens`` per rid as progress lines, then kills the
+    stream, ``fail_times`` times; afterwards completes every request with
+    the fake-engine arithmetic (token = START + len(input_ids) + i), which
+    makes a seamless suffix resume reproduce the uninterrupted sequence."""
+
+    def __init__(self, progress_tokens=2, fail_times=1, wv=7):
+        self.progress_tokens = progress_tokens
+        self.fail_times = fail_times
+        self.wv = wv
+        self.calls: list[list[dict]] = []
+
+    def health(self):
+        return True
+
+    def resume_local_instances(self):
+        return {}
+
+    def batch_generate_stream(self, requests, max_local_gen_s=None):
+        # snapshot: the salvage layer mutates the request dicts in place
+        self.calls.append([{"rid": r["rid"],
+                            "input_ids": list(r["input_ids"]),
+                            "max_new_tokens":
+                                r["sampling_params"]["max_new_tokens"]}
+                           for r in requests])
+        failing = len(self.calls) <= self.fail_times
+        if failing:
+            for r in requests:
+                n = len(r["input_ids"])
+                yield GenerateProgress(
+                    rid=r["rid"],
+                    token_ids=[START + n + i
+                               for i in range(self.progress_tokens)],
+                    logprobs=[-0.5] * self.progress_tokens,
+                    weight_version=self.wv)
+            raise ManagerTransportError("injected stream failure")
+        for r in requests:
+            n = len(r["input_ids"])
+            m = r["sampling_params"]["max_new_tokens"]
+            yield GenerateResult(
+                rid=r["rid"], success=True,
+                output_token_ids=[START + n + i for i in range(m)],
+                output_token_logprobs=[-0.5] * m,
+                finish_reason="length",
+                output_token_weight_versions=[self.wv + 1] * m)
+
+
+def test_stream_salvage_reissues_only_the_suffix():
+    mgr = _ProgressStreamManager(progress_tokens=2)
+    rr = RemoteRollout(mgr, resume_budget=2, resume_wait_s=5.0)
+    prompts = [[1] * 4, [2] * 4, [3] * 4, [4] * 4]
+    chunks = list(rr.generate_stream(
+        prompts, SamplingParams(max_new_tokens=6), group_size=2, min_emit=2))
+    results = dict(i_res for c in chunks for i_res in c)
+    assert sorted(results) == [0, 1, 2, 3]
+    # the re-issue carried prompt+salvage and a decremented budget
+    assert len(mgr.calls) == 2
+    for req in mgr.calls[1]:
+        assert len(req["input_ids"]) == 4 + 2
+        assert req["input_ids"][4:] == [START + 4, START + 4 + 1]
+        assert req["max_new_tokens"] == 6 - 2
+    # stitched sequence == the uninterrupted arithmetic run, zero re-decoded
+    for res in results.values():
+        assert res.output_token_ids == [START + 4 + i for i in range(6)]
+        assert len(res.output_token_logprobs) == 6
+        # tokens sampled before/after the resume keep their version tags
+        assert res.output_token_weight_versions == [7, 7, 8, 8, 8, 8]
+    assert rr.tokens_salvaged == 8
+    assert rr.suffix_resumes == 4
+    assert rr.resume_prefill_tokens == 4 * 6
+    assert rr.stream_resumes == 1
+    assert rr.dropped_groups == 0
+    counters = rr.fault_counters()
+    assert counters["fault/tokens_salvaged"] == 8.0
+    assert counters["fault/suffix_resumes"] == 4.0
+
+
+def test_salvage_completing_budget_synthesizes_terminal():
+    # progress covers the whole budget: the fold must complete the request
+    # locally instead of re-issuing with max_new_tokens <= 0
+    mgr = _ProgressStreamManager(progress_tokens=3, fail_times=99)
+    rr = RemoteRollout(mgr, resume_budget=1, resume_wait_s=0.1)
+    chunks = list(rr.generate_stream(
+        [[9] * 4] * 2, SamplingParams(max_new_tokens=3), group_size=2,
+        min_emit=2))
+    results = [res for c in chunks for _, res in c]
+    assert len(results) == 2
+    for res in results:
+        assert res.output_token_ids == [START + 4 + i for i in range(3)]
+        assert res.finish_reason == "length"
+    assert len(mgr.calls) == 1  # never re-issued
+    assert rr.suffix_resumes == 0
+    assert rr.dropped_groups == 0
+
+
+def test_salvage_stop_token_synthesizes_terminal():
+    stop = START + 4 + 1  # second salvaged token is a stop token
+    mgr = _ProgressStreamManager(progress_tokens=2, fail_times=99)
+    rr = RemoteRollout(mgr, resume_budget=1, resume_wait_s=0.1)
+    chunks = list(rr.generate_stream(
+        [[9] * 4] * 2,
+        SamplingParams(max_new_tokens=8, stop_token_ids=(stop,)),
+        group_size=2, min_emit=2))
+    results = [res for c in chunks for _, res in c]
+    assert len(results) == 2
+    for res in results:
+        assert res.output_token_ids[-1] == stop
+        assert res.finish_reason == "stop"
+    assert len(mgr.calls) == 1
+
+
+def test_finish_locally_reuses_salvaged_prefix():
+    class _LocalEngine:
+        def __init__(self):
+            self.seen: list[tuple[list[int], int]] = []
+
+        def resume_memory(self):
+            pass
+
+        def release_memory(self):
+            pass
+
+        def generate(self, prompts, sampling, **kw):
+            out = []
+            for p in prompts:
+                self.seen.append((list(p), sampling.max_new_tokens))
+                out.append({"token_ids": [START + len(p) + i
+                                          for i in range(
+                                              sampling.max_new_tokens)],
+                            "logprobs": [-0.5] * sampling.max_new_tokens,
+                            "finish_reason": "length"})
+            return out
+
+    from types import SimpleNamespace
+
+    eng = _LocalEngine()
+    mgr = _ProgressStreamManager(progress_tokens=2, fail_times=99)
+    rr = RemoteRollout(mgr, local_server=SimpleNamespace(engine=eng),
+                       resume_budget=0, resume_wait_s=0.1)
+    chunks = list(rr.generate_stream(
+        [[1] * 4] * 2, SamplingParams(max_new_tokens=6), group_size=2,
+        min_emit=2))
+    results = [res for c in chunks for _, res in c]
+    assert rr.local_fallbacks == 1
+    # the degraded completion got prompt+salvage and the DECREMENTED budget
+    for p, mnt in eng.seen:
+        assert len(p) == 6 and p[4:] == [START + 4, START + 5]
+        assert mnt == 4
+    # and the stitched output still reproduces the uninterrupted sequence
+    for res in results:
+        assert res.output_token_ids == [START + 4 + i for i in range(6)]
+    assert rr.tokens_salvaged == 4
+
+
+def test_salvage_disabled_restores_from_zero_resume():
+    mgr = _ProgressStreamManager(progress_tokens=2)
+    rr = RemoteRollout(mgr, resume_budget=2, resume_wait_s=5.0,
+                       salvage_partials=False)
+    chunks = list(rr.generate_stream(
+        [[1] * 4] * 2, SamplingParams(max_new_tokens=6), group_size=2,
+        min_emit=2))
+    results = [res for c in chunks for _, res in c]
+    assert len(results) == 2
+    # re-issue went back to the ORIGINAL prompt and full budget
+    assert [len(r["input_ids"]) for r in mgr.calls[1]] == [4, 4]
+    assert [r["max_new_tokens"] for r in mgr.calls[1]] == [6, 6]
+    assert rr.tokens_salvaged == 0 and rr.suffix_resumes == 0
+
+
+# -- rid-reuse abort cleanup (RolloutServer._drop_abort) ---------------------
+
+
+def test_drop_abort_identity_checked_on_rid_reuse(monkeypatch):
+    from polyrl_tpu.rollout.server import RolloutServer
+
+    srv = RolloutServer.__new__(RolloutServer)  # no engine/HTTP needed
+    srv._aborts = {}
+    srv._aborts_lock = threading.Lock()
+    first = threading.Event()
+    second = threading.Event()
+    srv._aborts["rid"] = second  # a retry re-registered the rid
+    # the FIRST attempt's teardown must not pop the replacement's event
+    srv._drop_abort("rid", first)
+    assert srv._aborts.get("rid") is second
+    # abort_request must still reach the live (second) attempt
+    srv.abort_request("rid")
+    assert second.is_set() and not first.is_set()
+    # the owner's teardown removes it
+    srv._drop_abort("rid", second)
+    assert "rid" not in srv._aborts
+
+
+# -- greedy interrupt → resume determinism on the CB engine ------------------
+
+
+@pytest.fixture(scope="module")
+def tiny_engine_parts():
+    from polyrl_tpu.models import decoder
+
+    # float32: the bitwise prefill-vs-decode parity below is only exact
+    # without bf16 rounding (conftest already pins highest matmul precision)
+    cfg = decoder.get_config("tiny", dtype=jnp.float32)
+    params = decoder.init_params(jax.random.PRNGKey(0), cfg)
+    return cfg, params
+
+
+def _mk_engine(cfg, params, **kw):
+    from polyrl_tpu.rollout.cb_engine import CBEngine
+
+    kw.setdefault("max_slots", 4)
+    kw.setdefault("page_size", 8)
+    kw.setdefault("max_seq_len", 512)
+    kw.setdefault("prompt_buckets", (16, 32, 64))
+    kw.setdefault("num_pages", 128)
+    kw.setdefault("steps_per_dispatch", 2)
+    kw.setdefault("pipeline_depth", 4)
+    return CBEngine(cfg, params, **kw)
+
+
+def _drain_stream(out):
+    from polyrl_tpu.rollout.cb_engine import STREAM_END
+
+    toks, lps, reason = [], [], ""
+    while True:
+        item = out.get(timeout=180)
+        if item is STREAM_END:
+            break
+        toks += item["token_ids"]
+        lps += item["logprobs"]
+        if item.get("finished"):
+            reason = item.get("finish_reason", "")
+    return toks, lps, reason
+
+
+def test_greedy_interrupt_resume_is_bitwise_identical(tiny_engine_parts):
+    """Acceptance criterion: a generation killed at token k and resumed on
+    ANOTHER engine yields the identical token/logprob sequence as an
+    uninterrupted run, re-decoding zero tokens before k."""
+    cfg, params = tiny_engine_parts
+    prompt = [5, 6, 7, 9, 11]
+    budget = 160
+    sp = SamplingParams(temperature=0.0, max_new_tokens=budget,
+                        stop_token_ids=())
+
+    ref_eng = _mk_engine(cfg, params).start()
+    ref = ref_eng.generate([prompt], sp, timeout=300.0)[0]
+    ref_eng.stop()
+    assert len(ref["token_ids"]) == budget
+
+    # interrupted run: abort mid-decode; salvage flushes in-flight tokens
+    eng1 = _mk_engine(cfg, params).start()
+    ev = threading.Event()
+    out = eng1.submit("r1", prompt, sp, abort=ev)
+    got_t, got_l = [], []
+    while len(got_t) < 5:
+        item = out.get(timeout=180)
+        got_t += item["token_ids"]
+        got_l += item["logprobs"]
+        assert "weight_version" in item  # per-token version tagging
+    ev.set()
+    tail_t, tail_l, reason = _drain_stream(out)
+    got_t += tail_t
+    got_l += tail_l
+    assert reason == "abort"
+    k = len(got_t)
+    assert 0 < k < budget, "abort landed after the run finished — flaky"
+    assert eng1.tokens_salvaged > 0  # the drain flushed in-flight tokens
+    # the salvaged prefix is BITWISE the uninterrupted prefix (tokens and
+    # logprobs): nothing before k is ever re-decoded
+    assert got_t == ref["token_ids"][:k]
+    np.testing.assert_array_equal(np.asarray(got_l, np.float32),
+                                  np.asarray(ref["logprobs"][:k], np.float32))
+
+    # resume on ANOTHER engine: prompt+salvaged prefilled, budget shrunk
+    eng2 = _mk_engine(cfg, params).start()
+    sp2 = dataclasses.replace(sp, max_new_tokens=budget - k)
+    res2 = eng2.generate([prompt + got_t], sp2, timeout=300.0)[0]
+    eng2.stop()
+
+    # stitched tokens identical; suffix logprobs at the prefix-cache
+    # parity tolerance (prefill-built vs decode-built KV differs in the
+    # last float bits — different XLA reduction orders — the same bound
+    # test_prefix_cache.py accepts for cached-prefix decoding)
+    stitched_t = got_t + res2["token_ids"]
+    stitched_l = got_l + res2["logprobs"]
+    assert stitched_t == ref["token_ids"]
+    np.testing.assert_allclose(
+        np.asarray(stitched_l, np.float32),
+        np.asarray(ref["logprobs"], np.float32), atol=5e-4)
+
+    # resume on the SAME engine: the abort published prompt+generated pages,
+    # so the continuation's suffix prefill hits the prefix cache
+    assert eng1.salvage_published_pages > 0
+    hits_before = eng1.prefix_cache.hits
+    res1 = eng1.generate([prompt + got_t], sp2, timeout=300.0)[0]
+    assert eng1.prefix_cache.hits > hits_before
+    assert got_t + res1["token_ids"] == ref["token_ids"]
+    eng1.stop()
+
+
+def test_drain_endpoint_flushes_partials(tiny_engine_parts):
+    """POST /drain: in-flight request ends in a partial abort carrying its
+    decoded tokens; the health gate fails; new submissions are refused with
+    an immediate abort terminal."""
+    import http.client
+
+    from polyrl_tpu.rollout.server import RolloutServer
+
+    cfg, params = tiny_engine_parts
+    srv = RolloutServer(_mk_engine(cfg, params), host="127.0.0.1",
+                        port=0).start()
+    host, port = srv.endpoint.split(":")
+
+    def post(path, body, stream=False):
+        conn = http.client.HTTPConnection(host, int(port), timeout=60)
+        conn.request("POST", path, json.dumps(body),
+                     {"Content-Type": "application/json"})
+        return conn, conn.getresponse()
+
+    lines: list[dict] = []
+    done = threading.Event()
+
+    def consume():
+        conn, resp = post("/generate", {
+            "rid": "d1", "input_ids": [3, 4, 5],
+            "sampling_params": {"temperature": 0.0,
+                                "max_new_tokens": 300}})
+        for raw in resp:
+            raw = raw.strip()
+            if raw:
+                lines.append(json.loads(raw))
+        conn.close()
+        done.set()
+
+    t = threading.Thread(target=consume, daemon=True)
+    t.start()
+    deadline = time.monotonic() + 60
+    while not lines and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert lines, "no tokens streamed before the drain"
+
+    conn, resp = post("/drain", {})
+    out = json.loads(resp.read())
+    conn.close()
+    assert out["success"] and out["draining"]
+    assert done.wait(timeout=60)
+    assert lines[-1]["finish_reason"] == "abort"
+    n_tokens = sum(len(li["token_ids"]) for li in lines)
+    assert 0 < n_tokens < 300  # partial, not dropped, not complete
+
+    # health gate fails while /health stays alive
+    conn = http.client.HTTPConnection(host, int(port), timeout=10)
+    conn.request("GET", "/health_generate")
+    assert conn.getresponse().status == 503
+    conn.close()
+    conn = http.client.HTTPConnection(host, int(port), timeout=10)
+    conn.request("GET", "/health")
+    assert conn.getresponse().status == 200
+    conn.close()
+
+    # new submissions refuse with an immediate abort partial
+    conn, resp = post("/generate", {
+        "rid": "d2", "input_ids": [1, 2],
+        "sampling_params": {"max_new_tokens": 4}})
+    refused = [json.loads(r) for r in resp if r.strip()]
+    conn.close()
+    assert refused[-1]["finish_reason"] == "abort"
+    assert srv.drain_count >= 1
+    srv.stop()
+
+
+# -- manager progress forwarding (real C++ binary) ---------------------------
+
+
+_FAST_ARGS = ["--health-check-interval-s", "0.1",
+              "--stats-poll-interval-s", "0.2",
+              "--generate-timeout-ms", "10000",
+              "--schedule-wait-timeout-ms", "3000"]
+
+
+def _wait_active(client, n, deadline=10.0):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < deadline:
+        st = client.get_instances_status()
+        if len([i for i in st["instances"] if i["healthy"]]) >= n:
+            return
+        time.sleep(0.1)
+    raise TimeoutError(client.get_instances_status())
+
+
+def test_manager_forwards_token_progress():
+    proc, port = spawn_rollout_manager("127.0.0.1:0", extra_args=_FAST_ARGS)
+    client = ManagerClient(f"127.0.0.1:{port}")
+    eng = FakeEngine(token_delay_s=0.01, start_token=START).start()
+    try:
+        client.wait_healthy()
+        client.register_rollout_instance(eng.endpoint)
+        _wait_active(client, 1)
+        reqs = [{"rid": f"p{i}", "input_ids": [1, 2, 3],
+                 "sampling_params": {"max_new_tokens": 5}}
+                for i in range(2)]
+        progress: dict[str, list[int]] = {}
+        finals: dict[str, GenerateResult] = {}
+        for item in client.batch_generate_stream(reqs):
+            if isinstance(item, GenerateProgress):
+                progress.setdefault(item.rid, []).extend(item.token_ids)
+            else:
+                finals[item.rid] = item
+        assert sorted(finals) == ["p0", "p1"]
+        for rid, res in finals.items():
+            assert res.success
+            # progress lines covered the exact final token sequence
+            assert progress[rid] == res.output_token_ids
+            assert res.output_token_ids == [START + 3 + i for i in range(5)]
+            # fake engine reports no weight_version → tagged -1 end-to-end
+            assert res.output_token_weight_versions == [-1] * 5
+    finally:
+        proc.kill()
+        eng.stop()
+
+
+# -- fault-injected fake-engine fit (acceptance criterion) -------------------
+
+
+def test_fault_injected_fit_salvages_every_request():
+    """Fault injection kills the manager stream once at the worst moment
+    (every rid pending with progress): the fit step must complete with
+    fault/suffix_resumes >= batch size and ZERO dropped groups."""
+    from polyrl_tpu.data.dataset import PromptDataLoader, make_arithmetic_dataset
+    from polyrl_tpu.models import decoder
+    from polyrl_tpu.rewards.manager import load_reward_manager
+    from polyrl_tpu.trainer.actor import ActorConfig, StreamActor
+    from polyrl_tpu.trainer.stream_trainer import StreamRLTrainer, TrainerConfig
+    from polyrl_tpu.utils.tokenizer import ByteTokenizer
+
+    proc, port = spawn_rollout_manager("127.0.0.1:0", extra_args=_FAST_ARGS)
+    client = ManagerClient(f"127.0.0.1:{port}")
+    eng = FakeEngine(token_delay_s=0.03, start_token=50).start()
+    try:
+        client.wait_healthy()
+        client.register_rollout_instance(eng.endpoint)
+        _wait_active(client, 1)
+        injector = FaultInjector(FaultInjectionConfig(
+            enabled=True, stream_kill_times=1, stream_kill_min_progress=1))
+        rr = RemoteRollout(client, resume_budget=3, resume_wait_s=10.0,
+                           fault_injector=injector)
+        tok = ByteTokenizer()
+        mcfg = decoder.get_config("tiny", dtype=jnp.float32, vocab_size=512,
+                                  max_position_embeddings=128)
+        params = decoder.init_params(jax.random.PRNGKey(0), mcfg)
+        tcfg = TrainerConfig(
+            train_batch_size=4, rollout_n=2, ppo_mini_batch_size=8,
+            micro_batch_size=4, min_stream_batch_size=8,
+            max_prompt_length=16, max_response_length=8,
+            adv_estimator="grpo", total_steps=1, temperature=1.0)
+        actor = StreamActor(mcfg, ActorConfig(lr=1e-4, remat=False), params)
+        trainer = StreamRLTrainer(
+            tcfg, actor, rr, tok,
+            load_reward_manager("naive", tok, num_workers=1),
+            PromptDataLoader(make_arithmetic_dataset(16), 4))
+        history = trainer.fit()
+        assert len(history) == 1
+        h = history[0]
+        assert injector.stream_kills == 1, "the injected kill never fired"
+        # every request (batch 4 x n 2 = 8) resumed as a suffix, none lost
+        assert h["fault/suffix_resumes"] >= 8
+        assert h["fault/tokens_salvaged"] >= 8
+        assert h["fault/dropped_groups"] == 0
+        assert rr.dropped_groups == 0
+    finally:
+        proc.kill()
+        eng.stop()

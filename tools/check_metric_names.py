@@ -22,8 +22,12 @@ Static coverage (AST, literals only — dynamic keys can't be checked):
 Covered key families include the pipelined trainer's ``perf/pipeline_*``
 (``perf/pipeline_overlap_s``, ``perf/pipeline_queue_depth``) and
 ``perf/weight_staleness`` gauges plus the ``actor/tis_*`` correction
-metrics (trainer/pipeline.py, stream_trainer.py) — new metric emitters in
-``polyrl_tpu/`` are linted automatically; nothing needs registering.
+metrics (trainer/pipeline.py, stream_trainer.py), and the token-level
+salvage counters — ``fault/tokens_salvaged``, ``fault/suffix_resumes``,
+``fault/resume_prefill_tokens`` (rollout/remote.py ``fault_counters``)
+and the injector's ``fault/injected_*`` (rollout/faults.py ``counters``)
+— new metric emitters in ``polyrl_tpu/`` are linted automatically;
+nothing needs registering.
 
 Run: ``python tools/check_metric_names.py [root ...]`` — exits 1 and lists
 violations. Wired into the quick test tier (tests/test_obs_tracing.py).
