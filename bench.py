@@ -61,9 +61,15 @@ RELAY_POLL_S = float(os.environ.get("POLYRL_BENCH_RELAY_POLL", "30"))
 # got SIGTERMed mid-write. Past this many seconds of accumulated downtime
 # the parent emits the partial/failed JSON itself and exits 0 — well under
 # the harness timeout, so the record always lands intact. Overridable via
-# env or ``--relay-down-budget-s=N``.
+# env or ``--relay-down-budget-s=N`` — but CLAMPED to the cap below:
+# r05 rode an oversized env-provided budget straight into the harness's
+# ~1800 s SIGTERM, which is exactly what the budget exists to prevent.
 RELAY_DOWN_BUDGET_S = float(
-    os.environ.get("POLYRL_BENCH_RELAY_DOWN_BUDGET", "600"))
+    os.environ.get("POLYRL_BENCH_RELAY_DOWN_BUDGET", "300"))
+# Hard ceiling on the effective budget, well below the harness kill window
+# (r05 died rc=124 at ~1800 s wall): no env/CLI value may exceed it.
+RELAY_DOWN_BUDGET_CAP_S = float(
+    os.environ.get("POLYRL_BENCH_RELAY_DOWN_CAP", "900"))
 # phase name → key its result is stored under in extra (single source for
 # child_main's phase table, attempt refunds, and the headline assembly)
 PHASE_STORE_KEYS = {"8b": "llama3_8b"}
@@ -1192,6 +1198,31 @@ def child_main() -> None:
     print(json.dumps(state["result"]))
 
 
+def _maybe_run_gate() -> None:
+    """Bench post-step (``POLYRL_BENCH_GATE=1``): run tools/bench_gate.py
+    over the repo's ``BENCH_*.json`` trajectory after the driver line is
+    emitted. stderr-only and best-effort — the gate must never alter the
+    driver JSON line or the bench exit code."""
+    if os.environ.get("POLYRL_BENCH_GATE", "") != "1":
+        return
+    try:
+        import importlib.util
+
+        here = os.path.dirname(os.path.abspath(__file__))
+        spec = importlib.util.spec_from_file_location(
+            "bench_gate", os.path.join(here, "tools", "bench_gate.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        paths = mod.find_rounds(here)
+        if not paths:
+            return
+        _, report = mod.run(paths, mod.DEFAULT_THRESHOLD)
+        print(f"[bench] gate: {json.dumps(report)}",
+              file=sys.stderr, flush=True)
+    except Exception as exc:  # noqa: BLE001 — the gate is advisory here
+        print(f"[bench] gate failed: {exc}", file=sys.stderr, flush=True)
+
+
 def _emit_partial(note: str, relay_stats: dict | None = None) -> None:
     """Print the state-derived JSON line (partial results beat none)."""
     state = _load_state()
@@ -1259,8 +1290,11 @@ def parent_main() -> None:
     # legitimate full-phase TPU run can take ~45 min through the tunnel);
     # a stricter DRIVER timeout is handled by the SIGTERM partial emit
     budget_s = float(os.environ.get("POLYRL_BENCH_BUDGET", "7200"))
-    relay_down_budget = _cli_float("--relay-down-budget-s",
-                                   RELAY_DOWN_BUDGET_S)
+    # clamp: a budget that outlives the harness timeout defeats the whole
+    # fail-fast (the r05 failure mode) — the cap wins over env AND CLI
+    relay_down_budget = min(
+        _cli_float("--relay-down-budget-s", RELAY_DOWN_BUDGET_S),
+        RELAY_DOWN_BUDGET_CAP_S)
     t_start = time.monotonic()
     last_err = ""
     runs, no_progress = 0, 0
@@ -1306,6 +1340,7 @@ def parent_main() -> None:
               file=sys.stderr, flush=True)
         attempt_s = min(ATTEMPT_TIMEOUT_S,
                         max(budget_s - (time.monotonic() - t_start), 60.0))
+        t_child = time.monotonic()
         try:
             child_ref[0] = subprocess.Popen(
                 [sys.executable, os.path.abspath(__file__), "--child"],
@@ -1327,15 +1362,33 @@ def parent_main() -> None:
             child_ref[0] = None
         if rc == 0 and out.strip():
             sys.stdout.write(out.strip().splitlines()[-1] + "\n")
+            _maybe_run_gate()
             return
         if _relay_required() and not _relay_up():
             # the tunnel died mid-child: that's a relay failure, not a
             # phase failure — refund unfinished phases' attempts and go
-            # back to cheap polling without burning the progress streak
+            # back to cheap polling without burning the progress streak.
+            # The child's wall was spent against a dead/dying relay, so it
+            # counts toward the relay-down budget too — otherwise a chain
+            # of wedged child runs rides the harness timeout the budget
+            # exists to beat (the pre-run poll loop and this path now
+            # drain the SAME budget).
+            relay_stats["down_s"] = round(
+                relay_stats["down_s"] + (time.monotonic() - t_child), 1)
             _refund_unfinished_attempts()
             print("[bench] relay found DOWN after failed child — attempts "
-                  "refunded, returning to socket polling",
-                  file=sys.stderr, flush=True)
+                  f"refunded ({relay_stats['down_s']:.0f}s of "
+                  f"{relay_down_budget:.0f}s down-budget spent), returning "
+                  "to socket polling", file=sys.stderr, flush=True)
+            if relay_stats["down_s"] >= relay_down_budget:
+                print(f"[bench] relay-down budget "
+                      f"{relay_down_budget:.0f}s exhausted — emitting "
+                      "partial result and exiting",
+                      file=sys.stderr, flush=True)
+                _emit_partial(
+                    f"relay down {relay_stats['down_s']:.0f}s (budget "
+                    f"{relay_down_budget:.0f}s); failing fast", relay_stats)
+                return
             prev = snapshot()
             continue
         cur = snapshot()

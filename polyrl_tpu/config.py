@@ -154,15 +154,31 @@ class LoggingSection:
 
 @dataclass
 class ObsSection:
-    """Observability knobs (ARCHITECTURE.md "Observability"): span tracing
-    with cross-process propagation + Perfetto export, and the per-step
-    manager /metrics scrape."""
+    """Observability knobs (ARCHITECTURE.md "Observability" + "Goodput &
+    health plane"): span tracing with cross-process propagation + Perfetto
+    export, the per-step manager /metrics scrape, the /statusz health
+    exporter, and the anomaly flight recorder."""
     trace: bool = False                   # span tracer on/off
     trace_dir: str = ""                   # spans.jsonl + trace.json dump dir
     trace_buffer: int = 4096              # ring-buffer span capacity
     # wrap trainer phases in jax.profiler.TraceAnnotation so device traces
     # (trainer.profile_steps) line up with host spans
     jax_annotations: bool = False
+    # live health plane: the trainer serves GET /statusz (shared schema
+    # with the rollout server's route — obs/statusz.py). port 0 = ephemeral
+    statusz: bool = False
+    statusz_host: str = "127.0.0.1"
+    statusz_port: int = 0
+    # anomaly flight recorder (obs/recorder.py): EWMA/z-score detection
+    # over step time + rollout throughput; dumps post-mortem bundles
+    # (trace ring, last N step records, thread stacks, fault counters)
+    # into recorder_dir on anomaly/crash/SIGTERM
+    recorder: bool = False
+    recorder_dir: str = ""                # "" -> next to logging.path
+    recorder_keep_steps: int = 64         # step records per bundle
+    recorder_z: float = 4.0               # z-score anomaly threshold
+    recorder_warmup: int = 5              # steps before detection arms
+    recorder_max_bundles: int = 4         # bundle budget per run
 
 
 @dataclass

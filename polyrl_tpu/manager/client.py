@@ -301,10 +301,19 @@ class ManagerClient:
 
     def metrics_text(self, timeout: float = 5.0) -> str:
         """Raw Prometheus text from GET /metrics (the trainer scrapes this
-        once per step and merges it into the step record as manager/*)."""
-        req = urllib.request.Request(self.endpoint + "/metrics", method="GET")
-        with urllib.request.urlopen(req, timeout=timeout) as r:
-            return r.read().decode()
+        once per step and merges it into the step record as manager/*).
+        No internal retry: a scrape miss degrades gracefully at the caller
+        (RemoteRollout skips the merge and counts obs/scrape_failed) —
+        retrying telemetry inside a step would trade step latency for a
+        metric merge nobody is blocked on."""
+        with obs.span("manager/metrics"):
+            req = urllib.request.Request(self.endpoint + "/metrics",
+                                         method="GET")
+            t0 = time.monotonic()
+            with urllib.request.urlopen(req, timeout=timeout) as r:
+                text = r.read().decode()
+            obs.observe("manager/scrape_s", time.monotonic() - t0)
+            return text
 
     def shutdown_instances(self, skip_if_updating_weights: bool = False) -> dict:
         return self._call("POST", "/shutdown_instances",

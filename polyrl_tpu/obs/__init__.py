@@ -12,6 +12,12 @@ The pieces (ARCHITECTURE.md "Observability"):
   ``observe()`` into; the trainer drains it into each step record.
 - :mod:`polyrl_tpu.obs.scrape` — Prometheus text-exposition parser for the
   manager's ``GET /metrics``, merged into step records as ``manager/*``.
+- :mod:`polyrl_tpu.obs.goodput` — per-step wall-time attribution ledger
+  (``goodput/*`` phase metrics, tokens/chip/s, MFU estimate).
+- :mod:`polyrl_tpu.obs.statusz` — the live ``/statusz`` health plane: one
+  JSON schema served by both the trainer and the rollout server.
+- :mod:`polyrl_tpu.obs.recorder` — anomaly flight recorder: EWMA/z-score
+  detection over the step stream + post-mortem bundle dumps.
 
 Everything here is import-light (no jax at module load) and no-op-cheap
 when tracing is disabled, so hot paths can call into it unconditionally.
@@ -21,10 +27,14 @@ from __future__ import annotations
 
 import contextlib
 
+from polyrl_tpu.obs.goodput import GoodputLedger  # noqa: F401
 from polyrl_tpu.obs.histogram import (Histogram, drain_histograms,  # noqa: F401
                                       observe)
+from polyrl_tpu.obs.recorder import (AnomalyDetector,  # noqa: F401
+                                     FlightRecorder)
 from polyrl_tpu.obs.scrape import (manager_gauges,  # noqa: F401
                                    parse_prometheus_text)
+from polyrl_tpu.obs.statusz import StatuszServer, build_snapshot  # noqa: F401
 from polyrl_tpu.obs.trace import Tracer, get_tracer  # noqa: F401
 
 _jax_annotations = False

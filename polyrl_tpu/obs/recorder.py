@@ -1,0 +1,226 @@
+"""Anomaly flight recorder (ARCHITECTURE.md "Goodput & health plane").
+
+BENCH_r01–r05 all died rc=124 with nobody noticing mid-run: nothing was
+watching the live trajectory. The recorder watches the per-step record
+stream with an EWMA/z-score detector over step time and decode throughput
+and, on anomaly, crash, or SIGTERM, dumps a self-contained post-mortem
+bundle into the run directory:
+
+``<out_dir>/postmortem/<seq>-<reason>/``
+    ``spans.jsonl``    — the tracer ring buffer (the last trace_buffer
+                         spans across trainer/manager/engine)
+    ``steps.jsonl``    — the last ``keep_steps`` step records
+    ``stacks.txt``     — ``faulthandler`` dump of every thread's stack
+    ``counters.json``  — reason, anomaly details, fault/salvage counters,
+                         detector state
+
+Detector design: EWMA mean + EW variance with a **median-initialized
+warmup** (the first step carries jit compiles — seeding the mean from the
+median of the warmup window keeps one cold-start outlier from poisoning
+the baseline) and a sigma floor (``min_sigma_frac`` of the mean) so a
+near-constant series doesn't hair-trigger on noise. Anomalous samples are
+NOT folded into the statistics — one stall yields one anomaly, and the
+recovered steps after it read normal again (pinned by test).
+"""
+
+from __future__ import annotations
+
+import collections
+import faulthandler
+import json
+import logging
+import math
+import os
+import re
+import signal
+import threading
+import time
+
+log = logging.getLogger(__name__)
+
+
+class AnomalyDetector:
+    """EWMA/z-score detector for one metric stream."""
+
+    def __init__(self, z_threshold: float = 4.0, warmup: int = 5,
+                 alpha: float = 0.3, min_sigma_frac: float = 0.1):
+        if warmup < 2:
+            raise ValueError(f"warmup must be >= 2, got {warmup}")
+        self.z_threshold = z_threshold
+        self.warmup = warmup
+        self.alpha = alpha
+        self.min_sigma_frac = min_sigma_frac
+        self.mean: float | None = None
+        self.var = 0.0
+        self.n = 0
+        self._warm: list[float] = []
+
+    def _sigma(self) -> float:
+        # floor: EW sigma, but never below min_sigma_frac of |mean| — a
+        # perfectly steady warmup must not make ordinary jitter anomalous
+        return max(math.sqrt(self.var),
+                   self.min_sigma_frac * abs(self.mean or 0.0), 1e-12)
+
+    def observe(self, value: float) -> float | None:
+        """Feed one sample; returns its z-score when anomalous, else None.
+        Warmup samples are never anomalous; anomalous samples do not
+        update the statistics."""
+        v = float(value)
+        self.n += 1
+        if self.mean is None:
+            self._warm.append(v)
+            if len(self._warm) >= self.warmup:
+                # median-initialized baseline: robust to the cold-start
+                # outlier (first-step jit compiles) inside the warmup
+                srt = sorted(self._warm)
+                mid = len(srt) // 2
+                med = (srt[mid] if len(srt) % 2
+                       else 0.5 * (srt[mid - 1] + srt[mid]))
+                self.mean = med
+                dev = sorted(abs(x - med) for x in srt)
+                mad = (dev[mid] if len(dev) % 2
+                       else 0.5 * (dev[mid - 1] + dev[mid]))
+                # 1.4826 ~ MAD->sigma for a normal distribution
+                self.var = (1.4826 * mad) ** 2
+                self._warm = []
+            return None
+        z = (v - self.mean) / self._sigma()
+        if abs(z) > self.z_threshold:
+            return z
+        a = self.alpha
+        delta = v - self.mean
+        self.mean += a * delta
+        self.var = (1 - a) * (self.var + a * delta * delta)
+        return None
+
+    def state(self) -> dict:
+        return {"n": self.n, "mean": self.mean, "sigma": self._sigma()
+                if self.mean is not None else None,
+                "warmed": self.mean is not None}
+
+
+# step-record keys the recorder watches by default: wall step time (a
+# stall spikes it) and the rollout plane's decode throughput (a sick pool
+# collapses it)
+DEFAULT_WATCH = ("perf/step_time_s", "perf/rollout_throughput_tok_s")
+
+
+class FlightRecorder:
+    """Watches the step-record stream; dumps post-mortem bundles."""
+
+    def __init__(self, out_dir: str, keep_steps: int = 64,
+                 z_threshold: float = 4.0, warmup: int = 5,
+                 alpha: float = 0.3, min_sigma_frac: float = 0.1,
+                 max_bundles: int = 4,
+                 watch: tuple[str, ...] = DEFAULT_WATCH):
+        self.out_dir = out_dir
+        self.max_bundles = max_bundles
+        self._steps: collections.deque = collections.deque(maxlen=keep_steps)
+        self._detectors = {
+            key: AnomalyDetector(z_threshold=z_threshold, warmup=warmup,
+                                 alpha=alpha, min_sigma_frac=min_sigma_frac)
+            for key in watch}
+        self._lock = threading.Lock()
+        self._seq = 0
+        self.anomalies = 0        # anomalous STEPS (one per step, not per key)
+        self.bundles_dropped = 0  # bundles skipped past max_bundles
+        self.bundle_paths: list[str] = []
+        # optional zero-arg callable returning cumulative fault counters
+        # (RemoteRollout.fault_counters) folded into every bundle
+        self.counters_fn = None
+
+    # -- step stream ---------------------------------------------------------
+
+    def record_step(self, step: int, record: dict) -> str | None:
+        """Feed one finished step's metric record; dumps and returns a
+        bundle path when any watched series is anomalous."""
+        with self._lock:
+            self._steps.append({"step": step, **record})
+        reasons = []
+        for key, det in self._detectors.items():
+            if key not in record:
+                continue
+            z = det.observe(float(record[key]))
+            if z is not None:
+                reasons.append(f"{key}={record[key]:.4g} z={z:.1f}")
+        if not reasons:
+            return None
+        self.anomalies += 1
+        return self.dump("anomaly", detail="; ".join(reasons), step=step)
+
+    def counters(self) -> dict[str, float]:
+        """Step-record gauges (``obs/*`` namespace, lint-documented)."""
+        return {"obs/anomalies": float(self.anomalies),
+                "obs/bundles": float(len(self.bundle_paths))}
+
+    # -- bundle dump ---------------------------------------------------------
+
+    def dump(self, reason: str, detail: str = "",
+             step: int | None = None) -> str | None:
+        """Write one post-mortem bundle; returns its path (None when the
+        bundle budget is spent or the write fails — the recorder must
+        never take the run down)."""
+        with self._lock:
+            if len(self.bundle_paths) >= self.max_bundles:
+                self.bundles_dropped += 1
+                log.warning("flight recorder: bundle budget (%d) spent; "
+                            "dropping %r", self.max_bundles, reason)
+                return None
+            self._seq += 1
+            seq = self._seq
+            steps = list(self._steps)
+        slug = re.sub(r"[^a-zA-Z0-9_.-]+", "_", reason)[:40]
+        path = os.path.join(self.out_dir, "postmortem", f"{seq:03d}-{slug}")
+        try:
+            os.makedirs(path, exist_ok=True)
+            from polyrl_tpu.obs import get_tracer
+
+            tracer = get_tracer()
+            with open(os.path.join(path, "spans.jsonl"), "w") as f:
+                for rec in tracer.records():
+                    f.write(json.dumps(rec) + "\n")
+            with open(os.path.join(path, "steps.jsonl"), "w") as f:
+                for rec in steps:
+                    f.write(json.dumps(rec) + "\n")
+            with open(os.path.join(path, "stacks.txt"), "w") as f:
+                faulthandler.dump_traceback(file=f, all_threads=True)
+            counters = {}
+            if self.counters_fn is not None:
+                try:
+                    counters = dict(self.counters_fn())
+                except Exception:  # noqa: BLE001 — counters are best-effort
+                    log.exception("flight recorder counters_fn failed")
+            with open(os.path.join(path, "counters.json"), "w") as f:
+                json.dump({
+                    "reason": reason,
+                    "detail": detail,
+                    "step": step,
+                    "time_unix_s": time.time(),
+                    "anomalies": self.anomalies,
+                    "tracer_dropped_spans": tracer.dropped,
+                    "fault_counters": counters,
+                    "detectors": {k: d.state()
+                                  for k, d in self._detectors.items()},
+                }, f, indent=2)
+        except Exception:  # noqa: BLE001 — a post-mortem writer that
+            # crashes the run it is documenting is worse than no bundle
+            log.exception("flight recorder bundle write failed (%s)", path)
+            return None
+        self.bundle_paths.append(path)
+        log.warning("flight recorder: %s bundle -> %s (%s)",
+                    reason, path, detail or "no detail")
+        return path
+
+    # -- signal wiring (main-thread only; train.py entry) --------------------
+
+    def install_signal_handlers(self) -> None:
+        """Dump a bundle on SIGTERM, then re-deliver the default action so
+        the process still dies with the expected signal semantics. Call
+        from the MAIN thread only (signal module constraint)."""
+
+        def _on_term(signum, frame):  # noqa: ARG001
+            self.dump("sigterm", detail=f"signal {signum}")
+            signal.signal(signum, signal.SIG_DFL)
+            os.kill(os.getpid(), signum)
+
+        signal.signal(signal.SIGTERM, _on_term)

@@ -46,6 +46,10 @@ class FaultInjectionConfig:
     corrupt_limit: int = 1         # total corrupted lines budget
     stall_s: float = 0.0           # stall each stream once, this long,
     stall_after_tokens: int = 1    #   after N tokens
+    stall_after_requests: int = 0  # arm stalls only after N admissions
+    #   (lets a run establish a healthy baseline first — the flight
+    #   recorder's anomaly drill stalls step K, not step 1)
+    stall_limit: int = -1          # total stall budget (-1 = unlimited)
     drain_after_requests: int = 0  # POST /drain semantics after N admissions
     # -- trainer/client-side trigger (RemoteRollout.fault_injector) --------
     stream_kill_times: int = 0       # how many manager streams to kill
@@ -122,7 +126,10 @@ class FaultInjector:
             count = self._tokens.get(key, 0) + n_tok
             self._tokens[key] = count
             do_stall = (self.cfg.stall_s > 0 and key not in self._stalled
-                        and count >= self.cfg.stall_after_tokens)
+                        and count >= self.cfg.stall_after_tokens
+                        and self._admitted >= self.cfg.stall_after_requests
+                        and (self.cfg.stall_limit < 0
+                             or self.stalls < self.cfg.stall_limit))
             if do_stall:
                 self._stalled.add(key)
                 self.stalls += 1

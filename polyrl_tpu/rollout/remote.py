@@ -126,6 +126,10 @@ class RemoteRollout:
         self.tokens_salvaged = 0
         self.suffix_resumes = 0
         self.resume_prefill_tokens = 0
+        # per-step manager /metrics scrape misses (telemetry degradation is
+        # graceful: the merge is skipped, the step never fails — this
+        # counter is the only trace a flaky scrape leaves)
+        self.scrape_failures = 0
         # per-stream nonce keeps rids globally unique: concurrent streams
         # (nested REMAX baselines, validation overlapping training, and the
         # pipelined trainer's prefetch lane) would otherwise collide on
@@ -149,6 +153,7 @@ class RemoteRollout:
             "fault/tokens_salvaged": float(self.tokens_salvaged),
             "fault/suffix_resumes": float(self.suffix_resumes),
             "fault/resume_prefill_tokens": float(self.resume_prefill_tokens),
+            "obs/scrape_failed": float(self.scrape_failures),
         }
         retries = getattr(self.manager, "retry_count", None)
         if retries is not None:
@@ -395,7 +400,16 @@ class RemoteRollout:
                     "manager stream failed with %d/%d rids pending (%s); "
                     "attempting resume (%d left in budget)",
                     len(pending), len(reqs), failure, budget)
-                if budget > 0 and self._wait_manager_recovery():
+                recovered = False
+                if budget > 0:
+                    # recovery wait is attributable stall time: the goodput
+                    # ledger maps the rollout/resume_wait_s totals into the
+                    # salvage_resume phase
+                    t_rw = time.monotonic()
+                    recovered = self._wait_manager_recovery()
+                    obs.observe("rollout/resume_wait_s",
+                                time.monotonic() - t_rw)
+                if recovered:
                     budget -= 1
                     self.stream_resumes += 1
                     continue
@@ -553,7 +567,12 @@ class RemoteRollout:
         try:
             return obs.manager_gauges(metrics_text())
         except Exception:  # noqa: BLE001 — telemetry must not fail a step
-            log.warning("manager /metrics scrape failed", exc_info=True)
+            # skip the merge, count the miss (obs/scrape_failed gauge via
+            # fault_counters) — a respawning/flaky manager degrades the
+            # step record, never the step or the pipeline lane
+            self.scrape_failures += 1
+            log.warning("manager /metrics scrape failed (%d total)",
+                        self.scrape_failures, exc_info=True)
             return {}
 
     def update_metrics(self, **stats) -> dict:

@@ -135,6 +135,11 @@ class RolloutServer:
                         self._json(200, {"status": "ok"})
                 elif self.path == "/get_server_info":
                     self._json(200, outer.server_info())
+                elif self.path == "/statusz":
+                    # live health plane: the SAME JSON schema the trainer's
+                    # exporter serves (obs/statusz.py), so one parser
+                    # sweeps both planes
+                    self._json(200, outer.statusz_snapshot())
                 elif self.path == "/metrics":
                     # Prometheus text exposition of the same telemetry the
                     # manager polls via /get_server_info (plus the engine's
@@ -472,6 +477,34 @@ class RolloutServer:
             info["spec_emitted"] = self.engine.spec_emitted
             info["spec_dispatches"] = self.engine.spec_dispatches
         return info
+
+    def statusz_snapshot(self) -> dict:
+        """The rollout plane's side of the shared /statusz schema
+        (ARCHITECTURE.md "Goodput & health plane"): engine queue depths,
+        decode throughput, weight version, salvage/drain/fault-injection
+        counters — one curl answers "what is this engine doing"."""
+        from polyrl_tpu.obs import statusz
+
+        info = self.server_info()
+        counters = {k: float(v) for k, v in info.items()
+                    if k in ("tokens_salvaged", "salvage_published_pages",
+                             "drained_requests", "spec_emitted",
+                             "spec_dispatches")}
+        counters["total_tokens_served"] = float(
+            getattr(self.engine, "total_tokens_served", 0))
+        if self.fault is not None:
+            counters.update(self.fault.counters())
+        gauges = {k: float(v) for k, v in info.items()
+                  if isinstance(v, (int, float))
+                  and not isinstance(v, bool) and k not in counters}
+        gauges["draining"] = float(self._draining.is_set())
+        gauges["paused"] = float(self._paused.is_set())
+        return statusz.build_snapshot(
+            "rollout",
+            counters=counters, gauges=gauges,
+            queues={"running": float(info.get("num_running_reqs", 0)),
+                    "queued": float(info.get("num_queued_reqs", 0))},
+            weights={"version": float(self.engine.weight_version)})
 
     def metrics_text(self) -> str:
         """Prometheus text format for /metrics: server_info fields as

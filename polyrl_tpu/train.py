@@ -405,6 +405,22 @@ def build_trainer(cfg: RunConfig, cleanup: list | None = None):
     logger = Tracking(backends=tuple(cfg.logging.backends),
                       path=cfg.logging.path or None)
 
+    recorder = None
+    if cfg.obs.recorder and multihost.is_main():
+        # anomaly flight recorder (obs/recorder.py): watches the step
+        # stream; an anomaly/crash/SIGTERM dumps a post-mortem bundle
+        # (trace ring + step records + thread stacks) into the run dir
+        from polyrl_tpu.obs.recorder import FlightRecorder
+
+        rec_dir = (cfg.obs.recorder_dir
+                   or (os.path.dirname(os.path.abspath(cfg.logging.path))
+                       if cfg.logging.path else "polyrl_postmortem"))
+        recorder = FlightRecorder(
+            rec_dir, keep_steps=cfg.obs.recorder_keep_steps,
+            z_threshold=cfg.obs.recorder_z, warmup=cfg.obs.recorder_warmup,
+            max_bundles=cfg.obs.recorder_max_bundles)
+        log.info("flight recorder armed: bundles -> %s/postmortem", rec_dir)
+
     if cfg.trainer.pipeline_depth > 0:
         # pipelined rollout (ARCHITECTURE.md "Pipeline overlap"): announce
         # the mode + staleness handling up front, since the step records
@@ -416,10 +432,19 @@ def build_trainer(cfg: RunConfig, cleanup: list | None = None):
             cfg.trainer.rollout_is_cap)
 
     val_dataset = build_dataset(cfg, "val")
-    return StreamRLTrainer(
+    trainer = StreamRLTrainer(
         cfg.trainer, actor, rollout, tokenizer, reward_manager, loader,
         critic=critic, ref_policy=ref_policy, logger=logger,
-        val_dataset=val_dataset)
+        val_dataset=val_dataset, recorder=recorder)
+    if cfg.obs.statusz and multihost.is_main():
+        # live health plane: GET /statusz answers "what is this trainer
+        # doing right now" (shared schema with the rollout server's route)
+        srv = trainer.start_statusz(port=cfg.obs.statusz_port,
+                                    host=cfg.obs.statusz_host)
+        cleanup.append(trainer.stop_statusz)
+        log.info("trainer /statusz serving at http://%s/statusz",
+                 srv.endpoint)
+    return trainer
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -451,6 +476,10 @@ def main(argv: list[str] | None = None) -> int:
     cleanup: list = []
     try:
         trainer = build_trainer(cfg, cleanup)
+        if trainer._recorder is not None:
+            # SIGTERM (driver timeout, preemption) dumps a post-mortem
+            # bundle before the process dies — main-thread entry only
+            trainer._recorder.install_signal_handlers()
         history = trainer.fit()
         if history:
             last = history[-1]

@@ -175,6 +175,7 @@ class StreamRLTrainer:
         ref_policy: ReferencePolicy | None = None,
         logger=None,
         val_dataset=None,
+        recorder=None,
     ):
         self.cfg = cfg
         self.actor = actor
@@ -218,6 +219,17 @@ class StreamRLTrainer:
         self._esi_expiry = ckpt_lib.esi_expiry_from_env()
         self._flops = FlopsCounter(actor.model_cfg, n_chips=jax.device_count())
         self._tracing = False
+        # goodput accounting (obs/goodput.py): every step's wall time is
+        # decomposed into non-overlapping phases; /statusz reads the
+        # cumulative side
+        self._goodput = obs.GoodputLedger(flops=self._flops)
+        self._last_record: dict = {}
+        self._statusz = None
+        # anomaly flight recorder (obs/recorder.py): fed each finished
+        # step record; dumps post-mortem bundles on anomaly/crash
+        self._recorder = recorder
+        if recorder is not None and isinstance(rollout, RemoteRollout):
+            recorder.counters_fn = rollout.fault_counters
 
     # -- profiling (reference _start/_stop_profiling with continuous-step
     # logic, stream_ray_trainer.py:356-361,629-641) ----------------------
@@ -957,6 +969,50 @@ class StreamRLTrainer:
                                 self.critic.flush_opt_step().items()})
         return state
 
+    # -- live health plane (/statusz; obs/statusz.py) ---------------------
+
+    def start_statusz(self, port: int = 0, host: str = "127.0.0.1"):
+        """Mount the shared-schema ``/statusz`` exporter for this trainer
+        process; returns the server (``.endpoint`` answers curl)."""
+        from polyrl_tpu.obs.statusz import StatuszServer
+
+        self._statusz = StatuszServer(self.statusz_snapshot,
+                                      host=host, port=port).start()
+        return self._statusz
+
+    def stop_statusz(self) -> None:
+        if self._statusz is not None:
+            self._statusz.stop()
+            self._statusz = None
+
+    def statusz_snapshot(self) -> dict:
+        """The trainer's side of the shared /statusz schema: current step,
+        cumulative goodput phase breakdown, last-step histogram quantiles,
+        fault/anomaly counters, weight staleness, pipeline queue depth."""
+        from polyrl_tpu.obs import statusz
+
+        rec = self._last_record
+        counters: dict[str, float] = {}
+        if isinstance(self.rollout, RemoteRollout):
+            counters.update(self.rollout.fault_counters())
+        if self._recorder is not None:
+            counters.update(self._recorder.counters())
+        gauges = {k: float(v) for k, v in rec.items()
+                  if k.startswith(("perf/", "training/", "manager/"))}
+        return statusz.build_snapshot(
+            "trainer", step=self.global_step,
+            goodput=self._goodput.snapshot(),
+            histograms=statusz.nest_histograms(rec),
+            counters=counters, gauges=gauges,
+            queues={"pipeline_depth": float(self.cfg.pipeline_depth),
+                    "pipeline_queue": float(rec.get(
+                        "perf/pipeline_queue_depth", 0.0))},
+            weights={"push_count": float(self._push_count),
+                     "version": float(getattr(self.rollout,
+                                              "weight_version", 0)),
+                     "staleness": float(rec.get(
+                         "perf/weight_staleness", 0.0))})
+
     # -- fit --------------------------------------------------------------
 
     def fit(self) -> list[dict]:
@@ -1092,15 +1148,47 @@ class StreamRLTrainer:
                 # distribution roll-up: drain the process-global histogram
                 # registry (rollout latency / decode rate, transfer push,
                 # manager RTT — observed by components with no tracker
-                # handle) into this step's record as p50/p95/p99/max
-                metrics.merge_histograms(obs.drain_histograms())
+                # handle) into this step's record as p50/p95/p99/max.
+                # Drained BEFORE goodput accounting so the ledger can
+                # attribute the resume-wait / manager-RTT totals.
+                hists = obs.drain_histograms()
+                # goodput attribution (obs/goodput.py): the FULL step wall
+                # (incl. validation + checkpoint IO, which perf/step_time_s
+                # predates) decomposed into non-overlapping goodput/* phases
+                metrics.update(self._goodput.account(
+                    step_time_s=time.monotonic() - step_t0,
+                    timings=metrics.timings(),
+                    bubble_s=state["bubble"],
+                    overlap_s=metrics.get("perf/pipeline_overlap_s"),
+                    histograms=hists,
+                    n_tokens=state["n_tokens"],
+                    mean_context_len=state["n_tokens"] / n_traj,
+                    n_chips=jax.device_count()))
+                metrics.merge_histograms(hists)
                 if self.logger is not None:
                     metrics.update_gauge({"obs/log_errors": float(
                         getattr(self.logger, "log_errors", 0))})
+                if self._recorder is not None:
+                    # one step of lag by design: the gauges describe the
+                    # steps already watched when this record was built
+                    metrics.update_gauge(self._recorder.counters())
                 record = metrics.as_dict()
                 history.append(record)
+                self._last_record = record
+                if self._recorder is not None:
+                    # anomaly watch over the live step stream; a spike in
+                    # step time (or a throughput collapse) dumps a
+                    # post-mortem bundle into the run dir
+                    self._recorder.record_step(self.global_step, record)
                 if self.logger is not None and self._is_main:
                     self.logger.log(record, step=self.global_step)
+        except BaseException as exc:
+            if self._recorder is not None:
+                # crash post-mortem: the bundle carries the trace ring and
+                # every thread's stack at the moment of death
+                self._recorder.dump(f"crash-{type(exc).__name__}",
+                                    detail=repr(exc), step=self.global_step)
+            raise
         finally:
             if pipeline is not None:
                 pipeline.close()

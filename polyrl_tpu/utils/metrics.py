@@ -80,6 +80,23 @@ class MetricsTracker:
             hist = self._hists[name] = Histogram()
         hist.observe(value)
 
+    def timings(self) -> dict[str, float]:
+        """Snapshot of the phase timings accumulated so far (seconds per
+        marked_timer/add_timing key) — the goodput ledger's feed."""
+        return dict(self._timings)
+
+    def get(self, key: str, default: float = 0.0) -> float:
+        """Current value of one metric by key, across kinds (averaged mean,
+        then gauge, then raw counter). For step-end consumers (the goodput
+        ledger) that need one already-recorded value without as_dict()."""
+        if key in self._sums:
+            return self._sums[key] / self._counts[key]
+        if key in self._gauges:
+            return self._gauges[key]
+        if key in self._counters:
+            return self._counters[key]
+        return default
+
     def merge(self, other: "MetricsTracker") -> None:
         """Fold another tracker in, kind-by-kind (averaged keys keep their
         sample counts so the merged mean is the pooled mean). Used to land a

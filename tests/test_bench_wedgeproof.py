@@ -94,6 +94,48 @@ def test_relay_down_budget_fails_fast(tmp_path):
 
 
 @pytest.mark.quick
+def test_relay_down_budget_env_clamped_to_cap(tmp_path):
+    """r05 post-mortem: an oversized env-provided down-budget (6000 s) rode
+    straight into the harness's ~1800 s SIGTERM. The cap must clamp ANY
+    env/CLI value, so the fail-fast still lands an intact JSON record."""
+    env = dict(os.environ)
+    env.update({
+        "PALLAS_AXON_POOL_IPS": "",
+        "POLYRL_BENCH_RELAY_REQUIRED": "1",
+        "POLYRL_BENCH_RELAY_PORT": "1",       # nothing listens on :1
+        "POLYRL_BENCH_BUDGET": "120",
+        "POLYRL_BENCH_RELAY_POLL": "1",
+        "POLYRL_BENCH_RELAY_DOWN_BUDGET": "6000",  # the r05 failure mode
+        "POLYRL_BENCH_RELAY_DOWN_CAP": "2",        # cap wins
+        "POLYRL_BENCH_STATE": str(tmp_path / "state.json"),
+    })
+    t0 = time.monotonic()
+    proc = subprocess.run(
+        [sys.executable, BENCH], capture_output=True, text=True,
+        timeout=60, env=env, cwd=REPO)
+    wall = time.monotonic() - t0
+    assert proc.returncode == 0
+    assert wall < 30, f"clamped budget should fail fast, took {wall:.0f}s"
+    lines = [ln for ln in proc.stdout.splitlines() if ln.strip()]
+    assert len(lines) == 1
+    result = json.loads(lines[0])              # failed-but-VALID JSON
+    assert result["metric"] == "bench_failed"
+    assert "failing fast" in result["extra"]["bench_incomplete"]
+    assert "budget 2s" in proc.stderr or "budget 2s" in str(result)
+
+
+@pytest.mark.quick
+def test_relay_down_budget_default_well_below_harness(tmp_path, monkeypatch):
+    """The defaults themselves must sit well under the observed ~1800 s
+    harness kill window — the clamp is belt, this is suspenders."""
+    monkeypatch.delenv("POLYRL_BENCH_RELAY_DOWN_BUDGET", raising=False)
+    monkeypatch.delenv("POLYRL_BENCH_RELAY_DOWN_CAP", raising=False)
+    bench = _load_bench(monkeypatch, tmp_path)
+    assert bench.RELAY_DOWN_BUDGET_S <= 300
+    assert bench.RELAY_DOWN_BUDGET_CAP_S <= 900
+
+
+@pytest.mark.quick
 def test_refund_unfinished_attempts(tmp_path, monkeypatch):
     """Attempts for phases WITHOUT results are refunded (tunnel death is a
     relay failure, not a phase failure); finished phases keep theirs —

@@ -1,0 +1,214 @@
+#!/usr/bin/env python3
+"""Bench regression gate: diff the newest ``BENCH_*.json`` against the
+round trajectory and FAIL on regressions (ARCHITECTURE.md "Goodput &
+health plane").
+
+BENCH_r01–r05 drifted into rc=124 deaths with nobody noticing between
+rounds — the trajectory was recorded but never read. This gate reads it:
+
+- **rc**: the newest round must have exited 0 (a rc=124/SIGTERM round is
+  a regression even when a partial JSON landed);
+- **headline**: ``parsed.value`` must not drop more than ``--threshold``
+  (default 15%) below the median of the prior successful rounds;
+- **goodput/phase fields**: watched ``extra`` paths (serving tok/s, MFU,
+  weight-sync seconds, TTFT tails, ...) are diffed the same way, in the
+  direction that matters per key.
+
+Input formats: the driver wrapper ``{"n", "rc", "tail", "parsed": {...}}``
+or a bare bench line ``{"metric", "value", ...}`` (rc assumed 0). Rounds
+sort by their ``n`` field, falling back to filename order.
+
+Run standalone::
+
+    python tools/bench_gate.py               # gates ./BENCH_*.json
+    python tools/bench_gate.py --dir /runs --threshold 0.10 --json
+
+or as a bench post-step: ``POLYRL_BENCH_GATE=1 python bench.py`` runs the
+gate after the bench line is emitted (report to stderr; never changes the
+bench's own exit code). Exit status: 0 = ok (or not enough history),
+1 = regression, 2 = usage/input error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+DEFAULT_THRESHOLD = 0.15
+
+# watched extra.* paths: (dotted path, higher_is_better). Missing paths
+# are skipped — rounds measure what their phases reached.
+WATCHED_EXTRA = (
+    ("cb.serve_tok_s", True),
+    ("cb.direct_tok_s", True),
+    ("cb.serve_peak_tok_s", True),
+    ("cb.util.mfu_pct", True),
+    ("cb.ttft_p95_ms", False),
+    ("cb.req_p95_s", False),
+    ("llama3_8b.tok_s", True),
+    ("llama3_8b.util.mfu_pct", True),
+    ("bucketed.tok_s", True),
+    ("bucketed.util.mfu_pct", True),
+    ("weight_sync.eff_mb_s", True),
+    ("weight_sync.total_s", False),
+    ("spec.speedup_continuation", True),
+)
+
+
+def _dig(obj, dotted: str):
+    for part in dotted.split("."):
+        if not isinstance(obj, dict) or part not in obj:
+            return None
+        obj = obj[part]
+    return obj if isinstance(obj, (int, float)) \
+        and not isinstance(obj, bool) else None
+
+
+def load_round(path: str) -> dict | None:
+    """One BENCH file → ``{"n", "rc", "value", "metric", "extra", "path"}``
+    (None when unparseable — the gate reports it, not a traceback)."""
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if not isinstance(data, dict):
+        return None
+    parsed = data.get("parsed") if isinstance(data.get("parsed"), dict) \
+        else data if "metric" in data else {}
+    n = data.get("n")
+    if n is None:
+        m = re.search(r"(\d+)", os.path.basename(path))
+        n = int(m.group(1)) if m else 0
+    return {
+        "path": path,
+        "n": int(n),
+        "rc": int(data.get("rc", 0)),
+        "metric": str(parsed.get("metric", "")),
+        "value": float(parsed.get("value") or 0.0),
+        "extra": parsed.get("extra") or {},
+    }
+
+
+def _median(vals: list[float]) -> float:
+    srt = sorted(vals)
+    mid = len(srt) // 2
+    return srt[mid] if len(srt) % 2 else 0.5 * (srt[mid - 1] + srt[mid])
+
+
+def gate(rounds: list[dict], threshold: float = DEFAULT_THRESHOLD) -> dict:
+    """Diff the newest round against the prior trajectory. Baselines are
+    per-field MEDIANS over the prior successful rounds (robust to one
+    lucky/unlucky round)."""
+    rounds = sorted(rounds, key=lambda r: r["n"])
+    newest = rounds[-1]
+    prior = [r for r in rounds[:-1] if r["rc"] == 0 and r["value"] > 0]
+    failures: list[str] = []
+    checks: list[dict] = []
+
+    if newest["rc"] != 0:
+        failures.append(
+            f"newest round (n={newest['n']}) exited rc={newest['rc']} — "
+            f"the run died before finishing (metric {newest['metric'] or 'none'!r})")
+    if not prior:
+        return {"ok": not failures, "failures": failures, "checks": checks,
+                "newest_n": newest["n"], "history": 0,
+                "note": "no successful prior rounds to gate against"}
+
+    def check(name: str, new, base, higher_better: bool) -> None:
+        if new is None or base is None or base == 0:
+            return
+        ratio = new / base
+        bad = ratio < 1.0 - threshold if higher_better \
+            else ratio > 1.0 + threshold
+        checks.append({"field": name, "new": new, "baseline": round(base, 4),
+                       "ratio": round(ratio, 4), "ok": not bad})
+        if bad:
+            direction = "dropped" if higher_better else "rose"
+            failures.append(
+                f"{name} {direction} beyond {threshold:.0%}: "
+                f"{new:.4g} vs baseline {base:.4g} "
+                f"(ratio {ratio:.3f})")
+
+    if newest["rc"] == 0:
+        base = _median([r["value"] for r in prior])
+        if newest["value"] <= 0:
+            # rc=0 with no headline number (BENCH_r03's failure mode):
+            # the run "succeeded" but measured nothing — a regression
+            failures.append(
+                f"newest round (n={newest['n']}) recorded no headline "
+                f"value (baseline {base:.4g})")
+        else:
+            check("value", newest["value"], base, True)
+    for path, higher in WATCHED_EXTRA:
+        base_vals = [v for v in (_dig(r["extra"], path) for r in prior)
+                     if v is not None]
+        if not base_vals:
+            continue
+        check(f"extra.{path}", _dig(newest["extra"], path),
+              _median(base_vals), higher)
+
+    return {"ok": not failures, "failures": failures, "checks": checks,
+            "newest_n": newest["n"], "history": len(prior)}
+
+
+def find_rounds(dirpath: str) -> list[str]:
+    return sorted(glob.glob(os.path.join(dirpath, "BENCH_*.json")))
+
+
+def run(paths: list[str], threshold: float) -> tuple[int, dict]:
+    rounds = []
+    broken = []
+    for p in paths:
+        r = load_round(p)
+        (rounds if r is not None else broken).append(r if r is not None else p)
+    if not rounds:
+        return 2, {"ok": False,
+                   "failures": [f"no parseable BENCH rounds in {paths!r}"],
+                   "checks": [], "history": 0}
+    report = gate(rounds, threshold=threshold)
+    if broken:
+        report["unparseable"] = broken
+    return (0 if report["ok"] else 1), report
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Fail when the newest BENCH round regresses vs the "
+                    "trajectory")
+    ap.add_argument("files", nargs="*",
+                    help="BENCH json files (default: --dir/BENCH_*.json)")
+    ap.add_argument("--dir", default=".", help="directory to glob")
+    ap.add_argument("--threshold", type=float, default=DEFAULT_THRESHOLD,
+                    help="allowed relative regression (default 0.15)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the report as one JSON line")
+    args = ap.parse_args(argv)
+    paths = args.files or find_rounds(args.dir)
+    if len(paths) < 1:
+        print("bench_gate: no BENCH_*.json rounds found", file=sys.stderr)
+        return 2
+    code, report = run(paths, args.threshold)
+    if args.json:
+        print(json.dumps(report))
+    else:
+        for c in report["checks"]:
+            mark = "ok  " if c["ok"] else "FAIL"
+            print(f"[{mark}] {c['field']}: {c['new']:.4g} vs "
+                  f"{c['baseline']:.4g} (x{c['ratio']:.3f})")
+        for fmsg in report["failures"]:
+            print(f"REGRESSION: {fmsg}")
+        if report.get("note"):
+            print(report["note"])
+        print(f"bench_gate: {'OK' if report['ok'] else 'FAILED'} "
+              f"(newest n={report.get('newest_n')}, "
+              f"history {report['history']})")
+    return code
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -22,12 +22,17 @@ Static coverage (AST, literals only — dynamic keys can't be checked):
 Covered key families include the pipelined trainer's ``perf/pipeline_*``
 (``perf/pipeline_overlap_s``, ``perf/pipeline_queue_depth``) and
 ``perf/weight_staleness`` gauges plus the ``actor/tis_*`` correction
-metrics (trainer/pipeline.py, stream_trainer.py), and the token-level
+metrics (trainer/pipeline.py, stream_trainer.py), the token-level
 salvage counters — ``fault/tokens_salvaged``, ``fault/suffix_resumes``,
 ``fault/resume_prefill_tokens`` (rollout/remote.py ``fault_counters``)
 and the injector's ``fault/injected_*`` (rollout/faults.py ``counters``)
-— new metric emitters in ``polyrl_tpu/`` are linted automatically;
-nothing needs registering.
+— and the goodput/health plane's ``goodput/*`` phase attribution plus the
+``obs/*`` self-telemetry (``obs/scrape_failed``, ``obs/anomalies``,
+``obs/bundles``, ``obs/log_errors``). New metric emitters in
+``polyrl_tpu/`` are linted automatically; nothing needs registering —
+EXCEPT a new top-level namespace, which must be added to ``NAMESPACES``
+below and documented in ARCHITECTURE.md in the same change (an
+emitted-but-undocumented namespace fails the lint).
 
 Run: ``python tools/check_metric_names.py [root ...]`` — exits 1 and lists
 violations. Wired into the quick test tier (tests/test_obs_tracing.py).
@@ -44,6 +49,26 @@ KEY_RE = re.compile(r"^[a-z0-9_]+(/[a-z0-9_.]+)+$")
 # a literal f-string head like "timing_s/" must be a valid key prefix
 PREFIX_RE = re.compile(r"^[a-z0-9_]+(/[a-z0-9_.]*)*$")
 
+# Documented metric namespaces — the leading ``/``-segment of every
+# literal key (ARCHITECTURE.md "Observability" table). Adding a namespace
+# here without documenting it there defeats the point of the lint.
+NAMESPACES = frozenset({
+    "actor",         # policy losses / entropy / TIS correction
+    "critic",        # value losses / KL
+    "reward",        # reward manager scores + REMAX baselines
+    "val",           # validation scores
+    "perf",          # step wall / throughput / MFU / pipeline gauges
+    "goodput",       # per-step wall-time phase attribution (obs/goodput.py)
+    "training",      # step counter / balancer budget
+    "fault",         # control-plane + salvage fault counters
+    "manager",       # scraped manager gauges + client RTT
+    "rollout",       # rollout-plane latency/throughput distributions
+    "transfer",      # weight-fabric pack/push timings
+    "prefix_cache",  # engine prefix-cache hit telemetry
+    "timing_s",      # marked_timer phase timings
+    "obs",           # observability self-telemetry (scrape/log/anomaly)
+})
+
 # APIs whose first positional string argument IS a metric key
 _FULL_KEY_APIS = {"observe", "incr"}
 # APIs whose first argument is emitted under the timing_s/ prefix
@@ -56,6 +81,13 @@ def _check_key(key: str, where: str, violations: list[str]) -> None:
     if not KEY_RE.match(key):
         violations.append(f"{where}: metric key {key!r} does not match "
                           f"{KEY_RE.pattern}")
+        return
+    ns = key.split("/", 1)[0]
+    if ns not in NAMESPACES:
+        violations.append(
+            f"{where}: metric key {key!r} uses undocumented namespace "
+            f"{ns!r} — add it to NAMESPACES (tools/check_metric_names.py) "
+            f"AND the ARCHITECTURE.md Observability table")
 
 
 def _check_fstring_head(node: ast.JoinedStr, where: str,
@@ -63,9 +95,17 @@ def _check_fstring_head(node: ast.JoinedStr, where: str,
     if not node.values or not isinstance(node.values[0], ast.Constant):
         return  # no literal head to check
     head = node.values[0].value
-    if isinstance(head, str) and head and not PREFIX_RE.match(head):
+    if not isinstance(head, str) or not head:
+        return
+    if not PREFIX_RE.match(head):
         violations.append(f"{where}: metric key prefix {head!r} does not "
                           f"match {PREFIX_RE.pattern}")
+        return
+    if "/" in head and head.split("/", 1)[0] not in NAMESPACES:
+        violations.append(
+            f"{where}: metric key prefix {head!r} uses undocumented "
+            f"namespace {head.split('/', 1)[0]!r} — add it to NAMESPACES "
+            f"AND the ARCHITECTURE.md Observability table")
 
 
 def _dict_slash_keys(node: ast.Dict):
