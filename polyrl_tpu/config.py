@@ -19,6 +19,7 @@ from dataclasses import dataclass, field
 from typing import Any
 
 from polyrl_tpu.rollout.faults import FaultInjectionConfig
+from polyrl_tpu.rollout.pool import PoolConfig
 from polyrl_tpu.trainer.actor import ActorConfig
 from polyrl_tpu.trainer.critic import CriticConfig
 from polyrl_tpu.trainer.stream_trainer import TrainerConfig
@@ -113,6 +114,11 @@ class RolloutSection:
     # KV HBM back to training (reference sglang_http_async_engine.py:102-113
     # + handlers.rs:500-513)
     colocated_local: bool = False
+    # elastic pool (rollout/pool.py; ARCHITECTURE.md "Elastic pool"):
+    # fleet membership lifecycle on top of the manager — scale-up join
+    # gating, preemption drills, membership sweeps for /statusz, and the
+    # progressive train<->rollout balance estimator window
+    pool: PoolConfig = field(default_factory=PoolConfig)
 
 
 @dataclass

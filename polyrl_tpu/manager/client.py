@@ -261,6 +261,19 @@ class ManagerClient:
             self.supervisor.record_local_instances(endpoints)
         return out
 
+    def deregister_rollout_instance(self, endpoint: str,
+                                    drained: bool = False) -> dict:
+        """Graceful leave (scale-down drill): remove one engine from the
+        pool. ``drained=True`` books it as a drain departure (the engine
+        flushed its partials first) rather than an eviction. Idempotent —
+        deregistering an already-forgotten endpoint is a no-op."""
+        out = self._call("POST", "/deregister_rollout_instance",
+                         {"endpoint": endpoint, "drained": drained},
+                         idempotent=True)
+        if self.supervisor is not None:
+            self.supervisor.forget_instance(endpoint)
+        return out
+
     def generate(self, rid: str, input_ids: list[int], sampling_params: dict) -> GenerateResult:
         out = self._call("POST", "/generate", {
             "rid": rid, "input_ids": input_ids, "sampling_params": sampling_params})
@@ -327,16 +340,22 @@ class ManagerClient:
 
     def reconcile(self, remote_endpoints: list[str], local_endpoints: list[str],
                   senders: list[str], groups_per_sender: int,
-                  weight_version: int) -> dict:
+                  weight_version: int,
+                  instance_versions: dict[str, int] | None = None) -> dict:
         """Idempotent bulk re-registration (supervisor replay after a
         manager respawn): already-known endpoints are kept as-is and the
-        weight version is only ever raised, never reset."""
+        weight version is only ever raised, never reset.
+        ``instance_versions`` replays pool membership's per-engine
+        last-known weight versions so a respawned manager re-admits a
+        healthy, caught-up fleet instead of orphaning it behind a
+        redundant weight bootstrap."""
         return self._call("POST", "/reconcile", {
             "remote_endpoints": remote_endpoints,
             "local_endpoints": local_endpoints,
             "senders": senders,
             "groups_per_sender": groups_per_sender,
             "weight_version": weight_version,
+            "instance_versions": dict(instance_versions or {}),
         }, idempotent=True)
 
     # -- streaming batch (the C7 StreamingBatchIterator role) -------------

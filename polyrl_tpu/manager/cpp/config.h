@@ -78,6 +78,10 @@ struct Config {
   double stats_poll_interval_s = 1.0;
   double health_check_interval_s = 2.0;
   double health_check_deadline_s = 300.0;
+  // elastic pool: consecutive stats-poll misses before a REMOTE instance
+  // is evicted (heartbeat-timeout death detection; locals are exempt —
+  // they fail by time-slice abort, not by dying). 0 disables eviction.
+  int heartbeat_failures = 3;
   int max_generate_attempts = 5;
   int generate_timeout_ms = 600000;
   int schedule_wait_timeout_ms = 120000;  // block on instance availability
@@ -148,6 +152,7 @@ inline Config load_config(int argc, char** argv) {
     if (auto* v = get("stats_poll_interval_s")) cfg.stats_poll_interval_s = std::stod(*v);
     if (auto* v = get("health_check_interval_s")) cfg.health_check_interval_s = std::stod(*v);
     if (auto* v = get("health_check_deadline_s")) cfg.health_check_deadline_s = std::stod(*v);
+    if (auto* v = get("heartbeat_failures")) cfg.heartbeat_failures = std::stoi(*v);
     if (auto* v = get("max_generate_attempts")) cfg.max_generate_attempts = std::stoi(*v);
     if (auto* v = get("generate_timeout_ms")) cfg.generate_timeout_ms = std::stoi(*v);
     if (auto* v = get("schedule_wait_timeout_ms")) cfg.schedule_wait_timeout_ms = std::stoi(*v);
@@ -167,6 +172,7 @@ inline Config load_config(int argc, char** argv) {
     else if (a == "--stats-poll-interval-s") cfg.stats_poll_interval_s = std::stod(v);
     else if (a == "--health-check-interval-s") cfg.health_check_interval_s = std::stod(v);
     else if (a == "--health-check-deadline-s") cfg.health_check_deadline_s = std::stod(v);
+    else if (a == "--heartbeat-failures") cfg.heartbeat_failures = std::stoi(v);
     else if (a == "--max-generate-attempts") cfg.max_generate_attempts = std::stoi(v);
     else if (a == "--generate-timeout-ms") cfg.generate_timeout_ms = std::stoi(v);
     else if (a == "--schedule-wait-timeout-ms") cfg.schedule_wait_timeout_ms = std::stoi(v);

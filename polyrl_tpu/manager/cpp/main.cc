@@ -139,7 +139,7 @@ class Manager {
       // to max_generate_attempts healthy engines.
       if (!inst->is_local && !request_error) {
         log_line("evicting instance " + inst->endpoint + " after stream failure");
-        state_.deregister(inst->endpoint);
+        state_.evict(inst->endpoint);
         std::string ep = inst->endpoint;
         std::thread([ep] { phttp::request("POST", ep, "/shutdown", "{}", 2000); }).detach();
       }
@@ -321,18 +321,48 @@ class Manager {
 
   // ---- background workers ---------------------------------------------
 
+  // Stats poll doubles as the pool HEARTBEAT: every registered healthy
+  // instance (not just the active routing set — drained/updating engines
+  // still need death detection) is probed each tick. A poll answer resets
+  // the miss counter and feeds the scheduler's load/version view; it also
+  // carries the engine's own "draining" announcement (preemption notice →
+  // out of the routing set before the next batch routes to it). A REMOTE
+  // instance missing cfg.heartbeat_failures consecutive polls is EVICTED —
+  // an engine that died WITHOUT notice; its in-flight rids fail their
+  // streams and continue on survivors through the salvage path.
   void start_stats_poller() {
     stats_thread_ = std::thread([this] {
       while (!state_.is_shutdown()) {
-        for (auto& inst : state_.active_instances()) {
+        for (auto& inst : state_.all_instances()) {
+          if (!inst->healthy.load()) continue;  // pending: own health check
           auto resp = phttp::request("GET", inst->endpoint, "/get_server_info", "", 2000);
+          bool parsed = false;
           if (resp.ok()) {
-            bool ok = false;
-            Value info = pjson::Parser::parse(resp.body, &ok);
-            if (ok) {
+            Value info = pjson::Parser::parse(resp.body, &parsed);
+            if (parsed) {
+              inst->heartbeat_misses = 0;
               inst->num_running_reqs = info["num_running_reqs"].as_int();
               inst->num_queued_reqs = info["num_queued_reqs"].as_int();
               inst->last_gen_throughput = info["last_gen_throughput"].as_num();
+              if (info["draining"].as_bool() && !inst->draining.load()) {
+                log_line("instance " + inst->endpoint +
+                         " announced draining; leaving routing set");
+                state_.mark_draining(inst->endpoint);
+              }
+              // monotonic version raise from the engine's own report —
+              // re-admits a caught-up engine the weight plane lost track of
+              if (info["weight_version"].is_num())
+                state_.set_instance_version(inst->endpoint,
+                                            info["weight_version"].as_int());
+            }
+          }
+          if (!parsed) {
+            int64_t misses = inst->heartbeat_misses.fetch_add(1) + 1;
+            if (cfg_.heartbeat_failures > 0 && !inst->is_local &&
+                misses >= cfg_.heartbeat_failures) {
+              log_line("evicting instance " + inst->endpoint + " after " +
+                       std::to_string(misses) + " heartbeat misses");
+              state_.evict(inst->endpoint);
             }
           }
         }
@@ -435,12 +465,24 @@ void register_routes(phttp::Server& server, Manager& mgr) {
       o["num_queued_reqs"] = Value(inst->num_queued_reqs.load());
       o["weight_sender"] = Value(inst->weight_sender);
       o["group_idx"] = Value(inst->group_idx);
+      o["draining"] = Value(inst->draining.load());
+      o["heartbeat_misses"] = Value(inst->heartbeat_misses.load());
+      o["active"] = Value(state.is_active(inst->endpoint));
       arr.push_back(Value(std::move(o)));
     }
     Object top;
     top["instances"] = Value(std::move(arr));
     top["weight_version"] = Value(state.weight_version());
     top["max_local_gen_s"] = Value(state.balance.max_local_gen_s());
+    auto pc = state.pool_counts();
+    Object pool;
+    pool["joins"] = Value(pc.joins);
+    pool["evictions"] = Value(pc.evictions);
+    pool["drain_departures"] = Value(pc.drain_departures);
+    pool["active"] = Value(pc.active);
+    pool["pending"] = Value(pc.pending);
+    pool["registered"] = Value(pc.registered);
+    top["pool"] = Value(std::move(pool));
     rw.body = Value(std::move(top)).dump();
   });
 
@@ -491,6 +533,18 @@ void register_routes(phttp::Server& server, Manager& mgr) {
     body += "# TYPE polyrl_mgr_max_local_gen_s gauge\n"
             "polyrl_mgr_max_local_gen_s " +
             std::to_string(state.balance.max_local_gen_s()) + "\n";
+    auto pc = state.pool_counts();
+    body += "# TYPE polyrl_mgr_pool_joins counter\npolyrl_mgr_pool_joins " +
+            std::to_string(pc.joins) + "\n";
+    body += "# TYPE polyrl_mgr_pool_evictions counter\n"
+            "polyrl_mgr_pool_evictions " + std::to_string(pc.evictions) + "\n";
+    body += "# TYPE polyrl_mgr_pool_drain_departures counter\n"
+            "polyrl_mgr_pool_drain_departures " +
+            std::to_string(pc.drain_departures) + "\n";
+    body += "# TYPE polyrl_mgr_pool_active gauge\npolyrl_mgr_pool_active " +
+            std::to_string(pc.active) + "\n";
+    body += "# TYPE polyrl_mgr_pool_pending gauge\npolyrl_mgr_pool_pending " +
+            std::to_string(pc.pending) + "\n";
     body += "# TYPE polyrl_mgr_running_reqs gauge\npolyrl_mgr_running_reqs " +
             std::to_string(running) + "\n";
     body += "# TYPE polyrl_mgr_queued_reqs gauge\npolyrl_mgr_queued_reqs " +
@@ -528,6 +582,26 @@ void register_routes(phttp::Server& server, Manager& mgr) {
     o["group_idx"] = Value(group);
     rw.body = Value(std::move(o)).dump();
     log_line("registered remote instance " + endpoint);
+  });
+
+  // Graceful leave (scale-down as a drill): the engine — or the pool
+  // manager running a preemption drill — announces departure AFTER
+  // draining. ``drained=true`` books it as a drain departure rather than
+  // an eviction; idempotent (an already-forgotten endpoint is a no-op).
+  server.route("POST", "/deregister_rollout_instance",
+               [&, acl_reject](const phttp::Request& req, phttp::ResponseWriter& rw) {
+    if (acl_reject(req, rw)) return;
+    Value body = pjson::Parser::parse(req.body);
+    std::string endpoint = body["endpoint"].as_str();
+    if (endpoint.empty()) { rw.status = 400; rw.body = "{\"error\":\"endpoint required\"}"; return; }
+    bool known = state.has_instance(endpoint);
+    if (known) state.leave(endpoint, body["drained"].as_bool());
+    Object o;
+    o["status"] = Value("ok");
+    o["removed"] = Value(known);
+    rw.body = Value(std::move(o)).dump();
+    log_line("deregistered instance " + endpoint +
+             (body["drained"].as_bool() ? " (drained)" : ""));
   });
 
   server.route("POST", "/register_local_rollout_instances",
@@ -573,6 +647,16 @@ void register_routes(phttp::Server& server, Manager& mgr) {
       if (state.has_instance(ep)) { ++kept; continue; }
       state.register_instance(ep, true);
       ++added_local;
+    }
+    // pool-membership replay: each engine's last-known weight version.
+    // Without this a respawned manager sees every replayed engine at -1,
+    // gates the whole (healthy, caught-up) fleet behind a redundant weight
+    // bootstrap, and orphans it if no sender ever re-pushes. Monotonic and
+    // bootstrap-gated inside set_instance_version, so a double replay (or
+    // a stale one) is a no-op.
+    if (body["instance_versions"].is_obj()) {
+      for (const auto& [ep, ver] : body["instance_versions"].as_obj())
+        state.set_instance_version(ep, ver.as_int(-1));
     }
     Object o;
     o["status"] = Value("ok");

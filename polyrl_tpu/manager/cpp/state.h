@@ -36,6 +36,13 @@ struct Instance {
   std::atomic<bool> updating_weight{false};
   std::atomic<int64_t> weight_version{-1};
   std::atomic<bool> healthy{false};
+  // elastic-pool membership state: consecutive heartbeat (stats-poll)
+  // misses — a remote past the configured budget is evicted; draining is
+  // the engine's own announcement (server_info) that it took a preemption
+  // notice — it leaves the routing set immediately but stays registered
+  // until it deregisters or its heartbeat lapses
+  std::atomic<int64_t> heartbeat_misses{0};
+  std::atomic<bool> draining{false};
 };
 
 using InstancePtr = std::shared_ptr<Instance>;
@@ -67,6 +74,11 @@ class AppState {
       inst->weight_sender = sender;
       inst->group_idx = group;
     }
+    // a re-registration (rejoin after drain/eviction of the same endpoint)
+    // starts with a clean bill: no inherited misses or draining flag
+    inst->heartbeat_misses = 0;
+    inst->draining = false;
+    ++joins_;
     if (is_local) {
       // local engines are trusted healthy (they registered from in-process)
       inst->healthy = true;
@@ -85,12 +97,106 @@ class AppState {
     it->second->healthy = true;
     pending_.erase(endpoint);
     // joins the ACTIVE pool only after weight bootstrap (get_receive_instances
-    // → update_weights), mirroring handlers.rs:40-86. With no senders
-    // registered (no weight fabric), it goes straight to active.
-    if (weight_senders_.empty()) {
+    // → update_weights), mirroring handlers.rs:40-86 — UNLESS the instance
+    // already reports the pool's current weight version (a reconcile replay
+    // of a healthy fleet after a manager respawn: those engines would never
+    // be offered to a sender and would strand outside the routing set
+    // forever). With no senders registered (no weight fabric), it goes
+    // straight to active.
+    if (weight_senders_.empty() ||
+        it->second->weight_version.load() >= weight_version_) {
       active_.insert(endpoint);
       cv_.notify_all();
     }
+  }
+
+  // Reconcile replay: restore a replayed engine's last-known weight version
+  // (monotonic per instance — a stale replay can never rewind a live
+  // engine), then re-admit it to the routing set if it is healthy and at
+  // the current pool version (the respawned manager must not orphan a
+  // caught-up fleet behind a redundant weight bootstrap).
+  void set_instance_version(const std::string& endpoint, int64_t version) {
+    // versions from real trainer pushes are >= 1 (update_weight_version
+    // pre-increments from 0); a reported 0 is an engine's random-init
+    // weights and must NOT satisfy the bootstrap gate
+    if (version <= 0) return;
+    std::lock_guard<std::mutex> g(mu_);
+    auto it = instances_.find(endpoint);
+    if (it == instances_.end()) return;
+    auto& inst = it->second;
+    if (version > inst->weight_version.load()) inst->weight_version = version;
+    // re-admission is for caught-up REMOTES only: a time-sliced-out local
+    // re-enters exclusively via resume_local_instances, and an instance
+    // mid-weight-update re-enters via complete_weight_update
+    if (!inst->is_local && inst->healthy.load() && !inst->draining.load() &&
+        !inst->updating_weight.load() &&
+        inst->weight_version.load() >= weight_version_) {
+      active_.insert(endpoint);
+      cv_.notify_all();
+    }
+  }
+
+  // The engine announced it is draining (preemption notice): out of the
+  // routing set immediately, but it stays registered — in-flight aborts are
+  // still being flushed as salvageable partials through its wire.
+  void mark_draining(const std::string& endpoint) {
+    std::lock_guard<std::mutex> g(mu_);
+    auto it = instances_.find(endpoint);
+    if (it == instances_.end()) return;
+    if (!it->second->draining.exchange(true)) ++drain_departures_;
+    active_.erase(endpoint);
+  }
+
+  // Heartbeat-timeout eviction (scale-down WITHOUT notice): forget the
+  // instance and count the eviction. In-flight rids on it fail their
+  // stream and continue on survivors through the normal salvage path.
+  void evict(const std::string& endpoint) {
+    std::lock_guard<std::mutex> g(mu_);
+    if (!instances_.count(endpoint)) return;
+    active_.erase(endpoint);
+    pending_.erase(endpoint);
+    instances_.erase(endpoint);
+    ++evictions_;
+  }
+
+  // Graceful leave (POST /deregister_rollout_instance): the engine (or the
+  // pool manager running a preemption drill) announced departure. A drain
+  // the heartbeat already booked (mark_draining) is not counted twice.
+  void leave(const std::string& endpoint, bool drained) {
+    std::lock_guard<std::mutex> g(mu_);
+    auto it = instances_.find(endpoint);
+    if (it == instances_.end()) return;
+    bool already_draining = it->second->draining.load();
+    active_.erase(endpoint);
+    pending_.erase(endpoint);
+    instances_.erase(it);
+    if (drained) {
+      if (!already_draining) ++drain_departures_;
+    } else {
+      ++evictions_;
+    }
+  }
+
+  struct PoolCounts {
+    int64_t joins = 0, evictions = 0, drain_departures = 0;
+    int64_t active = 0, pending = 0, registered = 0;
+  };
+
+  PoolCounts pool_counts() {
+    std::lock_guard<std::mutex> g(mu_);
+    PoolCounts out;
+    out.joins = joins_;
+    out.evictions = evictions_;
+    out.drain_departures = drain_departures_;
+    out.active = static_cast<int64_t>(active_.size());
+    out.pending = static_cast<int64_t>(pending_.size());
+    out.registered = static_cast<int64_t>(instances_.size());
+    return out;
+  }
+
+  bool is_active(const std::string& endpoint) {
+    std::lock_guard<std::mutex> g(mu_);
+    return active_.count(endpoint) > 0;
   }
 
   bool has_instance(const std::string& endpoint) {
@@ -148,6 +254,7 @@ class AppState {
     if (!pending_.empty()) return true;
     for (auto& [ep, inst] : instances_) {
       if (!inst->healthy.load()) continue;
+      if (inst->draining.load()) continue;  // announced departure: leaving
       if (active_.count(ep)) return true;
       if (!inst->is_local) return true;
     }
@@ -157,8 +264,11 @@ class AppState {
   // -- scheduling (reference next_instance_with_type, state.rs:84-147) --
 
   // Block until an instance is available: quota not exhausted AND zero
-  // queued requests; round-robin among eligible. want_local filters by
-  // locality (-1 = any). Returns nullptr on shutdown/timeout.
+  // queued requests; among eligible, pick the LEAST-LOADED (running +
+  // queued from the last stats tick, plus batches assigned since — the
+  // live signal between ticks), tie-broken round-robin so an idle pool
+  // still rotates. want_local filters by locality (-1 = any). Returns
+  // nullptr on shutdown/timeout.
   InstancePtr next_instance(int want_local = -1, int timeout_ms = 120000) {
     std::unique_lock<std::mutex> lk(mu_);
     auto deadline = std::chrono::steady_clock::now() +
@@ -171,12 +281,24 @@ class AppState {
         auto& inst = it->second;
         if (want_local >= 0 && inst->is_local != (want_local == 1)) continue;
         if (inst->updating_weight.load()) continue;
+        if (inst->draining.load()) continue;
         if (inst->assigned_batches.load() >= max_assigned_batches_) continue;
         if (inst->num_queued_reqs.load() > 0) continue;
         eligible.push_back(inst);
       }
       if (!eligible.empty()) {
-        auto& pick = eligible[rr_counter_++ % eligible.size()];
+        auto load = [](const InstancePtr& i) {
+          return i->num_running_reqs.load() + i->num_queued_reqs.load() +
+                 i->assigned_batches.load();
+        };
+        size_t start = rr_counter_++ % eligible.size();
+        InstancePtr pick = eligible[start];
+        int64_t best = load(pick);
+        for (size_t k = 1; k < eligible.size(); ++k) {
+          auto& cand = eligible[(start + k) % eligible.size()];
+          int64_t l = load(cand);
+          if (l < best) { best = l; pick = cand; }
+        }
         pick->assigned_batches.fetch_add(1);
         return pick;
       }
@@ -350,6 +472,10 @@ class AppState {
   int64_t weight_version_ = 0;
   int max_assigned_batches_;
   bool shutdown_ = false;
+  // pool lifecycle counters (cumulative; /metrics + /get_instances_status)
+  int64_t joins_ = 0;
+  int64_t evictions_ = 0;
+  int64_t drain_departures_ = 0;
 };
 
 }  // namespace manager

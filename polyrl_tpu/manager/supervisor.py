@@ -72,7 +72,12 @@ class ManagerSupervisor:
         self._lock = threading.Lock()
         self._desired: dict = {"remote": set(), "local": set(),
                                "senders": [], "groups_per_sender": 1,
-                               "weight_version": 0}
+                               "weight_version": 0,
+                               # pool membership: endpoint -> last-known
+                               # weight version (replayed so a respawn does
+                               # not orphan a caught-up fleet behind a
+                               # redundant weight bootstrap)
+                               "instance_versions": {}}
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
 
@@ -96,6 +101,24 @@ class ManagerSupervisor:
         with self._lock:
             if version > self._desired["weight_version"]:
                 self._desired["weight_version"] = int(version)
+
+    def record_instance_version(self, endpoint: str, version: int) -> None:
+        """Per-engine weight version (monotonic per endpoint)."""
+        if not endpoint or version <= 0:
+            return
+        with self._lock:
+            cur = self._desired["instance_versions"].get(endpoint, 0)
+            if version > cur:
+                self._desired["instance_versions"][endpoint] = int(version)
+
+    def forget_instance(self, endpoint: str) -> None:
+        """Drop a departed engine from desired state (graceful leave /
+        preemption drill): replaying it onto a fresh manager would re-add
+        a dead endpoint the pool just said goodbye to."""
+        with self._lock:
+            self._desired["remote"].discard(endpoint)
+            self._desired["local"].discard(endpoint)
+            self._desired["instance_versions"].pop(endpoint, None)
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -151,9 +174,11 @@ class ManagerSupervisor:
             senders = list(self._desired["senders"])
             groups = self._desired["groups_per_sender"]
             version = self._desired["weight_version"]
+            inst_versions = dict(self._desired["instance_versions"])
         if not (remote or local or senders or version):
             return  # nothing registered yet (first spawn)
-        out = client.reconcile(remote, local, senders, groups, version)
+        out = client.reconcile(remote, local, senders, groups, version,
+                               instance_versions=inst_versions)
         log.info("manager reconciled: %s", out)
 
     def _snapshot(self, client: ManagerClient) -> None:
@@ -170,6 +195,11 @@ class ManagerSupervisor:
                     continue
                 key = "local" if inst.get("is_local") else "remote"
                 self._desired[key].add(ep)
+                # pool membership: the engine's last-known weight version
+                # rides along so the replay can re-admit a caught-up fleet
+                iv = int(inst.get("weight_version", -1))
+                if iv > self._desired["instance_versions"].get(ep, 0):
+                    self._desired["instance_versions"][ep] = iv
             v = int(st.get("weight_version", 0))
             if v > self._desired["weight_version"]:
                 self._desired["weight_version"] = v

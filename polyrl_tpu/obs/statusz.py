@@ -20,6 +20,8 @@ Schema (``polyrl/statusz/v1`` — additive evolution only):
 - ``gauges``    — scalar last-values (weight staleness, queue depth, ...)
 - ``queues``    — engine/pipeline queue depths
 - ``weights``   — weight version / push count / staleness
+- ``pool``      — elastic-pool membership (engines + lifecycle counts;
+  trainer role with a PoolManager attached, empty elsewhere)
 
 ``GET /metrics`` on the same listener renders the snapshot's numeric
 leaves as Prometheus text (``polyrl_statusz_*`` gauges) for real scrapers.
@@ -49,7 +51,8 @@ def build_snapshot(role: str, *, step: int | None = None,
                    counters: dict | None = None,
                    gauges: dict | None = None,
                    queues: dict | None = None,
-                   weights: dict | None = None) -> dict:
+                   weights: dict | None = None,
+                   pool: dict | None = None) -> dict:
     """The shared statusz schema; every section present (empty when the
     plane has nothing for it) so consumers never need existence checks."""
     return {
@@ -65,6 +68,7 @@ def build_snapshot(role: str, *, step: int | None = None,
         "gauges": gauges or {},
         "queues": queues or {},
         "weights": weights or {},
+        "pool": pool or {},
     }
 
 

@@ -55,6 +55,14 @@ class FaultInjectionConfig:
     stream_kill_times: int = 0       # how many manager streams to kill
     stream_kill_min_progress: int = 1  # fire only once EVERY pending rid
     #                                    has salvaged >= this many tokens
+    # -- pool-drill trigger: kill a whole ENGINE mid-batch ------------------
+    # Fires the registered ``engine_killer`` callback (tests/bench attach
+    # e.g. ``server.kill`` — death WITHOUT notice) once the stream has
+    # forwarded >= engine_kill_min_progress progress tokens, i.e. while
+    # requests are provably mid-decode on the pool. Recovery is the pool's
+    # job: heartbeat eviction + manager continuation on survivors.
+    engine_kill_times: int = 0
+    engine_kill_min_progress: int = 1
 
 
 def base_rid(rid: str) -> str:
@@ -79,12 +87,17 @@ class FaultInjector:
         self._stalled: set[str] = set()
         self._admitted = 0
         self._drained = False
+        # pool drill: a zero-arg callable that kills one engine (e.g.
+        # ``RolloutServer.kill`` or ``FakeEngine.kill``); armed by
+        # engine_kill_times in the config
+        self.engine_killer = None
         # telemetry
         self.kills = 0
         self.corruptions = 0
         self.stalls = 0
         self.drains = 0
         self.stream_kills = 0
+        self.engine_kills = 0
 
     def counters(self) -> dict[str, float]:
         return {
@@ -93,6 +106,7 @@ class FaultInjector:
             "fault/injected_stalls": float(self.stalls),
             "fault/injected_drains": float(self.drains),
             "fault/injected_stream_kills": float(self.stream_kills),
+            "fault/injected_engine_kills": float(self.engine_kills),
         }
 
     # -- engine/server-side hooks -------------------------------------------
@@ -166,29 +180,52 @@ class FaultInjector:
         """Wrap ``ManagerClient.batch_generate_stream``: pass items through,
         then raise a transport error once every still-pending rid has
         reported >= ``stream_kill_min_progress`` salvageable tokens — the
-        worst-case manager death for the salvage ledger to recover from."""
-        if not self.cfg.enabled or self.cfg.stream_kill_times <= 0:
+        worst-case manager death for the salvage ledger to recover from.
+
+        With ``engine_kill_times`` armed, also fires the registered
+        ``engine_killer`` once the stream has forwarded
+        ``engine_kill_min_progress`` progress tokens: the engine dies
+        provably mid-batch (SIGKILL semantics — no drain, no notice) and
+        the pool must recover by heartbeat eviction + continuation."""
+        arm_stream = self.cfg.enabled and self.cfg.stream_kill_times > 0
+        arm_engine = (self.cfg.enabled and self.cfg.engine_kill_times > 0
+                      and self.engine_killer is not None)
+        if not arm_stream and not arm_engine:
             yield from stream
             return
         from polyrl_tpu.manager.client import (GenerateProgress,
                                                ManagerTransportError)
 
         progress = {r: 0 for r in pending_rids}
+        total_progress = 0
         pending = set(pending_rids)
         for item in stream:
             if isinstance(item, GenerateProgress):
                 if item.rid in progress:
                     progress[item.rid] += len(item.token_ids)
+                    total_progress += len(item.token_ids)
             else:
                 pending.discard(getattr(item, "rid", None))
             yield item
+            kill_engine = False
             with self._lock:
-                armed = self.stream_kills < self.cfg.stream_kill_times
+                if (arm_engine
+                        and self.engine_kills < self.cfg.engine_kill_times
+                        and total_progress
+                        >= self.cfg.engine_kill_min_progress):
+                    self.engine_kills += 1
+                    kill_engine = True
+                armed = (arm_stream
+                         and self.stream_kills < self.cfg.stream_kill_times)
                 fire = (armed and pending
                         and all(progress[r] >= self.cfg.stream_kill_min_progress
                                 for r in pending))
                 if fire:
                     self.stream_kills += 1
+            if kill_engine:
+                log.warning("fault injection: killing an engine mid-batch "
+                            "(%d rids pending)", len(pending))
+                self.engine_killer()
             if fire:
                 log.warning("fault injection: killing manager stream with "
                             "%d rids pending", len(pending))

@@ -1,0 +1,344 @@
+"""Elastic rollout pool: N engines, one manager, preemption as a normal
+event (ARCHITECTURE.md "Elastic pool").
+
+The C++ manager owns the data plane — request routing (queue-depth- and
+weight-version-aware, ``state.h next_instance``), heartbeat-timeout
+eviction, and the weight-bootstrap gate that keeps a late joiner out of
+the routing set until its weight version reaches the pool floor. This
+module is the FLEET-side control plane on top of it:
+
+- :class:`PoolManager` — membership lifecycle. ``add_engine`` registers a
+  server (attaching its weight receiver so the transfer fabric's idle poll
+  catches it up to the current version), ``preempt`` runs the scale-down
+  drill (``POST /drain`` → salvaged partials re-route as suffix resumes on
+  survivors → graceful deregistration), and ``sweep``/``wait_for_size``
+  give tests, the bench ``--pool`` topology, and the trainer's /statusz a
+  live membership view with ``pool/*`` counters.
+- :class:`BalanceEstimator` — the paper's progressive train↔rollout
+  balance estimator: a sliding window over recent steps' ``goodput/*``
+  phase walls (generate vs update vs bubble) replaces the one-scalar feed
+  the manager's hill-climbing balancer used to get, so one anomalous step
+  (a preemption drill, a checkpoint) no longer yanks the colocated
+  generation window around.
+
+Scheduling reference: the Adaptive Placement framework (PAPERS.md);
+trainer/fleet decoupling per LlamaRL (PAPERS.md).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import threading
+import time
+import urllib.request
+from collections import deque
+from statistics import median
+
+log = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class PoolConfig:
+    """``rollout.pool.*`` knobs (config.py RolloutSection)."""
+    # expected pool size for launchers/bench --pool (0 = whatever joins)
+    engines: int = 0
+    # background membership sweep cadence (0 = manual sweep() only)
+    sweep_interval_s: float = 0.0
+    # scale-down drill: wait after /drain for abort partials to flush
+    # through their open manager streams before deregistering
+    drain_grace_s: float = 0.5
+    # scale-up: how long add_engine(wait=True) waits for the engine to
+    # pass health + the weight-bootstrap gate into the routing set
+    join_deadline_s: float = 120.0
+    # balance estimator sliding window (steps)
+    balance_window: int = 8
+
+
+def _http_post(endpoint: str, path: str, payload: dict | None = None,
+               timeout: float = 5.0) -> dict:
+    req = urllib.request.Request(
+        f"http://{endpoint}{path}",
+        data=json.dumps(payload or {}).encode(), method="POST",
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.loads(r.read() or b"{}")
+
+
+def _http_get(endpoint: str, path: str, timeout: float = 3.0) -> dict:
+    req = urllib.request.Request(f"http://{endpoint}{path}", method="GET")
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.loads(r.read() or b"{}")
+
+
+class PoolManager:
+    """Fleet membership on top of a :class:`ManagerClient`.
+
+    The manager's registry is the source of truth; this object adds the
+    lifecycle verbs (join with weight catch-up, preemption drill, hard
+    evict), a cached membership snapshot for /statusz, and cumulative
+    ``pool/*`` counters for step records."""
+
+    def __init__(self, manager, cfg: PoolConfig | None = None):
+        self.manager = manager
+        self.cfg = cfg or PoolConfig()
+        self._lock = threading.Lock()
+        self._last_status: dict = {}
+        self._last_sweep = 0.0
+        # drill bookkeeping (manager counters survive respawns via
+        # /reconcile; these are the drills THIS control plane initiated)
+        self.preemptions = 0
+        self.hard_evictions = 0
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        if self.cfg.sweep_interval_s > 0:
+            self._thread = threading.Thread(target=self._sweep_loop,
+                                            name="pool-sweep", daemon=True)
+            self._thread.start()
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+    # -- membership view ---------------------------------------------------
+
+    def sweep(self) -> dict:
+        """One /get_instances_status snapshot (cached for statusz readers);
+        best-effort — a respawning manager returns the last good view."""
+        try:
+            st = self.manager.get_instances_status()
+        except Exception:  # noqa: BLE001 — manager mid-respawn
+            log.warning("pool sweep failed; serving last snapshot",
+                        exc_info=True)
+            with self._lock:
+                return dict(self._last_status)
+        with self._lock:
+            self._last_status = st
+            self._last_sweep = time.monotonic()
+        return st
+
+    def _sweep_loop(self) -> None:
+        while not self._stop.wait(self.cfg.sweep_interval_s):
+            self.sweep()
+
+    def engines(self, refresh: bool = True) -> list[dict]:
+        st = self.sweep() if refresh else self._last_status
+        return list(st.get("instances", []))
+
+    def active_count(self, refresh: bool = True) -> int:
+        return sum(1 for i in self.engines(refresh)
+                   if i.get("active", i.get("healthy")))
+
+    def probe(self, endpoint: str) -> bool:
+        """Direct serving-health probe of one engine (the manager's view
+        lags one heartbeat tick; drills want the live answer)."""
+        try:
+            return _http_get(endpoint, "/health_generate").get(
+                "status") == "ok"
+        except Exception:  # noqa: BLE001 — dead/draining engines say no
+            return False
+
+    # -- scale-up ----------------------------------------------------------
+
+    def add_engine(self, server=None, endpoint: str = "",
+                   transfer_streams: int = 4, wait: bool = True,
+                   deadline_s: float | None = None) -> str:
+        """Join one engine mid-run. With a :class:`RolloutServer`, the
+        weight receiver is attached too, so the transfer fabric's idle
+        poll full-pushes the current version and the engine then rides the
+        normal async push fan-out; the manager keeps it OUT of the routing
+        set until its version reaches the pool floor (state.h
+        promote_healthy / complete_weight_update). Returns the endpoint."""
+        if server is not None:
+            from polyrl_tpu.rollout.serve import register_with_manager
+
+            register_with_manager(server, client=self.manager,
+                                  transfer_streams=transfer_streams)
+            endpoint = server.endpoint
+        elif endpoint:
+            self.manager.register_rollout_instance(endpoint)
+        else:
+            raise ValueError("add_engine needs a server or an endpoint")
+        if wait:
+            self.wait_for_member(endpoint,
+                                 deadline_s or self.cfg.join_deadline_s)
+        return endpoint
+
+    def wait_for_member(self, endpoint: str, deadline_s: float = 120.0,
+                        active: bool = True) -> dict:
+        """Poll until ``endpoint`` is in the routing set (or merely
+        registered+healthy with ``active=False``)."""
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < deadline_s:
+            for inst in self.engines():
+                if inst.get("endpoint") != endpoint:
+                    continue
+                if inst.get("active") if active else inst.get("healthy"):
+                    return inst
+            time.sleep(0.1)
+        raise TimeoutError(
+            f"engine {endpoint} not {'active' if active else 'healthy'} "
+            f"after {deadline_s:.0f}s: {self.engines(refresh=False)}")
+
+    def wait_for_size(self, n: int, deadline_s: float = 60.0) -> None:
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < deadline_s:
+            if self.active_count() >= n:
+                return
+            time.sleep(0.1)
+        raise TimeoutError(f"pool never reached {n} active engines: "
+                           f"{self.engines(refresh=False)}")
+
+    # -- scale-down --------------------------------------------------------
+
+    def preempt(self, endpoint: str, grace_s: float | None = None) -> dict:
+        """Scale-down as a drill, not a disaster: ``POST /drain`` (the
+        engine refuses new admissions and aborts in-flight requests into
+        salvageable partials, which re-route to survivors as suffix
+        resumes through the manager's continuation), a short grace for
+        those aborts to flush, then graceful deregistration."""
+        self.preemptions += 1
+        out: dict = {}
+        try:
+            out = _http_post(endpoint, "/drain")
+        except Exception:  # noqa: BLE001 — engine may already be gone
+            log.warning("drain of %s failed; deregistering anyway",
+                        endpoint, exc_info=True)
+        time.sleep(grace_s if grace_s is not None else self.cfg.drain_grace_s)
+        try:
+            self.manager.deregister_rollout_instance(endpoint, drained=True)
+        except Exception:  # noqa: BLE001 — heartbeat eviction backstops
+            log.warning("deregister of %s failed; heartbeat will evict",
+                        endpoint, exc_info=True)
+        return out
+
+    def evict(self, endpoint: str) -> None:
+        """Hard removal (the drill for death WITHOUT notice — normally the
+        manager's heartbeat does this on its own)."""
+        self.hard_evictions += 1
+        self.manager.deregister_rollout_instance(endpoint, drained=False)
+
+    # -- telemetry ---------------------------------------------------------
+
+    def counters(self, refresh: bool = True) -> dict[str, float]:
+        """``pool/*`` gauges for step records / bench lines."""
+        st = self.sweep() if refresh else dict(self._last_status)
+        pool = st.get("pool", {})
+        insts = st.get("instances", [])
+        out = {
+            "pool/engines": float(pool.get("registered", len(insts))),
+            "pool/active": float(pool.get("active", 0)),
+            "pool/pending": float(pool.get("pending", 0)),
+            "pool/joins": float(pool.get("joins", 0)),
+            "pool/evictions": float(pool.get("evictions", 0)),
+            "pool/drain_departures": float(pool.get("drain_departures", 0)),
+            "pool/preemption_drills": float(self.preemptions),
+        }
+        versions = [int(i.get("weight_version", -1)) for i in insts]
+        if versions:
+            out["pool/weight_version_floor"] = float(min(versions))
+        return out
+
+    def statusz_section(self) -> dict:
+        """The /statusz ``pool`` block: membership + per-engine health,
+        queue depth, and weight version (served from the cached sweep so
+        the exporter never blocks on a respawning manager)."""
+        with self._lock:
+            st = dict(self._last_status)
+            age = time.monotonic() - self._last_sweep if self._last_sweep \
+                else -1.0
+        return {
+            "counts": {k.split("/", 1)[1]: v
+                       for k, v in self.counters(refresh=False).items()},
+            "engines": [{
+                "endpoint": i.get("endpoint", ""),
+                "is_local": bool(i.get("is_local")),
+                "healthy": bool(i.get("healthy")),
+                "active": bool(i.get("active")),
+                "draining": bool(i.get("draining")),
+                "weight_version": int(i.get("weight_version", -1)),
+                "running": int(i.get("num_running_reqs", 0)),
+                "queued": int(i.get("num_queued_reqs", 0)),
+                "heartbeat_misses": int(i.get("heartbeat_misses", 0)),
+            } for i in st.get("instances", [])],
+            "snapshot_age_s": round(age, 3),
+        }
+
+
+class BalanceEstimator:
+    """Progressive train↔rollout balance estimator.
+
+    The manager's hill-climbing balancer (balance.h) actuates the
+    colocated generation window from three scalars per step. Before this
+    estimator those scalars were the LAST step's raw values, so one
+    anomalous step (preemption drill, checkpoint write, a salvage resume
+    wait) would swing the window by gap/3 off a measurement that says
+    nothing about steady state. This maintains a sliding window of recent
+    steps' goodput phase walls and feeds the balancer per-field MEDIANS —
+    the same robust-baseline trick tools/bench_gate.py uses — plus
+    ``pool/balance_*`` gauges so the step record shows what the balancer
+    actually saw."""
+
+    def __init__(self, window: int = 8):
+        self.window = max(1, int(window))
+        self._steps: deque[dict[str, float]] = deque(maxlen=self.window)
+        self._lock = threading.Lock()
+
+    def observe(self, *, step_time_s: float = 0.0,
+                trainer_bubble_s: float = 0.0, throughput: float = 0.0,
+                generate_s: float = 0.0, update_s: float = 0.0,
+                **_ignored) -> None:
+        """Fold one finished step in. ``generate_s``/``update_s`` are the
+        goodput ledger's phase walls (timing_s/gen and the actor+critic
+        update phases); extra keys are accepted and ignored so callers can
+        pass a whole stats dict through."""
+        with self._lock:
+            self._steps.append({
+                "step_time_s": float(step_time_s),
+                "trainer_bubble_s": float(trainer_bubble_s),
+                "throughput": float(throughput),
+                "generate_s": float(generate_s),
+                "update_s": float(update_s),
+            })
+
+    def _window_median(self, key: str) -> float:
+        return median(s[key] for s in self._steps) if self._steps else 0.0
+
+    def stats(self) -> dict[str, float]:
+        """Smoothed balancer feed (the update_metrics payload). Falls back
+        to zeros before the first observe — the manager then keeps its
+        initial window."""
+        with self._lock:
+            if not self._steps:
+                return {}
+            return {
+                "step_time_s": self._window_median("step_time_s"),
+                "trainer_bubble_s": self._window_median("trainer_bubble_s"),
+                "throughput": self._window_median("throughput"),
+            }
+
+    def metrics(self) -> dict[str, float]:
+        """``pool/balance_*`` step-record gauges: what the balancer saw,
+        plus the estimated offload fraction — the share of generation the
+        trainer-side update window can NOT hide, i.e. what should run on
+        remote engines rather than the colocated one."""
+        with self._lock:
+            if not self._steps:
+                return {}
+            gen = self._window_median("generate_s")
+            upd = self._window_median("update_s")
+            bubble = self._window_median("trainer_bubble_s")
+            step = self._window_median("step_time_s")
+        gen_total = gen + bubble  # colocated gen + blocked-on-remote time
+        offload = gen_total / (gen_total + upd) if gen_total + upd > 0 else 0.0
+        return {
+            "pool/balance_window_steps": float(len(self._steps)),
+            "pool/balance_step_time_s": step,
+            "pool/balance_bubble_s": bubble,
+            "pool/balance_generate_s": gen,
+            "pool/balance_update_s": upd,
+            "pool/balance_offload_frac": offload,
+        }

@@ -27,6 +27,7 @@ import dataclasses
 import itertools
 import logging
 import queue
+import random
 import threading
 import time
 from typing import Any, Iterator
@@ -37,6 +38,7 @@ from polyrl_tpu import obs
 from polyrl_tpu.manager.client import (ControlPlaneDown, GenerateProgress,
                                        GenerateResult, ManagerClient,
                                        ManagerTransportError)
+from polyrl_tpu.rollout.pool import BalanceEstimator
 from polyrl_tpu.rollout.sampling import SamplingParams
 
 log = logging.getLogger(__name__)
@@ -104,6 +106,8 @@ class RemoteRollout:
         resume_wait_s: float = 60.0,  # per-resume wait for manager recovery
         salvage_partials: bool = True,  # token-level suffix resume
         fault_injector=None,         # rollout/faults.py (tests, bench --chaos)
+        balance_window: int = 8,     # progressive balance estimator window
+        pool=None,                   # rollout/pool.py PoolManager (optional)
     ):
         self.manager = manager
         self.transfer = transfer
@@ -130,6 +134,18 @@ class RemoteRollout:
         # graceful: the merge is skipped, the step never fails — this
         # counter is the only trace a flaky scrape leaves)
         self.scrape_failures = 0
+        # pool re-admissions of the colocated engine that stayed failed
+        # past the retry budget: the pool silently lost its local engine
+        # (it idles with restored KV HBM while the manager never routes to
+        # it) — the counter is the visibility a log line never gave
+        self.resume_instances_failures = 0
+        # progressive train<->rollout balance estimator: update_metrics
+        # feeds the manager's balancer windowed medians instead of the
+        # last step's raw scalars (rollout/pool.py)
+        self.balance = BalanceEstimator(window=balance_window)
+        # optional fleet control plane (rollout/pool.py PoolManager): the
+        # trainer merges its pool/* counters and /statusz section
+        self.pool = pool
         # per-stream nonce keeps rids globally unique: concurrent streams
         # (nested REMAX baselines, validation overlapping training, and the
         # pipelined trainer's prefetch lane) would otherwise collide on
@@ -153,8 +169,15 @@ class RemoteRollout:
             "fault/tokens_salvaged": float(self.tokens_salvaged),
             "fault/suffix_resumes": float(self.suffix_resumes),
             "fault/resume_prefill_tokens": float(self.resume_prefill_tokens),
+            "fault/resume_instances_failed": float(
+                self.resume_instances_failures),
             "obs/scrape_failed": float(self.scrape_failures),
         }
+        if self.fault_injector is not None:
+            # chaos-mode visibility: the injected-fault counters ride the
+            # same step-record gauges the recovery counters do, so a drill
+            # record shows cause and effect side by side
+            out.update(self.fault_injector.counters())
         retries = getattr(self.manager, "retry_count", None)
         if retries is not None:
             out["fault/client_retries"] = float(retries)
@@ -162,6 +185,34 @@ class RemoteRollout:
         if supervisor is not None:
             out["fault/manager_restarts"] = float(supervisor.restarts)
         return out
+
+    def _resume_local_instances(self, attempts: int = 3,
+                                backoff_base_s: float = 0.1,
+                                backoff_max_s: float = 1.0) -> bool:
+        """Re-admit the colocated engine to the manager's routing set, with
+        a bounded jittered-backoff retry. A one-shot call that swallowed
+        its failure used to leave the pool silently one engine short — the
+        local engine idled with restored KV HBM while every request went
+        remote. Still best-effort past the budget (the stream must start
+        even if the manager is mid-respawn), but the failure now lands in
+        ``fault/resume_instances_failed`` so it is visible in step records
+        instead of only in a log line."""
+        err: Exception | None = None
+        for attempt in range(attempts):
+            try:
+                self.manager.resume_local_instances()
+                return True
+            except Exception as exc:  # noqa: BLE001 — retried below
+                err = exc
+                if attempt + 1 < attempts:
+                    sleep = min(backoff_base_s * 2 ** attempt,
+                                backoff_max_s) * (0.5 + random.random())
+                    time.sleep(sleep)
+        self.resume_instances_failures += 1
+        log.error("resume_local_instances failed after %d attempts "
+                  "(%d total failures): %s", attempts,
+                  self.resume_instances_failures, err)
+        return False
 
     def _wait_manager_recovery(self) -> bool:
         """Poll /health until the manager answers (the supervisor respawn
@@ -234,10 +285,7 @@ class RemoteRollout:
             # (handlers.rs:500-513), and engine resume + pool re-admission
             # must travel together or the pool starves while the engine
             # idles with restored KV HBM.
-            try:
-                self.manager.resume_local_instances()
-            except Exception:  # noqa: BLE001 — manager may not be up yet
-                log.exception("resume_local_instances failed")
+            self._resume_local_instances()
             if max_local_gen_s:
                 window_timer = threading.Timer(max_local_gen_s + 1.0, _release)
                 window_timer.daemon = True
@@ -578,9 +626,21 @@ class RemoteRollout:
     def update_metrics(self, **stats) -> dict:
         """Feed step stats to the manager's adaptive balancer; returns its
         response incl. the next local-generation budget (handlers.rs:867-901
-        equivalent)."""
+        equivalent).
+
+        The raw per-step stats first fold into the progressive balance
+        estimator (``generate_s``/``update_s`` goodput phase walls ride
+        along and stay trainer-side); the manager then receives the
+        windowed medians — one anomalous step no longer swings the
+        colocated generation window by gap/3."""
+        self.balance.observe(**stats)
+        smoothed = dict(stats)
+        # estimator-only inputs never reach the wire
+        smoothed.pop("generate_s", None)
+        smoothed.pop("update_s", None)
+        smoothed.update(self.balance.stats())
         try:
-            return self.manager.update_metrics(**stats)
+            return self.manager.update_metrics(**smoothed)
         except Exception:  # noqa: BLE001 — metrics are best-effort
             log.exception("update_metrics failed")
             return {}

@@ -180,16 +180,26 @@ def create_server(model: str, manager_endpoint: str | None = None,
     return server
 
 
-def register_with_manager(server, manager_endpoint: str,
+def register_with_manager(server, manager_endpoint: str = "",
                           is_local: bool = False,
-                          transfer_streams: int = 4) -> None:
+                          transfer_streams: int = 4,
+                          client=None) -> None:
     """POST /register_rollout_instance; spawn the receiver agent pointed at
-    the assigned weight sender (reference §3.2 startup flow)."""
+    the assigned weight sender (reference §3.2 startup flow). Passing an
+    existing ``client`` (PoolManager.add_engine does) registers through it
+    so a bound supervisor records the membership for /reconcile replay."""
     from polyrl_tpu.manager.client import ManagerClient
     from polyrl_tpu.transfer.agents import ReceiverAgent
     from polyrl_tpu.transfer.layout import build_layout
 
-    client = ManagerClient(manager_endpoint)
+    if client is None:
+        if not manager_endpoint:
+            raise ValueError("register_with_manager needs an endpoint or "
+                             "a client")
+        client = ManagerClient(manager_endpoint)
+    # remember who we joined: the /preempt → leave() lifecycle deregisters
+    # through this endpoint on graceful departure
+    server.manager_endpoint = client.endpoint.replace("http://", "")
     if is_local:
         client.register_local_rollout_instances([server.endpoint])
         return

@@ -84,6 +84,16 @@ class RolloutServer:
         # a drained server is about to lose its host.
         self._draining = threading.Event()
         self.drain_count = 0  # requests aborted by /drain (telemetry)
+        # chaos kill switch (pool drills): a "SIGKILLed" engine answers
+        # nothing and breaks every open stream mid-chunk — no drain, no
+        # partial flush, exactly the wire signature of a dead process.
+        # The manager's heartbeat then evicts it and in-flight rids
+        # continue on survivors through the salvage path.
+        self._killed = threading.Event()
+        # manager this server registered with (serve.register_with_manager
+        # / PoolManager.add_engine) — the leave/preempt lifecycle notifies
+        # it on graceful departure; "" = never registered
+        self.manager_endpoint = ""
         # optional FaultInjector (rollout/faults.py): observes admissions
         # and every outgoing stream line; can kill/corrupt/stall/drain
         self.fault = None
@@ -124,6 +134,10 @@ class RolloutServer:
                 self._send(code, json.dumps(obj).encode(), "application/json")
 
             def do_GET(self):
+                if outer._killed.is_set():
+                    self.close_connection = True
+                    self.connection.close()
+                    return
                 if self.path == "/health":
                     self._json(200, {"status": "ok"})
                 elif self.path == "/health_generate":
@@ -150,10 +164,20 @@ class RolloutServer:
                     self._json(404, {"error": f"no route {self.path}"})
 
             def do_POST(self):
+                if outer._killed.is_set():
+                    self.close_connection = True
+                    self.connection.close()
+                    return
                 length = int(self.headers.get("Content-Length", 0))
                 body = json.loads(self.rfile.read(length) or b"{}")
                 if self.path == "/generate":
                     self.handle_generate(body)
+                elif self.path == "/preempt":
+                    # preemption notice (the cloud's "you have N seconds"):
+                    # ack first, then run the drain + graceful leave off
+                    # the handler thread so the notifier is never blocked
+                    self._json(200, {"success": True, "draining": True})
+                    threading.Thread(target=outer.leave, daemon=True).start()
                 elif self.path == "/update_weights_from_agent":
                     ok, err = outer.update_weights_from_agent(
                         int(body.get("weight_version", -1)))
@@ -345,9 +369,51 @@ class RolloutServer:
         self.abort_request(None)
         return {"success": True, "draining": True, "aborted": n}
 
+    def leave(self, grace_s: float = 0.5) -> None:
+        """Graceful pool departure (POST /preempt, or a launcher's SIGTERM
+        handler): drain — in-flight requests flush salvageable partials
+        that re-route to surviving engines — then tell the manager this
+        endpoint is gone so the routing set shrinks NOW instead of at the
+        next heartbeat tick. Best-effort on the notify: the heartbeat is
+        the backstop."""
+        self.drain()
+        time.sleep(grace_s)  # let abort partials flush through open streams
+        if not self.manager_endpoint:
+            return
+        try:
+            import urllib.request
+
+            req = urllib.request.Request(
+                f"http://{self.manager_endpoint}/deregister_rollout_instance",
+                data=json.dumps({"endpoint": self.endpoint,
+                                 "drained": True}).encode(),
+                method="POST",
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=5.0):
+                pass
+        except Exception:  # noqa: BLE001 — heartbeat eviction backstops
+            log.warning("deregister with manager %s failed",
+                        self.manager_endpoint, exc_info=True)
+
+    def kill(self) -> None:
+        """Chaos: die WITHOUT notice. No drain, no salvage flush, no
+        manager notify — open streams break mid-chunk, new connections are
+        dropped, and the listener closes. Recovery is entirely the pool's
+        job (heartbeat eviction + manager continuation on survivors).
+        ``stop()`` still owns the eventual resource teardown."""
+        self._killed.set()
+        # wake blocked handler threads: their next queue item hits the
+        # killed check in _serialize_line and breaks the connection
+        self.abort_request(None)
+        threading.Thread(target=self._http.shutdown, daemon=True).start()
+
     def _serialize_line(self, rid: str, line: dict, abort_ev) -> str:
         """One outgoing NDJSON line; the fault injector may replace it
         (corruption), delay it (stall), or trip the abort event (kill)."""
+        if self._killed.is_set():
+            # dead engines don't speak: break the stream mid-chunk, exactly
+            # where a SIGKILLed process would have
+            raise BrokenPipeError("engine killed (chaos)")
         if self.fault is not None:
             replaced = self.fault.on_line(rid, line, abort_ev)
             if replaced is not None:
@@ -459,6 +525,10 @@ class RolloutServer:
                                 else self._queue.qsize()),
             "last_gen_throughput": self.engine.last_gen_throughput,
             "weight_version": self.engine.weight_version,
+            # preemption announcement: the manager's heartbeat reads this
+            # and pulls a draining engine out of the routing set before the
+            # next batch routes to it
+            "draining": self._draining.is_set(),
         }
         pc = getattr(self.engine, "prefix_cache", None)
         if pc is not None:

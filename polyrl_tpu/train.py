@@ -208,12 +208,20 @@ def _build_rollout(cfg: RunConfig, mcfg, params, tokenizer, cleanup: list):
         mgr.register_local_rollout_instances([local_server.endpoint])
         log.info("colocated local engine registered at %s",
                  local_server.endpoint)
+    # fleet control plane: membership sweeps for /statusz + pool/* step
+    # gauges, scale-up join gating, and preemption drills (rollout/pool.py)
+    from polyrl_tpu.rollout.pool import PoolManager
+
+    pool = PoolManager(mgr, cfg.rollout.pool)
+    cleanup.append(pool.close)
     return RemoteRollout(mgr, transfer=iface, local_server=local_server,
                          pad_token_id=pad,
                          resume_budget=cfg.rollout.resume_budget,
                          resume_wait_s=cfg.rollout.resume_wait_s,
                          salvage_partials=cfg.rollout.salvage_partials,
-                         fault_injector=fault)
+                         fault_injector=fault,
+                         balance_window=cfg.rollout.pool.balance_window,
+                         pool=pool)
 
 
 def _build_mesh(cfg: RunConfig):

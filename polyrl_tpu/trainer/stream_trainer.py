@@ -998,7 +998,9 @@ class StreamRLTrainer:
         if self._recorder is not None:
             counters.update(self._recorder.counters())
         gauges = {k: float(v) for k, v in rec.items()
-                  if k.startswith(("perf/", "training/", "manager/"))}
+                  if k.startswith(("perf/", "training/", "manager/",
+                                   "pool/"))}
+        pool = getattr(self.rollout, "pool", None)
         return statusz.build_snapshot(
             "trainer", step=self.global_step,
             goodput=self._goodput.snapshot(),
@@ -1011,7 +1013,8 @@ class StreamRLTrainer:
                      "version": float(getattr(self.rollout,
                                               "weight_version", 0)),
                      "staleness": float(rec.get(
-                         "perf/weight_staleness", 0.0))})
+                         "perf/weight_staleness", 0.0))},
+            pool=pool.statusz_section() if pool is not None else None)
 
     # -- fit --------------------------------------------------------------
 
@@ -1107,14 +1110,24 @@ class StreamRLTrainer:
                     # visible every step so a chaos event is observable in
                     # the step record
                     metrics.update_gauge(self.rollout.fault_counters())
+                    # balancer feed: raw scalars PLUS the goodput phase
+                    # walls the progressive estimator windows over —
+                    # generate (colocated gen) and update (actor+critic),
+                    # the two walls whose ratio decides how much
+                    # generation the trainer's update window can hide
+                    timings = metrics.timings()
+                    step_stats = dict(
+                        step_time_s=step_time,
+                        trainer_bubble_s=state["bubble"],
+                        throughput=throughput,
+                        generate_s=float(timings.get("gen", 0.0)),
+                        update_s=float(timings.get("update_actor", 0.0))
+                        + float(timings.get("update_critic", 0.0)))
                     if pipeline is not None:
                         # scrape + balancer round-trip ride the pipeline
                         # thread (off the hot path); their gauges land in
                         # the next consumed step's record
-                        pipeline.submit_step_stats(
-                            step_time_s=step_time,
-                            trainer_bubble_s=state["bubble"],
-                            throughput=throughput)
+                        pipeline.submit_step_stats(**step_stats)
                     else:
                         # per-step scrape of the manager's /metrics: pool
                         # health + queue depths + request totals land in the
@@ -1124,10 +1137,7 @@ class StreamRLTrainer:
                             self.rollout.scrape_manager_metrics())
                         # actuating metrics: the balancer returns the next
                         # local-generation budget (handlers.rs:867-901)
-                        resp = self.rollout.update_metrics(
-                            step_time_s=step_time,
-                            trainer_bubble_s=state["bubble"],
-                            throughput=throughput)
+                        resp = self.rollout.update_metrics(**step_stats)
                         if resp.get("max_local_gen_s"):
                             self._max_local_gen_s = float(
                                 resp["max_local_gen_s"])
@@ -1136,6 +1146,13 @@ class StreamRLTrainer:
                                     self._max_local_gen_s,
                                 "training/num_rollout_instances":
                                     float(resp.get("num_instances", 0))})
+                    # what the balancer actually saw (windowed medians +
+                    # offload fraction) and, with a PoolManager attached,
+                    # the pool membership counters — pool/* gauges in
+                    # every step record
+                    metrics.update_gauge(self.rollout.balance.metrics())
+                    if self.rollout.pool is not None:
+                        metrics.update_gauge(self.rollout.pool.counters())
                 self._maybe_validate(metrics,
                                      force=self.global_step >= cfg.total_steps)
                 if self._ckpt is not None and ckpt_lib.should_save_checkpoint(

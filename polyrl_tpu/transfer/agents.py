@@ -361,6 +361,11 @@ class SenderAgent:
         self.push_timeout_s = 600.0
         self.stream_push_timeout_s = 3600.0
         self._round_counter = 0  # unique per push attempt (stale-stream guard)
+        # elastic-pool telemetry: full pushes to instances this sender had
+        # never pushed before — the scale-up catch-up path (a late joiner
+        # registers, the idle poll finds it stale, it gets the CURRENT
+        # version in one round, then rides the normal push fan-out)
+        self.catchup_pushes = 0
         self._server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._server.bind((listen_host, 0))
@@ -613,6 +618,8 @@ class SenderAgent:
                 dt = time.monotonic() - t0
                 _send_json(reg.sock, {"event": "transfer_done",
                                       "status": "success", "version": version})
+            if reg.pushed_version < 0:
+                self.catchup_pushes += 1
             reg.pushed_version = version
             mbps = buffer.nbytes / max(dt, 1e-9) / 1e6
             # per-instance push duration distribution: one slow receiver

@@ -6,7 +6,12 @@ protocol tests.
 
 Failure injection: ``die_after_tokens`` makes the server emit N tokens then
 kill the stream mid-generation — exercising eviction + token-level
-continuation in the manager.
+continuation in the manager. ``kill()`` is whole-engine death WITHOUT
+notice (SIGKILL semantics: open streams break mid-line, every later
+request/heartbeat gets a dropped connection) and ``drain()`` is the
+graceful-preemption announcement (health_generate 503, server_info
+draining=true, new generates refused with an immediate abort terminal) —
+the elastic pool's scale-down drills.
 """
 
 from __future__ import annotations
@@ -30,6 +35,8 @@ class FakeEngine:
         self.weight_updates: list[int] = []
         self.aborted = threading.Event()
         self.shutdown_called = threading.Event()
+        self.killed = threading.Event()      # death without notice
+        self.draining = threading.Event()    # graceful preemption
         self.server: ThreadingHTTPServer | None = None
         self.port: int | None = None
         outer = self
@@ -47,8 +54,19 @@ class FakeEngine:
                 self.wfile.write(body)
 
             def do_GET(self):
-                if self.path == "/health" or self.path == "/health_generate":
+                if outer.killed.is_set():
+                    self.close_connection = True
+                    self.connection.close()
+                    return
+                if self.path == "/health":
                     if time.monotonic() - outer.started_at >= outer.healthy_after_s:
+                        self._json(200, {"status": "ok"})
+                    else:
+                        self._json(503, {"status": "starting"})
+                elif self.path == "/health_generate":
+                    if outer.draining.is_set():
+                        self._json(503, {"status": "draining"})
+                    elif time.monotonic() - outer.started_at >= outer.healthy_after_s:
                         self._json(200, {"status": "ok"})
                     else:
                         self._json(503, {"status": "starting"})
@@ -58,11 +76,16 @@ class FakeEngine:
                         "num_queued_reqs": 0,
                         "last_gen_throughput": 123.0,
                         "weight_version": outer.weight_updates[-1] if outer.weight_updates else -1,
+                        "draining": outer.draining.is_set(),
                     })
                 else:
                     self._json(404, {"error": "nope"})
 
             def do_POST(self):
+                if outer.killed.is_set():
+                    self.close_connection = True
+                    self.connection.close()
+                    return
                 length = int(self.headers.get("Content-Length", 0))
                 body = json.loads(self.rfile.read(length) or b"{}")
                 if self.path == "/generate":
@@ -74,6 +97,10 @@ class FakeEngine:
                 elif self.path == "/abort_request":
                     outer.aborted.set()
                     self._json(200, {"success": True})
+                elif self.path == "/drain":
+                    outer.draining.set()
+                    self._json(200, {"success": True, "draining": True,
+                                     "aborted": 0})
                 elif self.path == "/shutdown":
                     outer.shutdown_called.set()
                     self._json(200, {"success": True})
@@ -86,6 +113,21 @@ class FakeEngine:
                 input_ids = body.get("input_ids", [])
                 sp = body.get("sampling_params", {})
                 max_new = int(sp.get("max_new_tokens", 8))
+                if outer.draining.is_set():
+                    # drained engines refuse with an immediate abort
+                    # terminal — the manager's continuation re-routes
+                    # (rollout/server.py submit() drain semantics)
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/x-ndjson")
+                    self.send_header("Transfer-Encoding", "chunked")
+                    self.end_headers()
+                    line = json.dumps({"token_ids": [], "logprobs": [],
+                                       "finished": True,
+                                       "finish_reason": "abort"}) + "\n"
+                    data = line.encode()
+                    self.wfile.write(f"{len(data):x}\r\n".encode() + data
+                                     + b"\r\n0\r\n\r\n")
+                    return
                 self.send_response(200)
                 self.send_header("Content-Type", "application/x-ndjson")
                 self.send_header("Transfer-Encoding", "chunked")
@@ -99,6 +141,21 @@ class FakeEngine:
                 emitted = 0
                 # deterministic "generation": token = start + len(input) + step
                 for i in range(max_new):
+                    if outer.killed.is_set():
+                        # death without notice: break the stream mid-line
+                        self.connection.close()
+                        return
+                    if outer.draining.is_set():
+                        # graceful preemption mid-decode: abort terminal —
+                        # the already-streamed tokens are the salvaged
+                        # partial the manager's continuation resumes from
+                        line = json.dumps({
+                            "token_ids": [], "logprobs": [],
+                            "finished": True, "finish_reason": "abort",
+                        }) + "\n"
+                        chunk(line)
+                        self.wfile.write(b"0\r\n\r\n")
+                        return
                     if outer.die_after_tokens >= 0 and emitted >= outer.die_after_tokens:
                         # simulate instance death: kill the socket mid-stream
                         self.wfile.flush()
@@ -132,6 +189,21 @@ class FakeEngine:
         self.port = self.server.server_address[1]
         threading.Thread(target=self.server.serve_forever, daemon=True).start()
         return self
+
+    def drain(self):
+        """Graceful preemption announcement (also reachable via POST
+        /drain): serving health gate fails, new generates abort, the
+        heartbeat pulls this engine from the routing set."""
+        self.draining.set()
+
+    def kill(self):
+        """Die WITHOUT notice: every open stream breaks mid-line and every
+        later connection is dropped — the manager must detect this by
+        heartbeat timeout and evict."""
+        self.killed.set()
+        if self.server:
+            threading.Thread(target=self.server.shutdown,
+                             daemon=True).start()
 
     def stop(self):
         if self.server:

@@ -1,0 +1,332 @@
+"""Elastic-pool state machine: register → route → drain → evict → rejoin
+against stub engines and the real C++ manager (quick tier — the protocol
+surface is HTTP + fakes, no jax).
+
+Covers the membership lifecycle the elastic pool layer adds on top of the
+PR 1–5 primitives: heartbeat-timeout eviction (death WITHOUT notice),
+drain announcements pulling an engine from the routing set (preemption as
+a normal event), the weight-bootstrap gate on scale-up, and the
+/reconcile pool-membership replay that keeps a manager respawn from
+orphaning a healthy fleet. BalanceEstimator and PoolManager units ride
+along.
+"""
+
+import time
+
+import pytest
+
+from polyrl_tpu.manager.client import (GenerateResult, ManagerClient,
+                                       spawn_rollout_manager)
+from polyrl_tpu.rollout.pool import BalanceEstimator, PoolConfig, PoolManager
+from polyrl_tpu.rollout.remote import RemoteRollout
+from polyrl_tpu.rollout.sampling import SamplingParams
+from tests.fake_engine import FakeEngine
+
+_FAST_ARGS = ["--health-check-interval-s", "0.1",
+              "--stats-poll-interval-s", "0.1",
+              "--heartbeat-failures", "2",
+              "--generate-timeout-ms", "10000",
+              "--schedule-wait-timeout-ms", "5000"]
+
+
+@pytest.fixture()
+def manager():
+    proc, port = spawn_rollout_manager("127.0.0.1:0", extra_args=_FAST_ARGS)
+    client = ManagerClient(f"127.0.0.1:{port}")
+    client.wait_healthy()
+    yield client
+    proc.kill()
+
+
+def _wait(pred, deadline=10.0, msg="condition"):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < deadline:
+        if pred():
+            return
+        time.sleep(0.05)
+    raise TimeoutError(f"never saw: {msg}")
+
+
+def _inst(client, endpoint):
+    for i in client.get_instances_status()["instances"]:
+        if i["endpoint"] == endpoint:
+            return i
+    return None
+
+
+def _finals(stream):
+    return [r for r in stream if isinstance(r, GenerateResult)]
+
+
+# -- lifecycle: register → route → drain → evict → rejoin --------------------
+
+
+def test_register_drain_evict_rejoin_lifecycle(manager):
+    pool = PoolManager(manager, PoolConfig(drain_grace_s=0.1))
+    a = FakeEngine(start_token=1000).start()
+    b = FakeEngine(start_token=1000).start()
+    try:
+        for e in (a, b):
+            manager.register_rollout_instance(e.endpoint)
+        pool.wait_for_size(2)
+        st = manager.get_instances_status()
+        assert st["pool"]["joins"] >= 2
+        assert st["pool"]["active"] == 2
+
+        # route: requests complete against the 2-engine routing set
+        res = manager.generate("r1", [1, 2], {"max_new_tokens": 3})
+        assert res.success and res.output_token_ids == [1002, 1003, 1004]
+
+        # drain announcement (engine-side): the heartbeat reads
+        # server_info.draining and pulls A from the routing set
+        a.drain()
+        _wait(lambda: not (_inst(manager, a.endpoint) or {}).get(
+            "active", True), msg="A out of routing set after drain")
+        assert manager.get_instances_status()["pool"]["drain_departures"] >= 1
+        # requests still complete (B serves)
+        res = manager.generate("r2", [1, 2, 3], {"max_new_tokens": 2})
+        assert res.success and res.output_token_ids == [1003, 1004]
+
+        # death WITHOUT notice: heartbeat misses evict A entirely
+        a.kill()
+        _wait(lambda: _inst(manager, a.endpoint) is None,
+              msg="A evicted after heartbeat timeout")
+        assert manager.get_instances_status()["pool"]["evictions"] >= 1
+
+        # rejoin: a replacement registers mid-run and the pool recovers
+        a2 = FakeEngine(start_token=1000).start()
+        try:
+            pool.add_engine(endpoint=a2.endpoint, deadline_s=10.0)
+            pool.wait_for_size(2)
+            counters = pool.counters()
+            assert counters["pool/active"] == 2.0
+            assert counters["pool/evictions"] >= 1.0
+            assert counters["pool/joins"] >= 3.0
+        finally:
+            a2.stop()
+    finally:
+        pool.close()
+        a.stop()
+        b.stop()
+
+
+def test_drain_mid_batch_salvages_to_survivor(manager):
+    """The routed-before-drain race: requests are in flight on A when the
+    preemption notice lands. A aborts them into partials (tokens already
+    streamed), the manager's continuation resumes them token-exactly on B
+    — zero re-decoding, zero dropped groups (the PR 4 submit re-check
+    pattern, now exercised ACROSS engines)."""
+    a = FakeEngine(start_token=1000, token_delay_s=0.05).start()
+    b = FakeEngine(start_token=1000).start()
+    try:
+        for e in (a, b):
+            manager.register_rollout_instance(e.endpoint)
+        _wait(lambda: sum(i["healthy"] for i in
+                          manager.get_instances_status()["instances"]) >= 2,
+              msg="2 healthy engines")
+        rr = RemoteRollout(manager, resume_budget=2, resume_wait_s=10.0)
+        max_new = 12
+        sampling = SamplingParams(max_new_tokens=max_new, stop_token_ids=())
+        got = []
+        drained = False
+        drain_at = time.monotonic() + 0.2  # mid-first-wave decode on A
+        for chunk in rr.generate_stream([[1, 2]] * 6, sampling,
+                                        group_size=2, min_emit=2):
+            for i, res in chunk:
+                got.append(i)
+                assert res.success
+                # deterministic continuation: the stitched sequence equals
+                # the uninterrupted one token-for-token
+                assert res.output_token_ids == [1000 + 2 + j
+                                                for j in range(max_new)]
+            if not drained and time.monotonic() >= drain_at:
+                a.drain()
+                drained = True
+        assert sorted(got) == list(range(6))
+        assert rr.dropped_groups == 0
+    finally:
+        a.stop()
+        b.stop()
+
+
+# -- scale-up: the weight-bootstrap gate -------------------------------------
+
+
+def test_late_joiner_gated_until_weight_catchup(manager):
+    """With a weight fabric registered, a late joiner passes health but
+    stays OUT of the routing set until its weight version reaches the pool
+    floor; completing the catch-up push admits it."""
+    manager.update_weight_senders(["127.0.0.1:1"])  # fabric exists, no poll
+    v = manager.update_weight_version()
+    assert v == 1
+    eng = FakeEngine().start()
+    try:
+        manager.register_rollout_instance(eng.endpoint)
+        _wait(lambda: (_inst(manager, eng.endpoint) or {}).get("healthy"),
+              msg="healthy")
+        time.sleep(0.3)  # several heartbeat ticks: gate must HOLD
+        inst = _inst(manager, eng.endpoint)
+        assert inst["healthy"] and not inst["active"], inst
+        # catch-up push lands (manager → engine load → version record)
+        out = manager.update_weights([eng.endpoint], weight_version=v)
+        assert out["results"][0]["success"]
+        _wait(lambda: (_inst(manager, eng.endpoint) or {}).get("active"),
+              msg="active after catch-up")
+        assert eng.weight_updates == [1]
+    finally:
+        eng.stop()
+
+
+def test_reconcile_replays_pool_membership_and_is_idempotent():
+    """A manager respawn must not orphan a healthy, caught-up fleet: the
+    /reconcile replay carries per-engine weight versions, so an engine at
+    the pool floor re-enters the routing set without waiting for a
+    redundant weight bootstrap. Double replay is a no-op."""
+    eng = FakeEngine().start()
+    proc, port = spawn_rollout_manager("127.0.0.1:0", extra_args=_FAST_ARGS)
+    client = ManagerClient(f"127.0.0.1:{port}")
+    try:
+        client.wait_healthy()
+        payload = dict(remote_endpoints=[eng.endpoint], local_endpoints=[],
+                       senders=["127.0.0.1:1"], groups_per_sender=1,
+                       weight_version=3,
+                       instance_versions={eng.endpoint: 3})
+        out = client.reconcile(**payload)
+        assert out["added_remote"] == 1
+        assert out["weight_version"] == 3
+        # health check passes → straight to ACTIVE (version == floor),
+        # despite the registered sender fabric
+        _wait(lambda: (_inst(client, eng.endpoint) or {}).get("active"),
+              msg="replayed engine active without re-bootstrap")
+        assert _inst(client, eng.endpoint)["weight_version"] == 3
+        # double replay: idempotent — endpoint kept, version not rewound,
+        # still active
+        out2 = client.reconcile(**payload)
+        assert out2["added_remote"] == 0 and out2["kept"] >= 1
+        assert out2["weight_version"] == 3
+        inst = _inst(client, eng.endpoint)
+        assert inst["active"] and inst["weight_version"] == 3
+        # a STALE replay can only raise, never rewind
+        stale = dict(payload, weight_version=2,
+                     instance_versions={eng.endpoint: 1})
+        out3 = client.reconcile(**stale)
+        assert out3["weight_version"] == 3
+        assert _inst(client, eng.endpoint)["weight_version"] == 3
+    finally:
+        proc.kill()
+        eng.stop()
+
+
+def test_supervisor_records_pool_membership():
+    """Desired-state bookkeeping for the replay (no manager spawned)."""
+    from polyrl_tpu.manager.supervisor import ManagerSupervisor
+
+    sup = ManagerSupervisor()
+    sup.record_remote_instances(["e1:1", "e2:2"])
+    sup.record_instance_version("e1:1", 4)
+    sup.record_instance_version("e1:1", 2)   # stale: ignored
+    sup.record_instance_version("e2:2", -1)  # never pushed: ignored
+    assert sup._desired["instance_versions"] == {"e1:1": 4}
+    sup.forget_instance("e1:1")
+    assert sup._desired["instance_versions"] == {}
+    assert "e1:1" not in sup._desired["remote"]
+    assert "e2:2" in sup._desired["remote"]
+
+
+# -- PoolManager drills ------------------------------------------------------
+
+
+def test_pool_manager_preempt_drill(manager):
+    """Scale-down as a drill: preempt() drains the engine (it refuses new
+    admissions), deregisters it gracefully, and the pool counters book a
+    drain departure — not an eviction."""
+    a = FakeEngine().start()
+    b = FakeEngine().start()
+    pool = PoolManager(manager, PoolConfig(drain_grace_s=0.05))
+    try:
+        for e in (a, b):
+            manager.register_rollout_instance(e.endpoint)
+        pool.wait_for_size(2)
+        pool.preempt(a.endpoint)
+        assert a.draining.is_set()
+        _wait(lambda: _inst(manager, a.endpoint) is None,
+              msg="preempted engine deregistered")
+        counters = pool.counters()
+        assert counters["pool/drain_departures"] >= 1.0
+        assert counters["pool/preemption_drills"] == 1.0
+        assert counters["pool/active"] == 1.0
+        # requests keep completing on the survivor
+        res = manager.generate("r3", [9], {"max_new_tokens": 2})
+        assert res.success
+    finally:
+        pool.close()
+        a.stop()
+        b.stop()
+
+
+def test_pool_manager_statusz_section(manager):
+    eng = FakeEngine().start()
+    pool = PoolManager(manager)
+    try:
+        manager.register_rollout_instance(eng.endpoint)
+        pool.wait_for_size(1)
+        section = pool.statusz_section()
+        assert section["counts"]["active"] == 1.0
+        (row,) = section["engines"]
+        assert row["endpoint"] == eng.endpoint
+        assert row["healthy"] and row["active"] and not row["draining"]
+    finally:
+        pool.close()
+        eng.stop()
+
+
+# -- BalanceEstimator --------------------------------------------------------
+
+
+def test_balance_estimator_windows_out_anomalies():
+    est = BalanceEstimator(window=5)
+    for _ in range(4):
+        est.observe(step_time_s=10.0, trainer_bubble_s=2.0, throughput=100.0,
+                    generate_s=3.0, update_s=4.0)
+    # one anomalous step (a preemption drill): the median feed barely moves
+    est.observe(step_time_s=90.0, trainer_bubble_s=40.0, throughput=5.0,
+                generate_s=3.0, update_s=4.0)
+    stats = est.stats()
+    assert stats["step_time_s"] == 10.0
+    assert stats["trainer_bubble_s"] == 2.0
+    assert stats["throughput"] == 100.0
+    m = est.metrics()
+    assert m["pool/balance_window_steps"] == 5.0
+    # offload fraction: (gen + bubble) / (gen + bubble + update)
+    assert m["pool/balance_offload_frac"] == pytest.approx(5.0 / 9.0)
+
+
+def test_balance_estimator_empty_and_passthrough():
+    est = BalanceEstimator(window=3)
+    assert est.stats() == {}
+    assert est.metrics() == {}
+    # whole stats dicts pass through: unknown keys ignored
+    est.observe(step_time_s=1.0, trainer_bubble_s=0.5, throughput=10.0,
+                num_instances=3, anything_else="ok")
+    assert est.stats()["step_time_s"] == 1.0
+
+
+def test_remote_rollout_feeds_balancer_medians():
+    """update_metrics forwards windowed medians (and strips the
+    estimator-only phase walls) to the manager."""
+    calls = []
+
+    class _Mgr:
+        def update_metrics(self, **stats):
+            calls.append(stats)
+            return {"max_local_gen_s": 42.0}
+
+    rr = RemoteRollout(_Mgr(), balance_window=3)
+    rr.update_metrics(step_time_s=10.0, trainer_bubble_s=1.0,
+                      throughput=50.0, generate_s=2.0, update_s=3.0)
+    rr.update_metrics(step_time_s=20.0, trainer_bubble_s=3.0,
+                      throughput=70.0, generate_s=2.0, update_s=3.0)
+    assert calls[-1]["step_time_s"] == 15.0     # median of {10, 20}
+    assert calls[-1]["trainer_bubble_s"] == 2.0
+    assert "generate_s" not in calls[-1] and "update_s" not in calls[-1]
+    assert rr.balance.metrics()["pool/balance_update_s"] == 3.0

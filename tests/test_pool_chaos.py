@@ -1,0 +1,178 @@
+"""Quick-tier pool chaos e2e: 1 trainer + 2 fake engines on CPU.
+
+The FaultInjector SIGKILLs one engine mid-batch (death without notice —
+broken streams, dropped connections) and kills the trainer-side manager
+stream once at the worst moment; a replacement engine joins two steps
+later. The fit must complete with ZERO dropped rollout groups (manager
+eviction + token-level continuation on the survivor, client-side salvage
+ledger for the stream kill), ``fault/suffix_resumes > 0`` in the step
+records, and the pool back at 2 active engines in the trainer's /statusz
+pool section.
+
+A separate generate_stream-level test pins EXACT stitched sequences
+across the engine kill — the PR 4 salvage invariants hold across
+*engines*, not just within one.
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+
+from polyrl_tpu.data.dataset import PromptDataLoader, make_arithmetic_dataset
+from polyrl_tpu.manager.client import ManagerClient, spawn_rollout_manager
+from polyrl_tpu.models import decoder
+from polyrl_tpu.rewards.manager import load_reward_manager
+from polyrl_tpu.rollout.faults import FaultInjectionConfig, FaultInjector
+from polyrl_tpu.rollout.pool import PoolConfig, PoolManager
+from polyrl_tpu.rollout.remote import RemoteRollout
+from polyrl_tpu.rollout.sampling import SamplingParams
+from polyrl_tpu.trainer.actor import ActorConfig, StreamActor
+from polyrl_tpu.trainer.stream_trainer import StreamRLTrainer, TrainerConfig
+from polyrl_tpu.utils.tokenizer import ByteTokenizer
+from tests.fake_engine import FakeEngine
+
+_FAST_ARGS = ["--health-check-interval-s", "0.1",
+              "--stats-poll-interval-s", "0.1",
+              "--heartbeat-failures", "2",
+              "--generate-timeout-ms", "10000",
+              "--schedule-wait-timeout-ms", "5000"]
+
+
+class _JoinAtStep:
+    """Minimal trainer logger that registers a replacement engine when a
+    given global step's record is logged (between steps, on the trainer
+    thread — the scale-up drill's 'two steps later')."""
+
+    def __init__(self, pool: PoolManager, at_step: int, start_token: int):
+        self.pool = pool
+        self.at_step = at_step
+        self.start_token = start_token
+        self.joined: FakeEngine | None = None
+
+    def log(self, record, step=None):
+        if self.joined is None and step is not None and step >= self.at_step:
+            self.joined = FakeEngine(start_token=self.start_token,
+                                     token_delay_s=0.005).start()
+            self.pool.add_engine(endpoint=self.joined.endpoint, wait=False)
+
+
+def test_pool_chaos_fit_survives_engine_kill_and_rejoin():
+    proc, port = spawn_rollout_manager("127.0.0.1:0", extra_args=_FAST_ARGS)
+    mgr = ManagerClient(f"127.0.0.1:{port}")
+    # start_token 30: FakeEngine tokens stay far below the tiny model's
+    # 512-entry vocab, so the actor trains on them like real samples
+    eng_a = FakeEngine(start_token=30, token_delay_s=0.01).start()
+    eng_b = FakeEngine(start_token=30, token_delay_s=0.005).start()
+    injector = FaultInjector(FaultInjectionConfig(
+        enabled=True,
+        engine_kill_times=1, engine_kill_min_progress=4,
+        stream_kill_times=1, stream_kill_min_progress=1))
+    injector.engine_killer = eng_a.kill
+    pool = PoolManager(mgr, PoolConfig(drain_grace_s=0.1))
+    joiner = _JoinAtStep(pool, at_step=2, start_token=30)
+    try:
+        mgr.wait_healthy()
+        for e in (eng_a, eng_b):
+            mgr.register_rollout_instance(e.endpoint)
+        pool.wait_for_size(2)
+
+        tok = ByteTokenizer()
+        cfg = decoder.get_config("tiny", dtype=jnp.float32)
+        params = decoder.init_params(jax.random.PRNGKey(0), cfg)
+        remote = RemoteRollout(mgr, pad_token_id=tok.pad_token_id,
+                               resume_budget=3, resume_wait_s=10.0,
+                               fault_injector=injector, pool=pool)
+        tcfg = TrainerConfig(
+            train_batch_size=4, rollout_n=2, ppo_mini_batch_size=8,
+            micro_batch_size=4, min_stream_batch_size=4,
+            max_prompt_length=16, max_response_length=8,
+            adv_estimator="grpo", total_steps=4, temperature=1.0)
+        actor = StreamActor(cfg, ActorConfig(lr=1e-4, remat=False), params)
+        trainer = StreamRLTrainer(
+            tcfg, actor, remote, tok,
+            load_reward_manager("naive", tok, num_workers=1),
+            PromptDataLoader(make_arithmetic_dataset(32), 4),
+            logger=joiner)
+        history = trainer.fit()
+
+        assert len(history) == 4
+        # the headline: chaos cost throughput, never training data
+        assert remote.dropped_groups == 0
+        assert injector.engine_kills == 1
+        assert injector.stream_kills == 1
+        counters = remote.fault_counters()
+        assert counters["fault/suffix_resumes"] >= 1
+        assert counters["fault/tokens_salvaged"] >= 1
+        assert counters["fault/dropped_groups"] == 0
+        # step records carry the pool + balance gauges
+        last = history[-1]
+        assert last["fault/injected_engine_kills"] == 1.0
+        assert last["pool/balance_window_steps"] >= 1.0
+        assert "pool/evictions" in last
+        # the dead engine was evicted by heartbeat timeout...
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < 10:
+            if pool.counters()["pool/evictions"] >= 1:
+                break
+            time.sleep(0.1)
+        assert pool.counters(refresh=False)["pool/evictions"] >= 1
+        # ...and the replacement joined: pool size back to 2, visible in
+        # the trainer's /statusz pool section
+        assert joiner.joined is not None
+        pool.wait_for_size(2, deadline_s=10.0)
+        snap = trainer.statusz_snapshot()
+        assert snap["pool"]["counts"]["active"] == 2.0
+        alive = {r["endpoint"] for r in snap["pool"]["engines"]
+                 if r["active"]}
+        assert alive == {eng_b.endpoint, joiner.joined.endpoint}
+    finally:
+        proc.kill()
+        pool.close()
+        for e in (eng_a, eng_b, joiner.joined):
+            if e is not None:
+                e.stop()
+
+
+def test_engine_kill_mid_stream_exact_sequences():
+    """Salvage invariants ACROSS engines: kill engine A while requests are
+    provably mid-decode on the pool; every stitched sequence must equal
+    the uninterrupted one token-for-token (manager continuation re-prefills
+    prompt+partial on the survivor and re-decodes nothing)."""
+    proc, port = spawn_rollout_manager("127.0.0.1:0", extra_args=_FAST_ARGS)
+    mgr = ManagerClient(f"127.0.0.1:{port}")
+    eng_a = FakeEngine(start_token=1000, token_delay_s=0.05).start()
+    eng_b = FakeEngine(start_token=1000).start()
+    injector = FaultInjector(FaultInjectionConfig(
+        enabled=True, engine_kill_times=1, engine_kill_min_progress=6))
+    injector.engine_killer = eng_a.kill
+    try:
+        mgr.wait_healthy()
+        for e in (eng_a, eng_b):
+            mgr.register_rollout_instance(e.endpoint)
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < 10:
+            st = mgr.get_instances_status()
+            if sum(i["healthy"] for i in st["instances"]) >= 2:
+                break
+            time.sleep(0.1)
+        rr = RemoteRollout(mgr, resume_budget=2, resume_wait_s=10.0,
+                           fault_injector=injector)
+        max_new = 12
+        sampling = SamplingParams(max_new_tokens=max_new, stop_token_ids=())
+        got = []
+        for chunk in rr.generate_stream([[1, 2, 3]] * 6, sampling,
+                                        group_size=2, min_emit=2):
+            for i, res in chunk:
+                got.append(i)
+                assert res.success
+                assert res.output_token_ids == [1000 + 3 + j
+                                                for j in range(max_new)]
+                assert len(res.output_token_logprobs) == max_new
+        assert sorted(got) == list(range(6))
+        assert injector.engine_kills == 1
+        assert rr.dropped_groups == 0
+    finally:
+        proc.kill()
+        eng_a.stop()
+        eng_b.stop()

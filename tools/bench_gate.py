@@ -56,6 +56,12 @@ WATCHED_EXTRA = (
     ("weight_sync.eff_mb_s", True),
     ("weight_sync.total_s", False),
     ("spec.speedup_continuation", True),
+    # elastic-pool topology (bench.py --pool N): aggregate throughput must
+    # hold, the preemption/rejoin drill must not slow down, and a round
+    # that silently shrank its pool is a regression
+    ("pool.tok_s", True),
+    ("pool.pool_engines", True),
+    ("pool.recovery_s", False),
 )
 
 

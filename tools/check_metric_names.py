@@ -62,6 +62,7 @@ NAMESPACES = frozenset({
     "training",      # step counter / balancer budget
     "fault",         # control-plane + salvage fault counters
     "manager",       # scraped manager gauges + client RTT
+    "pool",          # elastic-pool membership + balance estimator gauges
     "rollout",       # rollout-plane latency/throughput distributions
     "transfer",      # weight-fabric pack/push timings
     "prefix_cache",  # engine prefix-cache hit telemetry
