@@ -380,6 +380,12 @@ def bench_cb(cfg, params, batch, prompt_len, new_tokens, max_slots=64,
     sampler_t.join(timeout=5.0)  # before del engine: the closure reads it
     serve_tokens = sum(counts)
     ttft_ok = [t for t in ttfts if t]  # failed/zero-token requests excluded
+    # server-side flight-deck readout (engine ledger): occupancy, page
+    # pressure, cache hit rate, and the server-measured TTFT/TPOT tails —
+    # the numbers the client-side ttft_* above cannot see (queue wait vs
+    # prefill split, decode interval). Captured before stop() tears the
+    # engine down.
+    srv_info = server.server_info()
     server.stop()
     trace = {k: round(v, 3) for k, v in sorted(engine.trace_report().items())}
     del engine
@@ -407,6 +413,24 @@ def bench_cb(cfg, params, batch, prompt_len, new_tokens, max_slots=64,
         "req_p50_s": round(req_hist.percentile(50.0), 3),
         "req_p95_s": round(req_hist.percentile(95.0), 3),
         "req_p99_s": round(req_hist.percentile(99.0), 3),
+        # engine flight deck (server_info): mean decode occupancy over the
+        # run's dispatches, peak page-pool utilization, prefix-cache hit
+        # rate, server-side latency tails, and the token-accounting
+        # reconciliation ratio (1.0 = every scheduled token attributed)
+        "engine_occupancy": round(float(srv_info.get("occupancy_mean",
+                                                     0.0)), 4),
+        "engine_page_util_peak": round(float(srv_info.get("page_util_peak",
+                                                          0.0)), 4),
+        "engine_cache_hit_rate": round(float(srv_info.get(
+            "prefix_cache/hit_rate", 0.0)), 4),
+        "engine_ttft_p95_ms": round(1e3 * float(srv_info.get("ttft_p95_s",
+                                                             0.0)), 1),
+        "engine_tpot_p95_ms": round(1e3 * float(srv_info.get("tpot_p95_s",
+                                                             0.0)), 2),
+        "engine_queue_wait_p95_ms": round(1e3 * float(srv_info.get(
+            "queue_wait_p95_s", 0.0)), 1),
+        "engine_attributed_frac": round(float(srv_info.get(
+            "attributed_frac", 0.0)), 4),
     }
 
 
@@ -1139,6 +1163,14 @@ def assemble_result(state: dict) -> dict:
             shootout, note="v0/cb at the headline workload; spec at b64; "
                            "v0 is BEST-OF-2 reps (drift diagnosis), cb/spec "
                            "single-rep — per-phase entries carry configs")
+    # promote the serving plane's flight-deck readout to top-level
+    # extra.engine_* so bench_gate watches it across rounds
+    for k in ("engine_occupancy", "engine_page_util_peak",
+              "engine_cache_hit_rate", "engine_ttft_p95_ms",
+              "engine_tpot_p95_ms", "engine_attributed_frac"):
+        v = cb.get(k)
+        if isinstance(v, (int, float)) and not isinstance(v, bool):
+            extra[k] = v
     meta = state.get("meta") or {}
     preset = meta.get("preset", "qwen3-1.7b")
     batch = meta.get("batch", 256)

@@ -344,6 +344,18 @@ class Manager {
               inst->num_running_reqs = info["num_running_reqs"].as_int();
               inst->num_queued_reqs = info["num_queued_reqs"].as_int();
               inst->last_gen_throughput = info["last_gen_throughput"].as_num();
+              // engine flight-deck forwarding: optional fields (absent on
+              // pre-flight-deck engines) — only overwrite when reported
+              auto fwd = [&](const char* key, std::atomic<double>& dst) {
+                if (info[key].is_num()) dst = info[key].as_num();
+              };
+              fwd("occupancy", inst->occupancy);
+              fwd("page_util", inst->page_util);
+              fwd("ttft_p95_s", inst->ttft_p95_s);
+              fwd("tpot_p95_s", inst->tpot_p95_s);
+              fwd("prefix_cache/hit_rate", inst->cache_hit_rate);
+              fwd("spec_accept_rate", inst->spec_accept_rate);
+              fwd("attributed_frac", inst->attributed_frac);
               if (info["draining"].as_bool() && !inst->draining.load()) {
                 log_line("instance " + inst->endpoint +
                          " announced draining; leaving routing set");
@@ -468,6 +480,14 @@ void register_routes(phttp::Server& server, Manager& mgr) {
       o["draining"] = Value(inst->draining.load());
       o["heartbeat_misses"] = Value(inst->heartbeat_misses.load());
       o["active"] = Value(state.is_active(inst->endpoint));
+      o["last_gen_throughput"] = Value(inst->last_gen_throughput.load());
+      o["occupancy"] = Value(inst->occupancy.load());
+      o["page_util"] = Value(inst->page_util.load());
+      o["ttft_p95_s"] = Value(inst->ttft_p95_s.load());
+      o["tpot_p95_s"] = Value(inst->tpot_p95_s.load());
+      o["cache_hit_rate"] = Value(inst->cache_hit_rate.load());
+      o["spec_accept_rate"] = Value(inst->spec_accept_rate.load());
+      o["attributed_frac"] = Value(inst->attributed_frac.load());
       arr.push_back(Value(std::move(o)));
     }
     Object top;
@@ -507,6 +527,8 @@ void register_routes(phttp::Server& server, Manager& mgr) {
     };
     auto insts = state.all_instances();
     long healthy = 0, local_n = 0, running = 0, queued = 0;
+    double occ_sum = 0.0, page_util_max = 0.0, tput_sum = 0.0;
+    long occ_n = 0;
     std::string per;
     for (auto& inst : insts) {
       if (inst->healthy.load()) healthy++;
@@ -519,6 +541,24 @@ void register_routes(phttp::Server& server, Manager& mgr) {
              esc(inst->endpoint) + "\"} " + std::to_string(r) + "\n";
       per += "polyrl_mgr_instance_queued_reqs{endpoint=\"" +
              esc(inst->endpoint) + "\"} " + std::to_string(q) + "\n";
+      // engine flight-deck per-instance load view (the "why is decode
+      // occupancy low on engine 3" answer, labeled by endpoint)
+      per += "polyrl_mgr_instance_occupancy{endpoint=\"" +
+             esc(inst->endpoint) + "\"} " +
+             std::to_string(inst->occupancy.load()) + "\n";
+      per += "polyrl_mgr_instance_page_util{endpoint=\"" +
+             esc(inst->endpoint) + "\"} " +
+             std::to_string(inst->page_util.load()) + "\n";
+      per += "polyrl_mgr_instance_ttft_p95_s{endpoint=\"" +
+             esc(inst->endpoint) + "\"} " +
+             std::to_string(inst->ttft_p95_s.load()) + "\n";
+      if (inst->healthy.load()) {
+        occ_sum += inst->occupancy.load();
+        ++occ_n;
+        if (inst->page_util.load() > page_util_max)
+          page_util_max = inst->page_util.load();
+        tput_sum += inst->last_gen_throughput.load();
+      }
     }
     std::string body;
     body += "# TYPE polyrl_mgr_instances gauge\npolyrl_mgr_instances " +
@@ -549,8 +589,22 @@ void register_routes(phttp::Server& server, Manager& mgr) {
             std::to_string(running) + "\n";
     body += "# TYPE polyrl_mgr_queued_reqs gauge\npolyrl_mgr_queued_reqs " +
             std::to_string(queued) + "\n";
+    // fleet flight-deck aggregates: mean occupancy over healthy engines,
+    // worst page-pool pressure, summed decode throughput
+    body += "# TYPE polyrl_mgr_fleet_occupancy gauge\n"
+            "polyrl_mgr_fleet_occupancy " +
+            std::to_string(occ_n ? occ_sum / occ_n : 0.0) + "\n";
+    body += "# TYPE polyrl_mgr_fleet_page_util gauge\n"
+            "polyrl_mgr_fleet_page_util " + std::to_string(page_util_max) +
+            "\n";
+    body += "# TYPE polyrl_mgr_fleet_throughput_tok_s gauge\n"
+            "polyrl_mgr_fleet_throughput_tok_s " + std::to_string(tput_sum) +
+            "\n";
     body += "# TYPE polyrl_mgr_instance_running_reqs gauge\n";
     body += "# TYPE polyrl_mgr_instance_queued_reqs gauge\n";
+    body += "# TYPE polyrl_mgr_instance_occupancy gauge\n";
+    body += "# TYPE polyrl_mgr_instance_page_util gauge\n";
+    body += "# TYPE polyrl_mgr_instance_ttft_p95_s gauge\n";
     body += per;
     long total_reqs = 0;
     std::string per_route;

@@ -43,6 +43,19 @@ struct Instance {
   // until it deregisters or its heartbeat lapses
   std::atomic<int64_t> heartbeat_misses{0};
   std::atomic<bool> draining{false};
+  // engine flight-deck telemetry (stats poller forwards from server_info):
+  // decode slot occupancy (EWMA), page-pool utilization, server-side
+  // latency tails, prefix-cache hit rate, speculative acceptance, and the
+  // token-accounting reconciliation ratio — the per-engine load signals a
+  // placement layer needs beyond num_running_reqs. Engines that predate
+  // the flight deck simply never write them (zeros / frac 1.0).
+  std::atomic<double> occupancy{0.0};
+  std::atomic<double> page_util{0.0};
+  std::atomic<double> ttft_p95_s{0.0};
+  std::atomic<double> tpot_p95_s{0.0};
+  std::atomic<double> cache_hit_rate{0.0};
+  std::atomic<double> spec_accept_rate{0.0};
+  std::atomic<double> attributed_frac{1.0};
 };
 
 using InstancePtr = std::shared_ptr<Instance>;

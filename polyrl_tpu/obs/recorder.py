@@ -100,9 +100,13 @@ class AnomalyDetector:
 
 
 # step-record keys the recorder watches by default: wall step time (a
-# stall spikes it) and the rollout plane's decode throughput (a sick pool
-# collapses it)
-DEFAULT_WATCH = ("perf/step_time_s", "perf/rollout_throughput_tok_s")
+# stall spikes it), the rollout plane's decode throughput (a sick pool
+# collapses it), and the fleet flight-deck gauges (PoolManager.counters) —
+# a decode-occupancy collapse or page-pool exhaustion on any engine is an
+# anomaly even while aggregate throughput still looks alive. Keys absent
+# from the step record (no pool attached) are simply never fed.
+DEFAULT_WATCH = ("perf/step_time_s", "perf/rollout_throughput_tok_s",
+                 "engine/occupancy", "engine/page_util")
 
 
 class FlightRecorder:
@@ -128,6 +132,10 @@ class FlightRecorder:
         # optional zero-arg callable returning cumulative fault counters
         # (RemoteRollout.fault_counters) folded into every bundle
         self.counters_fn = None
+        # optional zero-arg callable returning the fleet flight-deck view
+        # (PoolManager.engine_section) — written as engine.json so the
+        # bundle shows per-engine occupancy/page pressure at anomaly time
+        self.engine_fn = None
 
     # -- step stream ---------------------------------------------------------
 
@@ -190,6 +198,15 @@ class FlightRecorder:
                     counters = dict(self.counters_fn())
                 except Exception:  # noqa: BLE001 — counters are best-effort
                     log.exception("flight recorder counters_fn failed")
+            if self.engine_fn is not None:
+                try:
+                    engine_view = dict(self.engine_fn())
+                except Exception:  # noqa: BLE001 — best-effort like counters
+                    log.exception("flight recorder engine_fn failed")
+                    engine_view = {}
+                if engine_view:
+                    with open(os.path.join(path, "engine.json"), "w") as f:
+                        json.dump(engine_view, f, indent=2)
             with open(os.path.join(path, "counters.json"), "w") as f:
                 json.dump({
                     "reason": reason,

@@ -8,7 +8,8 @@ trainer mounts a standalone :class:`StatuszServer` (it has no HTTP surface
 of its own); the rollout server mounts ``/statusz`` as a route on its
 existing listener (rollout/server.py).
 
-Schema (``polyrl/statusz/v1`` — additive evolution only):
+Schema (``polyrl/statusz/v2`` — additive evolution only; v2 added the
+``engine`` section):
 
 - ``role``      — ``trainer`` | ``rollout``
 - ``pid`` / ``time_unix_s`` / ``uptime_s``
@@ -22,6 +23,14 @@ Schema (``polyrl/statusz/v1`` — additive evolution only):
 - ``weights``   — weight version / push count / staleness
 - ``pool``      — elastic-pool membership (engines + lifecycle counts;
   trainer role with a PoolManager attached, empty elsewhere)
+- ``engine``    — the engine flight deck (rollout/flightdeck.py): request
+  lifecycle tails (TTFT/TPOT/queue wait), slot occupancy, page-pool
+  utilization, token-accounting reconciliation. Rollout role serves its
+  own ledger; trainer role serves the fleet aggregate from PoolManager
+  sweeps; empty elsewhere.
+
+Every v2 section is ALWAYS present on both planes (conformance-tested) so
+consumers never need existence checks.
 
 ``GET /metrics`` on the same listener renders the snapshot's numeric
 leaves as Prometheus text (``polyrl_statusz_*`` gauges) for real scrapers.
@@ -40,9 +49,15 @@ from typing import Callable
 
 log = logging.getLogger(__name__)
 
-SCHEMA = "polyrl/statusz/v1"
+SCHEMA = "polyrl/statusz/v2"
 _PROC_T0 = time.monotonic()
 _HIST_SUFFIXES = ("p50", "p95", "p99", "max", "mean", "count")
+
+# every key the schema guarantees on EVERY snapshot, both planes — the
+# conformance contract consumers (and the conformance test) rely on
+REQUIRED_SECTIONS = ("schema", "role", "pid", "time_unix_s", "uptime_s",
+                     "step", "goodput", "histograms", "counters", "gauges",
+                     "queues", "weights", "pool", "engine")
 
 
 def build_snapshot(role: str, *, step: int | None = None,
@@ -52,7 +67,8 @@ def build_snapshot(role: str, *, step: int | None = None,
                    gauges: dict | None = None,
                    queues: dict | None = None,
                    weights: dict | None = None,
-                   pool: dict | None = None) -> dict:
+                   pool: dict | None = None,
+                   engine: dict | None = None) -> dict:
     """The shared statusz schema; every section present (empty when the
     plane has nothing for it) so consumers never need existence checks."""
     return {
@@ -69,6 +85,7 @@ def build_snapshot(role: str, *, step: int | None = None,
         "queues": queues or {},
         "weights": weights or {},
         "pool": pool or {},
+        "engine": engine or {},
     }
 
 

@@ -53,6 +53,7 @@ import numpy as np
 from polyrl_tpu import obs
 from polyrl_tpu.models import decoder
 from polyrl_tpu.rollout.engine import next_bucket
+from polyrl_tpu.rollout.flightdeck import EngineFlightDeck, ThroughputEWMA
 from polyrl_tpu.rollout.prefix_cache import PrefixCache
 from polyrl_tpu.rollout.sampling import (
     SamplingParams,
@@ -349,13 +350,25 @@ class CBEngine:
         self.tokens_salvaged = 0   # tokens flushed into abort partials
         self.salvage_published_pages = 0  # decoded pages kept via the cache
 
-        # serving telemetry (server_info contract)
+        # serving telemetry (server_info contract). last_gen_throughput is
+        # EWMA-smoothed (flightdeck.ThroughputEWMA): heartbeat-sampled
+        # consumers (manager stats poller, /statusz) must not alias on one
+        # fast/slow drain tick.
         self.weight_version = 0
         self.num_running = 0
         self.num_queued = 0
         self.last_gen_throughput = 0.0
         self.total_tokens_served = 0
         self._tok_window: collections.deque = collections.deque(maxlen=64)
+        self._tput_ewma = ThroughputEWMA()
+        # engine flight deck: per-request lifecycle (queue wait / TTFT /
+        # TPOT / token counts) + scheduler occupancy ledger, with exact
+        # request-vs-scheduler token reconciliation (flightdeck.py)
+        self.deck = EngineFlightDeck(max_slots, self.num_pages, page_size)
+        # speculative acceptance ceiling: tokens the spec dispatches COULD
+        # have emitted (active_slots * rounds * (spec_tokens+1) each) —
+        # spec_emitted / this ratio is the acceptance-rate gauge
+        self.spec_token_ceiling = 0
         # POLYRL_CB_TRACE=1: cumulative wall per engine phase (dispatch vs
         # fetch vs prefill vs host bookkeeping) — the serving-path analogue
         # of the trainer's marked_timer spans (SURVEY.md §5.1)
@@ -1066,6 +1079,7 @@ class CBEngine:
         """Zero the rolling tok/s window (serving telemetry). Benchmarks use
         it so one phase's throughput can't leak into the next's peak."""
         self._tok_window.clear()
+        self._tput_ewma.reset()
         self.last_gen_throughput = 0.0
 
     def flush_prefix_cache(self) -> None:
@@ -1119,6 +1133,7 @@ class CBEngine:
         if (not self._pending and not self._active.any()
                 and not self._chunk_jobs):
             self._drain_emit_q()  # drain only ever deactivates slots
+            self.deck.on_idle()
             self._idle.set()
             try:
                 self._pending.append(self._queue.get(timeout=0.05))
@@ -1191,6 +1206,7 @@ class CBEngine:
                 else:
                     self._prefill_wave(wave)
                 self._tmark("prefill_dispatch", t0)
+                self.deck.on_admit_wave(len(wave))
             except Exception:
                 for req, _slot, pages, _b, _mp, me in wave:
                     self.allocator.free(pages)
@@ -1381,6 +1397,7 @@ class CBEngine:
             if self._hist is not None:
                 self._hist[slot] = list(req.input_ids)
             self._slot_gen[slot] += 1
+            self.deck.on_admit(slot, req.rid, req.t_submit, n_prompt)
             idxs.append((slot, int(self._slot_gen[slot])))
         self._enqueue_output(("prefillb", (token, logp, done), idxs,
                               self.weight_version))
@@ -1470,6 +1487,12 @@ class CBEngine:
         if self._hist is not None:
             self._hist[slot] = list(req.input_ids)
         self._slot_gen[slot] += 1
+        # cached_tokens = the prefix this dispatch did NOT compute (cache
+        # hit and/or chunk-filled pages); the ledger's prefill total still
+        # counts the full prompt — token accounting is about attribution,
+        # not compute
+        self.deck.on_admit(slot, req.rid, req.t_submit, n_prompt,
+                           cached_tokens=prefix_len)
         self._enqueue_output(("prefill", (token, logp, done),
                              (slot, int(self._slot_gen[slot])),
                              self.weight_version))
@@ -1741,6 +1764,7 @@ class CBEngine:
         info.emitted.append(t)
         if self._hist is not None:
             self._hist[slot].append(t)
+        self.deck.on_first_token(slot)
         self._count_tokens(1)
         if fin:
             info.req.out.put(STREAM_END)
@@ -1789,6 +1813,7 @@ class CBEngine:
                 self._last_tokens[i] = t
                 self._n_generated[i] += 1
                 info.emitted.append(t)
+                self.deck.on_decode(i)
                 if self._hist is not None:
                     self._hist[i].append(t)
                 if fin:
@@ -1862,6 +1887,7 @@ class CBEngine:
                              [(int(i), int(self._slot_gen[i]))
                               for i in np.flatnonzero(self._active)],
                              self.steps_per_dispatch, self.weight_version))
+        self._deck_dispatch()
         # run ahead up to pipeline_depth dispatches: older outputs stream
         # out of the fetcher while the device computes, hiding the fetch
         # round trips entirely
@@ -1925,6 +1951,7 @@ class CBEngine:
                 self._slot_gen[i] += 1
                 self._emit_abort(info.req, emit_line=True)
                 self._salvage_publish(i, info)
+                self.deck.on_salvage(i)
                 self._finalize(i)
             self._invalidate_dev_state()
 
@@ -1983,15 +2010,41 @@ class CBEngine:
         self._tmark("spec_dispatch", t0)
         self._pools = (kp, vp)
         self.spec_dispatches += 1
+        # acceptance ceiling: every active slot could emit up to
+        # rounds * (spec_tokens+1) tokens from this dispatch
+        self.spec_token_ceiling += (int(self._active.sum())
+                                    * self.spec_rounds * m)
         # each spec round emits >=1 token per still-active slot
         self._inflight_tok[self._active] += self.spec_rounds
         self._enqueue_output(("spec", (token, logp, done, emitted),
                              [(int(i), int(self._slot_gen[i]))
                               for i in np.flatnonzero(self._active)],
                              self.spec_rounds, self.weight_version))
+        self._deck_dispatch()
         self._drain_emit_q(keep=self.pipeline_depth)
 
+    def _deck_dispatch(self) -> None:
+        """Scheduler step-ledger sample at decode-dispatch time: occupancy,
+        page pressure, prefix-cache residency, run-ahead depth."""
+        self.deck.on_dispatch(
+            int(self._active.sum()), self.allocator.free_count,
+            self.prefix_cache.num_entries
+            if self.prefix_cache is not None else 0,
+            self._outstanding(), len(self._pending))
+
+    @property
+    def spec_accept_rate(self) -> float:
+        """Speculative acceptance: emitted tokens over the dispatches'
+        token ceiling (each active slot could emit rounds*(spec_tokens+1)
+        per dispatch). 0.0 before any spec dispatch; 1/(spec_tokens+1)
+        per round is the no-acceptance floor, 1.0 the perfect-lookup
+        ceiling."""
+        if self.spec_token_ceiling <= 0:
+            return 0.0
+        return self.spec_emitted / self.spec_token_ceiling
+
     def _finalize(self, slot: int) -> None:
+        self.deck.on_finalize(slot)
         info = self._slots[slot]
         if info is not None:
             self.allocator.free(info.pages)
@@ -2042,6 +2095,10 @@ class CBEngine:
 
     def _count_tokens(self, n: int) -> None:
         self.total_tokens_served += n
+        if n > 0:
+            # scheduler-side emission total (reconciles against per-request
+            # decode counts at quiescence — flight-deck invariant)
+            self.deck.on_emitted(n)
         now = time.monotonic()
         self._tok_window.append((now, n))
         horizon = now - 10.0
@@ -2052,7 +2109,7 @@ class CBEngine:
         # over that sliver is meaningless (and once polluted the serving
         # bench's peak metric) — only update over a meaningful span
         if dt >= 0.2:
-            self.last_gen_throughput = toks / dt
+            self.last_gen_throughput = self._tput_ewma.update(toks / dt, now)
 
     # -- convenience (tests / bench) ----------------------------------------
 

@@ -224,7 +224,12 @@ class PoolManager:
     # -- telemetry ---------------------------------------------------------
 
     def counters(self, refresh: bool = True) -> dict[str, float]:
-        """``pool/*`` gauges for step records / bench lines."""
+        """``pool/*`` + fleet ``engine/*`` gauges for step records / bench
+        lines. The engine gauges aggregate the flight-deck telemetry the
+        manager's stats poller forwards per instance: mean + min decode
+        occupancy (a collapse on ONE engine must be visible in the fleet
+        view), worst page-pool pressure, worst latency tails, summed
+        throughput — the step-record feed the FlightRecorder watches."""
         st = self.sweep() if refresh else dict(self._last_status)
         pool = st.get("pool", {})
         insts = st.get("instances", [])
@@ -240,7 +245,60 @@ class PoolManager:
         versions = [int(i.get("weight_version", -1)) for i in insts]
         if versions:
             out["pool/weight_version_floor"] = float(min(versions))
+        out.update(self._fleet_engine_gauges(insts))
         return out
+
+    @staticmethod
+    def _fleet_engine_gauges(insts: list[dict]) -> dict[str, float]:
+        """Fleet-wide ``engine/*`` aggregates over the instances reporting
+        flight-deck telemetry (engines predating it are skipped, not
+        counted as zeros — a joining v0 engine must not fake a collapse)."""
+        rep = [i for i in insts
+               if i.get("healthy") and "occupancy" in i]
+        if not rep:
+            return {}
+        occ = [float(i.get("occupancy", 0.0)) for i in rep]
+        return {
+            "engine/occupancy": sum(occ) / len(occ),
+            "engine/occupancy_min": min(occ),
+            "engine/page_util": max(float(i.get("page_util", 0.0))
+                                    for i in rep),
+            "engine/ttft_p95_s": max(float(i.get("ttft_p95_s", 0.0))
+                                     for i in rep),
+            "engine/tpot_p95_s": max(float(i.get("tpot_p95_s", 0.0))
+                                     for i in rep),
+            "engine/cache_hit_rate": (
+                sum(float(i.get("cache_hit_rate", 0.0)) for i in rep)
+                / len(rep)),
+            "engine/throughput_tok_s": sum(
+                float(i.get("last_gen_throughput", 0.0)) for i in rep),
+            "engine/attributed_frac_min": min(
+                float(i.get("attributed_frac", 1.0)) for i in rep),
+        }
+
+    def engine_section(self) -> dict:
+        """The trainer-side /statusz ``engine`` block: the fleet aggregate
+        plus the per-engine flight-deck view (served from the cached sweep
+        — the exporter never blocks on a respawning manager)."""
+        with self._lock:
+            insts = list(dict(self._last_status).get("instances", []))
+        fleet = {k.split("/", 1)[1]: round(v, 6)
+                 for k, v in self._fleet_engine_gauges(insts).items()}
+        return {
+            "fleet": fleet,
+            "engines": [{
+                "endpoint": i.get("endpoint", ""),
+                "occupancy": float(i.get("occupancy", 0.0)),
+                "page_util": float(i.get("page_util", 0.0)),
+                "ttft_p95_s": float(i.get("ttft_p95_s", 0.0)),
+                "tpot_p95_s": float(i.get("tpot_p95_s", 0.0)),
+                "cache_hit_rate": float(i.get("cache_hit_rate", 0.0)),
+                "spec_accept_rate": float(i.get("spec_accept_rate", 0.0)),
+                "attributed_frac": float(i.get("attributed_frac", 1.0)),
+                "throughput_tok_s": float(i.get("last_gen_throughput", 0.0)),
+                "running": int(i.get("num_running_reqs", 0)),
+            } for i in insts if "occupancy" in i],
+        }
 
     def statusz_section(self) -> dict:
         """The /statusz ``pool`` block: membership + per-engine health,
@@ -263,6 +321,9 @@ class PoolManager:
                 "running": int(i.get("num_running_reqs", 0)),
                 "queued": int(i.get("num_queued_reqs", 0)),
                 "heartbeat_misses": int(i.get("heartbeat_misses", 0)),
+                # flight-deck load view (0.0 for engines predating it)
+                "occupancy": float(i.get("occupancy", 0.0)),
+                "page_util": float(i.get("page_util", 0.0)),
             } for i in st.get("instances", [])],
             "snapshot_age_s": round(age, 3),
         }

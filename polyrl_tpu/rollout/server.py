@@ -39,6 +39,7 @@ import numpy as np
 
 from polyrl_tpu import obs
 from polyrl_tpu.rollout.cb_engine import STREAM_END
+from polyrl_tpu.rollout.flightdeck import ThroughputEWMA
 from polyrl_tpu.rollout.sampling import SamplingParams
 from polyrl_tpu.rollout.stepper import StepDecoder
 
@@ -72,6 +73,9 @@ class RolloutServer:
         self.stepper = None if self.cb else StepDecoder(engine)
         self.max_batch = max_batch or max(getattr(engine, "batch_buckets", (64,)))
         self.batch_wait_s = batch_wait_s
+        # v0 batch-loop throughput smoothing (the CB engine smooths its
+        # own): one fast/slow batch must not alias heartbeat samplers
+        self._tput_ewma = ThroughputEWMA()
         self._queue: "queue.Queue[_PendingRequest]" = queue.Queue()
         self._aborts: dict[str, threading.Event] = {}
         self._aborts_lock = threading.Lock()
@@ -513,7 +517,8 @@ class RolloutServer:
                              "error": "stream ended without completion"})
                 req.out.put(_SENTINEL)
         dt = time.monotonic() - t0
-        self.engine.last_gen_throughput = total / dt if dt > 0 else 0.0
+        self.engine.last_gen_throughput = self._tput_ewma.update(
+            total / dt if dt > 0 else 0.0)
         self.engine.num_running = 0
 
     # -- telemetry / weights / memory ---------------------------------------
@@ -546,6 +551,14 @@ class RolloutServer:
             # spec_tokens+1 ceiling says whether the lookup is paying off
             info["spec_emitted"] = self.engine.spec_emitted
             info["spec_dispatches"] = self.engine.spec_dispatches
+            info["spec_accept_rate"] = round(
+                getattr(self.engine, "spec_accept_rate", 0.0), 4)
+        deck = getattr(self.engine, "deck", None)
+        if deck is not None:
+            # engine flight deck: occupancy / page pressure / server-side
+            # TTFT+TPOT tails / token-accounting reconciliation — flat keys
+            # the manager's stats poller forwards and bench reads
+            info.update(deck.server_info_fields())
         return info
 
     def statusz_snapshot(self) -> dict:
@@ -569,12 +582,25 @@ class RolloutServer:
                   and not isinstance(v, bool) and k not in counters}
         gauges["draining"] = float(self._draining.is_set())
         gauges["paused"] = float(self._paused.is_set())
+        deck = getattr(self.engine, "deck", None)
+        engine_section = {}
+        if deck is not None:
+            engine_section = deck.snapshot(
+                active=int(info.get("num_running_reqs", 0)),
+                queued=int(info.get("num_queued_reqs", 0)))
+            if getattr(self.engine, "spec_tokens", 0):
+                engine_section["spec"] = {
+                    "accept_rate": float(info.get("spec_accept_rate", 0.0)),
+                    "emitted": int(self.engine.spec_emitted),
+                    "dispatches": int(self.engine.spec_dispatches),
+                }
         return statusz.build_snapshot(
             "rollout",
             counters=counters, gauges=gauges,
             queues={"running": float(info.get("num_running_reqs", 0)),
                     "queued": float(info.get("num_queued_reqs", 0))},
-            weights={"version": float(self.engine.weight_version)})
+            weights={"version": float(self.engine.weight_version)},
+            engine=engine_section)
 
     def metrics_text(self) -> str:
         """Prometheus text format for /metrics: server_info fields as

@@ -230,6 +230,12 @@ class StreamRLTrainer:
         self._recorder = recorder
         if recorder is not None and isinstance(rollout, RemoteRollout):
             recorder.counters_fn = rollout.fault_counters
+            # post-mortem bundles carry the fleet flight-deck tail (per-
+            # engine occupancy/page pressure at anomaly time); resolved at
+            # dump time — the pool may attach after construction
+            recorder.engine_fn = (
+                lambda: rollout.pool.engine_section()
+                if rollout.pool is not None else {})
 
     # -- profiling (reference _start/_stop_profiling with continuous-step
     # logic, stream_ray_trainer.py:356-361,629-641) ----------------------
@@ -999,7 +1005,7 @@ class StreamRLTrainer:
             counters.update(self._recorder.counters())
         gauges = {k: float(v) for k, v in rec.items()
                   if k.startswith(("perf/", "training/", "manager/",
-                                   "pool/"))}
+                                   "pool/", "engine/"))}
         pool = getattr(self.rollout, "pool", None)
         return statusz.build_snapshot(
             "trainer", step=self.global_step,
@@ -1014,7 +1020,10 @@ class StreamRLTrainer:
                                               "weight_version", 0)),
                      "staleness": float(rec.get(
                          "perf/weight_staleness", 0.0))},
-            pool=pool.statusz_section() if pool is not None else None)
+            pool=pool.statusz_section() if pool is not None else None,
+            # fleet flight-deck aggregate (the rollout plane serves its own
+            # per-engine ledger; the trainer serves the pool-wide view)
+            engine=pool.engine_section() if pool is not None else None)
 
     # -- fit --------------------------------------------------------------
 

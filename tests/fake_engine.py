@@ -37,6 +37,9 @@ class FakeEngine:
         self.shutdown_called = threading.Event()
         self.killed = threading.Event()      # death without notice
         self.draining = threading.Event()    # graceful preemption
+        # extra /get_server_info fields (flight-deck telemetry: occupancy,
+        # page_util, ttft_p95_s, ... — whatever the test wants forwarded)
+        self.server_info_extra: dict = {}
         self.server: ThreadingHTTPServer | None = None
         self.port: int | None = None
         outer = self
@@ -71,13 +74,17 @@ class FakeEngine:
                     else:
                         self._json(503, {"status": "starting"})
                 elif self.path == "/get_server_info":
-                    self._json(200, {
+                    info = {
                         "num_running_reqs": 0,
                         "num_queued_reqs": 0,
                         "last_gen_throughput": 123.0,
                         "weight_version": outer.weight_updates[-1] if outer.weight_updates else -1,
                         "draining": outer.draining.is_set(),
-                    })
+                    }
+                    # flight-deck telemetry (tests set server_info_extra to
+                    # exercise the manager's forwarding + pool aggregation)
+                    info.update(outer.server_info_extra)
+                    self._json(200, info)
                 else:
                     self._json(404, {"error": "nope"})
 

@@ -1,0 +1,413 @@
+"""Engine flight deck: per-request lifecycle + scheduler occupancy ledger
+(rollout/flightdeck.py), its export surface (server_info, /statusz v2),
+the C++ manager's forwarding, and the PoolManager fleet aggregation.
+
+The load-bearing pin is the token-accounting reconciliation: scheduler-
+side totals (counted at admission dispatch and at emission) must equal
+the per-request totals folded in at finalize EXACTLY once the engine is
+quiescent — under normal completion, abort churn, and partial-rollout
+salvage. A leaked slot, a skipped finalize, or an emission past a dead
+slot breaks the equality.
+"""
+
+import json
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from polyrl_tpu.obs import statusz
+from polyrl_tpu.rollout.flightdeck import EngineFlightDeck, ThroughputEWMA
+from polyrl_tpu.rollout.pool import PoolConfig, PoolManager
+from tests.fake_engine import FakeEngine
+
+
+# -- units: throughput EWMA + deck bookkeeping (no jax) ----------------------
+
+
+def test_throughput_ewma_seeds_and_smooths():
+    ew = ThroughputEWMA(tau_s=5.0)
+    assert ew.update(100.0, now=0.0) == 100.0  # first sample seeds
+    # a single extreme tick moves the EWMA only fractionally (the
+    # aliasing last_gen_throughput used to expose to heartbeat samplers)
+    v = ew.update(1000.0, now=0.5)
+    assert 100.0 < v < 200.0
+    # long gap -> converges toward the new rate
+    v = ew.update(1000.0, now=60.0)
+    assert v > 990.0
+    ew.reset()
+    assert ew.value == 0.0 and ew.update(7.0, now=0.0) == 7.0
+
+
+def test_deck_reconciliation_and_idempotent_finalize():
+    deck = EngineFlightDeck(max_slots=4, num_pages=65, page_size=8)
+    deck.on_admit(0, "r0", time.monotonic() - 0.5, prompt_tokens=10)
+    deck.on_first_token(0)
+    deck.on_emitted(1)
+    for _ in range(3):
+        deck.on_decode(0)
+    deck.on_emitted(3)
+    assert deck.attributed_frac() < 1.0  # in flight: not yet attributed
+    deck.on_finalize(0)
+    deck.on_finalize(0)  # double finalize must fold exactly once
+    assert deck.req_prefill_tokens == deck.sched_prefill_tokens == 10
+    assert deck.req_decode_tokens == deck.sched_decode_tokens == 4
+    assert deck.attributed_frac() == 1.0
+    assert deck.requests_finished == 1
+    assert deck.hists["queue_wait_s"].count == 1
+    assert deck.hists["ttft_s"].count == 1
+    assert deck.hists["tpot_s"].count == 1
+    assert deck.hists["queue_wait_s"].vmax >= 0.5
+
+
+def test_deck_dispatch_bounds():
+    deck = EngineFlightDeck(max_slots=8, num_pages=17, page_size=8)
+    # occupancy and page utilization clamp to [0, 1] even on inconsistent
+    # inputs (mirror races can momentarily overshoot)
+    deck.on_dispatch(active=99, free_pages=0, cache_pages=3, run_ahead=5,
+                     queued=2)
+    assert deck.occupancy_last == 1.0 and deck.occupancy_ewma == 1.0
+    assert deck.page_util_last == 1.0
+    deck.on_dispatch(active=4, free_pages=16, cache_pages=0, run_ahead=0,
+                     queued=0)
+    assert deck.occupancy_last == 0.5
+    assert deck.page_util_last == 0.0
+    assert deck.page_util_peak == 1.0
+    info = deck.server_info_fields()
+    assert 0.0 <= info["occupancy"] <= 1.0
+    assert info["page_util_peak"] == 1.0
+
+
+# -- real CBEngine CPU path: invariants under completion/abort/salvage -------
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    import jax
+
+    from polyrl_tpu.models import decoder
+
+    cfg = decoder.get_config("tiny")
+    return cfg, decoder.init_params(jax.random.PRNGKey(0), cfg)
+
+
+def _mk_engine(tiny, **kw):
+    from polyrl_tpu.rollout.cb_engine import CBEngine
+
+    cfg, params = tiny
+    defaults = dict(max_slots=4, page_size=8, max_seq_len=128,
+                    prompt_buckets=(16, 32), num_pages=64)
+    defaults.update(kw)
+    return CBEngine(cfg, params, **defaults)
+
+
+def _drain_stream(q):
+    from polyrl_tpu.rollout.cb_engine import STREAM_END
+
+    toks, reason = [], ""
+    while True:
+        item = q.get(timeout=60)
+        if item is STREAM_END:
+            return toks, reason
+        toks.extend(item["token_ids"])
+        if item["finished"]:
+            reason = item["finish_reason"]
+
+
+def _assert_deck_invariants(engine):
+    d = engine.deck
+    assert (d.req_prefill_tokens + d.req_decode_tokens
+            == d.sched_prefill_tokens + d.sched_decode_tokens), (
+        f"token ledgers diverged: req=({d.req_prefill_tokens},"
+        f"{d.req_decode_tokens}) sched=({d.sched_prefill_tokens},"
+        f"{d.sched_decode_tokens})")
+    assert d.attributed_frac() == 1.0
+    assert 0.0 <= d.occupancy_last <= 1.0
+    assert 0.0 <= d.occupancy_ewma <= 1.0
+    assert 0.0 <= d.page_util_peak <= 1.0
+    assert d.hists["occupancy"].vmax <= 1.0
+    assert d.hists["page_util"].vmax <= 1.0
+
+
+def test_ledger_reconciles_after_completion(tiny):
+    from polyrl_tpu.rollout.sampling import SamplingParams
+
+    engine = _mk_engine(tiny)
+    sp = SamplingParams(temperature=0.0, max_new_tokens=6)
+    # 8 requests over 4 slots: the second wave queues (queue-wait > 0)
+    outs = [engine.submit(f"r{i}", [3 + i, 7, 11], sp) for i in range(8)]
+    engine.start()
+    for q in outs:
+        toks, reason = _drain_stream(q)
+        assert reason in ("stop", "length") and toks
+    # emission can lag the last stream item by one loop tick
+    t0 = time.monotonic()
+    while engine.deck.attributed_frac() != 1.0 \
+            and time.monotonic() - t0 < 20:
+        time.sleep(0.05)
+    engine.stop()
+    d = engine.deck
+    _assert_deck_invariants(engine)
+    assert d.requests_finished == 8
+    assert d.req_prefill_tokens == 8 * 3
+    assert d.req_decode_tokens == 8 * 6
+    assert d.hists["ttft_s"].count == 8
+    assert d.hists["queue_wait_s"].count == 8
+    assert d.decode_dispatches > 0
+    assert d.admit_waves >= 2  # 8 requests cannot admit in one 4-slot wave
+    # the engine-local server_info surface carries the tails
+    info = d.server_info_fields()
+    assert info["ttft_p95_s"] > 0.0
+    assert info["attributed_frac"] == 1.0
+
+
+def test_ledger_reconciles_under_abort_salvage_churn(tiny):
+    from polyrl_tpu.rollout.sampling import SamplingParams
+
+    engine = _mk_engine(tiny, max_seq_len=512, num_pages=128,
+                        salvage_partials=True)
+    engine.pipeline_depth = 16
+    engine.start()
+    sp_long = SamplingParams(temperature=0.0, max_new_tokens=400)
+    sp_short = SamplingParams(temperature=0.0, max_new_tokens=5)
+    evs = [threading.Event() for _ in range(2)]
+    aborted = [engine.submit(f"a{i}", [5 + i, 6, 7], sp_long, abort=ev)
+               for i, ev in enumerate(evs)]
+    normal = [engine.submit(f"n{i}", [9 + i, 2], sp_short)
+              for i in range(3)]
+    # let the aborted streams produce some tokens, then cut them
+    for q in aborted:
+        first = q.get(timeout=60)
+        assert first["token_ids"]
+    for ev in evs:
+        ev.set()
+    for q in aborted:
+        toks, reason = _drain_stream(q)
+        assert reason == "abort"
+    for q in normal:
+        toks, reason = _drain_stream(q)
+        assert len(toks) == 5
+    t0 = time.monotonic()
+    while engine.deck.attributed_frac() != 1.0 \
+            and time.monotonic() - t0 < 20:
+        time.sleep(0.05)
+    engine.stop()
+    d = engine.deck
+    _assert_deck_invariants(engine)
+    assert d.requests_finished == 5
+    assert d.requests_salvaged >= 2  # both aborts took the salvage path
+    # slots and pages fully reclaimed (the engine-level invariant the
+    # ledger's page_util must agree with)
+    assert all(s is None for s in engine._slots)
+    assert engine.allocator.free_count == engine.num_pages - 1
+
+
+def test_spec_accept_rate_gauge(tiny):
+    from polyrl_tpu.rollout.sampling import SamplingParams
+    from polyrl_tpu.rollout.server import RolloutServer
+
+    engine = _mk_engine(tiny, spec_tokens=2, spec_rounds=2)
+    server = RolloutServer(engine, host="127.0.0.1", port=0)
+    sp = SamplingParams(temperature=0.0, max_new_tokens=8)
+    outs = [engine.submit(f"s{i}", [3, 7, 11, 13], sp) for i in range(2)]
+    engine.start()
+    for q in outs:
+        toks, _ = _drain_stream(q)
+        assert len(toks) == 8
+    engine.stop()
+    assert engine.spec_dispatches > 0
+    assert engine.spec_token_ceiling >= engine.spec_emitted > 0
+    # ratio against the rounds*(spec_tokens+1) ceiling, never > 1
+    assert 0.0 < engine.spec_accept_rate <= 1.0
+    info = server.server_info()
+    assert info["spec_accept_rate"] == round(engine.spec_accept_rate, 4)
+    _assert_deck_invariants(engine)
+
+
+# -- export: server_info + /statusz v2 conformance ---------------------------
+
+
+def _get_json(url: str) -> dict:
+    with urllib.request.urlopen(url, timeout=10.0) as r:
+        return json.loads(r.read())
+
+
+def test_statusz_v2_conformance_both_planes(tiny):
+    """Every v2 section is present on BOTH planes (schema contract), and
+    the rollout plane's ``engine`` section carries the live ledger."""
+    from polyrl_tpu.rollout.server import RolloutServer
+
+    assert statusz.SCHEMA == "polyrl/statusz/v2"
+    # trainer plane: the standalone exporter over build_snapshot (the only
+    # snapshot constructor the trainer uses)
+    srv = statusz.StatuszServer(lambda: statusz.build_snapshot(
+        "trainer", step=3), host="127.0.0.1").start()
+    try:
+        snap = _get_json(f"http://{srv.endpoint}/statusz")
+        assert snap["schema"] == "polyrl/statusz/v2"
+        for section in statusz.REQUIRED_SECTIONS:
+            assert section in snap, f"trainer plane missing {section}"
+    finally:
+        srv.stop()
+
+    # rollout plane: the real engine-backed route
+    engine = _mk_engine(tiny)
+    server = RolloutServer(engine, host="127.0.0.1", port=0).start()
+    try:
+        from polyrl_tpu.rollout.sampling import SamplingParams
+
+        engine.generate([[5, 3, 9]], SamplingParams(temperature=0.0,
+                                                    max_new_tokens=4))
+        snap = _get_json(f"http://127.0.0.1:{server.port}/statusz")
+        assert snap["schema"] == "polyrl/statusz/v2"
+        for section in statusz.REQUIRED_SECTIONS:
+            assert section in snap, f"rollout plane missing {section}"
+        eng = snap["engine"]
+        assert eng["tokens"]["attributed_frac"] == 1.0
+        assert eng["requests"]["finished"] == 1
+        assert 0.0 <= eng["occupancy"]["last"] <= 1.0
+        assert eng["pages"]["util"] <= 1.0
+        assert "ttft_s" in eng["latency"]
+    finally:
+        server.stop()
+
+
+# -- fleet aggregation: PoolManager over flight-deck-reporting engines -------
+
+
+class _StubManagerClient:
+    """get_instances_status stub: aggregation math without a manager."""
+
+    def __init__(self, instances):
+        self.instances = instances
+
+    def get_instances_status(self):
+        return {"instances": self.instances,
+                "pool": {"registered": len(self.instances),
+                         "active": len(self.instances), "pending": 0,
+                         "joins": len(self.instances), "evictions": 0,
+                         "drain_departures": 0}}
+
+
+def test_pool_fleet_engine_aggregation():
+    insts = [
+        {"endpoint": "a:1", "healthy": True, "active": True,
+         "weight_version": 2, "occupancy": 0.9, "page_util": 0.4,
+         "ttft_p95_s": 0.2, "tpot_p95_s": 0.01, "cache_hit_rate": 0.5,
+         "attributed_frac": 1.0, "last_gen_throughput": 100.0},
+        {"endpoint": "b:2", "healthy": True, "active": True,
+         "weight_version": 2, "occupancy": 0.1, "page_util": 0.9,
+         "ttft_p95_s": 0.8, "tpot_p95_s": 0.05, "cache_hit_rate": 0.3,
+         "attributed_frac": 0.97, "last_gen_throughput": 50.0},
+        # pre-flight-deck engine: no occupancy key — skipped, not a zero
+        {"endpoint": "c:3", "healthy": True, "active": True,
+         "weight_version": 2},
+    ]
+    pool = PoolManager(_StubManagerClient(insts), PoolConfig())
+    c = pool.counters()
+    assert c["engine/occupancy"] == pytest.approx(0.5)
+    assert c["engine/occupancy_min"] == pytest.approx(0.1)
+    assert c["engine/page_util"] == pytest.approx(0.9)       # fleet max
+    assert c["engine/ttft_p95_s"] == pytest.approx(0.8)      # fleet max
+    assert c["engine/throughput_tok_s"] == pytest.approx(150.0)
+    assert c["engine/attributed_frac_min"] == pytest.approx(0.97)
+    sec = pool.engine_section()
+    assert len(sec["engines"]) == 2  # only flight-deck reporters
+    assert sec["fleet"]["occupancy"] == pytest.approx(0.5)
+    by_ep = {e["endpoint"]: e for e in sec["engines"]}
+    assert by_ep["b:2"]["page_util"] == pytest.approx(0.9)
+    # the pool statusz section carries the per-engine load view too
+    st = pool.statusz_section()
+    occ = {e["endpoint"]: e["occupancy"] for e in st["engines"]}
+    assert occ["a:1"] == pytest.approx(0.9) and occ["c:3"] == 0.0
+
+
+def test_pool_engine_aggregation_empty_without_reporters():
+    pool = PoolManager(_StubManagerClient(
+        [{"endpoint": "c:3", "healthy": True, "active": True}]), PoolConfig())
+    c = pool.counters()
+    assert not any(k.startswith("engine/") for k in c)
+    assert pool.engine_section()["engines"] == []
+
+
+# -- C++ manager forwarding (real manager + fake engines) --------------------
+
+_FAST_ARGS = ["--health-check-interval-s", "0.1",
+              "--stats-poll-interval-s", "0.1",
+              "--heartbeat-failures", "2",
+              "--generate-timeout-ms", "10000",
+              "--schedule-wait-timeout-ms", "5000"]
+
+
+def test_manager_forwards_flight_deck_telemetry():
+    from polyrl_tpu.manager.client import ManagerClient, spawn_rollout_manager
+
+    proc, port = spawn_rollout_manager("127.0.0.1:0", extra_args=_FAST_ARGS)
+    client = ManagerClient(f"127.0.0.1:{port}")
+    eng = FakeEngine().start()
+    eng.server_info_extra = {
+        "occupancy": 0.75, "page_util": 0.25, "ttft_p95_s": 0.33,
+        "tpot_p95_s": 0.02, "prefix_cache/hit_rate": 0.6,
+        "spec_accept_rate": 0.4, "attributed_frac": 0.99,
+    }
+    try:
+        client.wait_healthy()
+        client.register_rollout_instance(eng.endpoint)
+
+        def _forwarded():
+            for i in client.get_instances_status()["instances"]:
+                if i["endpoint"] == eng.endpoint and \
+                        i.get("occupancy") == 0.75:
+                    return i
+            return None
+
+        t0 = time.monotonic()
+        inst = None
+        while inst is None and time.monotonic() - t0 < 10.0:
+            inst = _forwarded()
+            time.sleep(0.05)
+        assert inst is not None, "stats poller never forwarded occupancy"
+        assert inst["page_util"] == 0.25
+        assert inst["ttft_p95_s"] == 0.33
+        assert inst["cache_hit_rate"] == 0.6
+        assert inst["spec_accept_rate"] == 0.4
+        assert inst["attributed_frac"] == 0.99
+        # PoolManager aggregates the forwarded view into engine/* gauges
+        pool = PoolManager(client, PoolConfig())
+        c = pool.counters()
+        assert c["engine/occupancy"] == pytest.approx(0.75)
+        assert c["engine/page_util"] == pytest.approx(0.25)
+        # and the manager's own Prometheus surface carries the fleet view
+        text = client.metrics_text()
+        assert "polyrl_mgr_fleet_occupancy 0.75" in text
+        assert "polyrl_mgr_instance_page_util" in text
+    finally:
+        eng.stop()
+        proc.kill()
+
+
+# -- flight recorder integration ---------------------------------------------
+
+
+def test_recorder_watches_occupancy_and_dumps_engine_view(tmp_path):
+    from polyrl_tpu.obs.recorder import DEFAULT_WATCH, FlightRecorder
+
+    assert "engine/occupancy" in DEFAULT_WATCH
+    assert "engine/page_util" in DEFAULT_WATCH
+    rec = FlightRecorder(str(tmp_path), warmup=3, z_threshold=4.0)
+    rec.engine_fn = lambda: {"fleet": {"occupancy": 0.05},
+                             "engines": [{"endpoint": "a:1",
+                                          "occupancy": 0.05}]}
+    # steady occupancy through warmup, then a collapse
+    for _ in range(6):
+        assert rec.record_step(1, {"engine/occupancy": 0.9,
+                                   "engine/page_util": 0.5}) is None
+    path = rec.record_step(7, {"engine/occupancy": 0.05,
+                               "engine/page_util": 0.5})
+    assert path is not None, "occupancy collapse must dump a bundle"
+    import os
+
+    with open(os.path.join(path, "engine.json")) as f:
+        eng = json.load(f)
+    assert eng["engines"][0]["occupancy"] == 0.05

@@ -203,7 +203,7 @@ def test_statusz_server_and_prometheus(tmp_path):
     srv = StatuszServer(lambda: snap).start()
     try:
         got = _get_json(f"http://{srv.endpoint}/statusz")
-        assert got["schema"] == "polyrl/statusz/v1"
+        assert got["schema"] == "polyrl/statusz/v2"
         assert got["role"] == "trainer" and got["step"] == 7
         # every schema section always present
         for section in ("goodput", "histograms", "counters", "gauges",

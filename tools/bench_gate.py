@@ -62,6 +62,13 @@ WATCHED_EXTRA = (
     ("pool.tok_s", True),
     ("pool.pool_engines", True),
     ("pool.recovery_s", False),
+    # engine flight deck (server-side ledger, promoted from the cb phase):
+    # decode occupancy and prefix-cache hit rate must hold; the
+    # server-measured TTFT/TPOT tails must not blow up
+    ("engine_occupancy", True),
+    ("engine_cache_hit_rate", True),
+    ("engine_ttft_p95_ms", False),
+    ("engine_tpot_p95_ms", False),
 )
 
 

@@ -28,7 +28,12 @@ salvage counters — ``fault/tokens_salvaged``, ``fault/suffix_resumes``,
 and the injector's ``fault/injected_*`` (rollout/faults.py ``counters``)
 — and the goodput/health plane's ``goodput/*`` phase attribution plus the
 ``obs/*`` self-telemetry (``obs/scrape_failed``, ``obs/anomalies``,
-``obs/bundles``, ``obs/log_errors``). New metric emitters in
+``obs/bundles``, ``obs/log_errors``). The engine flight deck
+(rollout/flightdeck.py) emits ``engine/*`` — per-request lifecycle
+distributions (``engine/ttft_s``, ``engine/tpot_s``,
+``engine/queue_wait_s``, ``engine/prefill_s``) into the global histogram
+registry and fleet aggregates (``engine/occupancy``, ``engine/page_util``,
+``engine/ttft_p95_s``, ...) via PoolManager.counters. New metric emitters in
 ``polyrl_tpu/`` are linted automatically; nothing needs registering —
 EXCEPT a new top-level namespace, which must be added to ``NAMESPACES``
 below and documented in ARCHITECTURE.md in the same change (an
@@ -63,6 +68,8 @@ NAMESPACES = frozenset({
     "fault",         # control-plane + salvage fault counters
     "manager",       # scraped manager gauges + client RTT
     "pool",          # elastic-pool membership + balance estimator gauges
+    "engine",        # engine flight deck: occupancy / TTFT / TPOT /
+                     # page-pool + fleet aggregates (rollout/flightdeck.py)
     "rollout",       # rollout-plane latency/throughput distributions
     "transfer",      # weight-fabric pack/push timings
     "prefix_cache",  # engine prefix-cache hit telemetry
