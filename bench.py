@@ -431,6 +431,15 @@ def bench_cb(cfg, params, batch, prompt_len, new_tokens, max_slots=64,
             "queue_wait_p95_s", 0.0)), 1),
         "engine_attributed_frac": round(float(srv_info.get(
             "attributed_frac", 0.0)), 4),
+        # group-shared prefill telemetry (near-zero on this phase's random
+        # distinct prompts — the --group-share A/B is the shared-prompt
+        # probe; recorded here so TPU rounds track the serving default)
+        "engine_prefill_reuse_frac": round(float(srv_info.get(
+            "prefill_reuse_frac", 0.0)), 4),
+        "engine_prefill_dispatches": int(srv_info.get(
+            "prefill_dispatches", 0)),
+        "engine_sibling_attach_dispatches": int(srv_info.get(
+            "sibling_attach_dispatches", 0)),
     }
 
 
@@ -1118,6 +1127,113 @@ _CHIP_PEAKS = {
 }
 
 
+def group_share_bench(preset: str = "tiny", g: int = 8, groups: int = 4,
+                      prompt_len: int = 128, new_tokens: int = 32) -> dict:
+    """Group-shared prefill A/B (``python bench.py --group-share``): the
+    same GRPO-shaped workload (``groups`` prompts × ``g`` samples each)
+    through two CB engines — group sharing ON (one prompt prefill + one
+    batched sibling attach per group) vs FORCED-INDEPENDENT
+    (``group_share=False``: the pre-group-share engine, where the leader
+    prefills and every sibling admits as a SERIALIZED singleton suffix
+    dispatch — admission dispatch count linear in g). Reports prefill
+    dispatch counts (the admission bottleneck on dispatch-latency-bound
+    links), the engine's prefill_reuse_frac, and wall/throughput. Each
+    engine takes one untimed warm pass first so XLA compiles stay out of
+    the timed window. CPU-sized by default; scale via env/flags on a real
+    chip."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from polyrl_tpu.models import decoder
+    from polyrl_tpu.rollout.cb_engine import STREAM_END, CBEngine
+    from polyrl_tpu.rollout.sampling import SamplingParams
+
+    cfg = decoder.get_config(preset, dtype=jnp.float32 if preset == "tiny"
+                             else jnp.bfloat16)
+    params = jax.jit(lambda: decoder.init_params(jax.random.PRNGKey(0),
+                                                 cfg))()
+    page_size = min(64, prompt_len)
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(1, cfg.vocab_size, prompt_len).tolist()
+               for _ in range(groups)]
+    sp = SamplingParams(temperature=1.0, max_new_tokens=new_tokens,
+                        stop_token_ids=())
+
+    def run(share: bool) -> dict:
+        from polyrl_tpu.rollout.flightdeck import EngineFlightDeck
+
+        eng = CBEngine(
+            cfg, params, max_slots=max(g * 2, 16), page_size=page_size,
+            max_seq_len=-(-(prompt_len + new_tokens) // page_size)
+            * page_size, prompt_buckets=(prompt_len,),
+            num_pages=groups * g * 4 * (-(-(prompt_len + new_tokens)
+                                          // page_size)),
+            group_share=share, steps_per_dispatch=4)
+
+        def drive(batch_prompts: list, tag: str) -> tuple[float, int]:
+            outs = []
+            for gi, p in enumerate(batch_prompts):
+                for si in range(g):
+                    outs.append(eng.submit(
+                        f"{tag}{gi}-{si}", p, sp,
+                        group_id=f"{tag}{gi}", group_size=g))
+            eng.start()
+            t0 = time.monotonic()
+            total = 0
+            for q in outs:
+                while True:
+                    item = q.get(timeout=600)
+                    if item is STREAM_END:
+                        break
+                    total += len(item["token_ids"])
+            return time.monotonic() - t0, total
+
+        # untimed warm pass (compiles every variant this traffic shape
+        # touches), then reset cache/counters so the timed window is clean
+        warm = [rng.integers(1, cfg.vocab_size, prompt_len).tolist()]
+        drive(warm, "warm")
+        eng.flush_prefix_cache()
+        eng.prefill_dispatches = 0
+        eng.sibling_attach_dispatches = 0
+        eng.group_forked_requests = 0
+        eng.deck = EngineFlightDeck(eng.max_slots, eng.num_pages,
+                                    eng.page_size)
+
+        wall, total = drive(prompts, "grp")
+        deck = eng.deck
+        res = {
+            "wall_s": round(wall, 3),
+            "tok_s": round(total / wall, 1) if wall > 0 else 0.0,
+            "prefill_dispatches": eng.prefill_dispatches,
+            "sibling_attach_dispatches": eng.sibling_attach_dispatches,
+            "group_forked_requests": eng.group_forked_requests,
+            "dispatches_per_group": round(
+                eng.prefill_dispatches / groups, 2),
+            "prefill_reuse_frac": round(deck.prefill_reuse_frac(), 4),
+            "attributed_frac": round(deck.attributed_frac(), 6),
+        }
+        eng.stop()
+        return res
+
+    shared = run(True)
+    independent = run(False)
+    return {
+        "g": g, "groups": groups, "prompt_len": prompt_len,
+        "new_tokens": new_tokens,
+        "shared": shared, "independent": independent,
+        # headline fields bench_gate watches: reuse must hold, the
+        # per-group dispatch count must stay <= 2 (1 prefill + 1 attach)
+        "engine_prefill_reuse_frac": shared["prefill_reuse_frac"],
+        "dispatches_per_group": shared["dispatches_per_group"],
+        "dispatch_reduction": round(
+            independent["prefill_dispatches"]
+            / max(shared["prefill_dispatches"], 1), 2),
+        "speedup": round(independent["wall_s"]
+                         / max(shared["wall_s"], 1e-9), 2),
+    }
+
+
 def _chip_peaks(device_kind: str) -> tuple[float, float]:
     for prefix, peaks in _CHIP_PEAKS.items():
         if device_kind.lower().startswith(prefix.lower()):
@@ -1167,7 +1283,8 @@ def assemble_result(state: dict) -> dict:
     # extra.engine_* so bench_gate watches it across rounds
     for k in ("engine_occupancy", "engine_page_util_peak",
               "engine_cache_hit_rate", "engine_ttft_p95_ms",
-              "engine_tpot_p95_ms", "engine_attributed_frac"):
+              "engine_tpot_p95_ms", "engine_attributed_frac",
+              "engine_prefill_reuse_frac"):
         v = cb.get(k)
         if isinstance(v, (int, float)) and not isinstance(v, bool):
             extra[k] = v
@@ -1603,6 +1720,19 @@ if __name__ == "__main__":
             endpoints=eps)
         print(json.dumps({"metric": "pool_tok_s", "value": res["tok_s"],
                           "unit": "tok/s", "extra": {"pool": res}}))
+    elif "--group-share" in sys.argv:
+        # group-shared prefill A/B: shared vs forced-independent admission
+        # on the GRPO traffic shape — its own entry, CPU-sized by default
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        res = group_share_bench(
+            preset=os.environ.get("POLYRL_BENCH_PRESET", "tiny"),
+            g=int(_cli_float("--g", 8)),
+            groups=int(_cli_float("--groups", 4)),
+            prompt_len=int(_cli_float("--prompt-len", 128)),
+            new_tokens=int(_cli_float("--new-tokens", 32)))
+        print(json.dumps({"metric": "group_share_dispatch_reduction",
+                          "value": res["dispatch_reduction"], "unit": "x",
+                          "extra": {"group_share": res}}))
     elif "--pipeline-microbench" in sys.argv:
         # CPU-only A/B of the trainer's pipelined mode — its own entry so
         # it never touches the TPU phase state machine or the relay

@@ -72,6 +72,16 @@ class RolloutSection:
     # weight read, distribution-exact rejection sampling. 0 = off.
     spec_tokens: int = 0
     spec_rounds: int = 2                  # fused device-side rounds/dispatch
+    # admission scheduler geometry (cb backend; ARCHITECTURE.md
+    # "Group-shared prefill"): admit_wave = max admissions fused into one
+    # batched prefill dispatch; admit_reorder_window = how many blocked
+    # queue heads admission may skip past while forming a wave (0 =
+    # strict FIFO head-of-line); group_share = prefill a GRPO group's
+    # shared prompt once and batch-attach the siblings (False restores
+    # per-request singleton suffix admission — the bench A/B baseline).
+    admit_wave: int = 8
+    admit_reorder_window: int = 8
+    group_share: bool = True
     # disaggregated plumbing (reference rollout_manager.{port,endpoint},
     # workers/config/rollout.py:95-101)
     manager_endpoint: str = ""            # "" → spawn the C++ manager locally

@@ -91,6 +91,10 @@ class Manager {
                          const std::string& parent_span = std::string(),
                          const ProgressFn& progress = ProgressFn()) {
     std::string rid = request["rid"].as_str();
+    // group-shared prefill: members of one GRPO group must land on ONE
+    // engine (group-affinity pin inside next_instance) or each split
+    // sibling pays a fresh prompt prefill
+    std::string group_id = request["group_id"].as_str();
     PartialResponse acc;
     // inject the trainer's trace context into the request we forward (and
     // into every continuation built from it) so the engine's spans join
@@ -105,7 +109,8 @@ class Manager {
     Value current = base;
     for (int attempt = 0; attempt < cfg_.max_generate_attempts; ++attempt) {
       InstancePtr inst = state_.next_instance(want_local,
-                                              cfg_.schedule_wait_timeout_ms);
+                                              cfg_.schedule_wait_timeout_ms,
+                                              group_id);
       if (!inst) {
         // Busy pool ≠ dead pool: while any healthy/pending instance exists
         // the request requeues without burning a retry attempt (matching the
@@ -356,6 +361,8 @@ class Manager {
               fwd("prefix_cache/hit_rate", inst->cache_hit_rate);
               fwd("spec_accept_rate", inst->spec_accept_rate);
               fwd("attributed_frac", inst->attributed_frac);
+              fwd("prefill_reuse_frac", inst->prefill_reuse_frac);
+              fwd("prefix_hit_frac", inst->prefix_hit_frac);
               if (info["draining"].as_bool() && !inst->draining.load()) {
                 log_line("instance " + inst->endpoint +
                          " announced draining; leaving routing set");
@@ -488,6 +495,8 @@ void register_routes(phttp::Server& server, Manager& mgr) {
       o["cache_hit_rate"] = Value(inst->cache_hit_rate.load());
       o["spec_accept_rate"] = Value(inst->spec_accept_rate.load());
       o["attributed_frac"] = Value(inst->attributed_frac.load());
+      o["prefill_reuse_frac"] = Value(inst->prefill_reuse_frac.load());
+      o["prefix_hit_frac"] = Value(inst->prefix_hit_frac.load());
       arr.push_back(Value(std::move(o)));
     }
     Object top;

@@ -11,6 +11,7 @@
 #include <chrono>
 #include <condition_variable>
 #include <cstdint>
+#include <deque>
 #include <map>
 #include <memory>
 #include <mutex>
@@ -56,6 +57,11 @@ struct Instance {
   std::atomic<double> cache_hit_rate{0.0};
   std::atomic<double> spec_accept_rate{0.0};
   std::atomic<double> attributed_frac{1.0};
+  // group-shared prefill telemetry: fraction of prompt tokens served from
+  // shared/cached pages, and the request-level (length-unbiased) prefix
+  // hit fraction
+  std::atomic<double> prefill_reuse_frac{0.0};
+  std::atomic<double> prefix_hit_frac{0.0};
 };
 
 using InstancePtr = std::shared_ptr<Instance>;
@@ -282,11 +288,48 @@ class AppState {
   // live signal between ticks), tie-broken round-robin so an idle pool
   // still rotates. want_local filters by locality (-1 = any). Returns
   // nullptr on shutdown/timeout.
-  InstancePtr next_instance(int want_local = -1, int timeout_ms = 120000) {
+  //
+  // group_id (group-shared prefill): the first member of a group pins the
+  // group to the picked endpoint; later members route to the pin even when
+  // it is quota-busy (they WAIT for it rather than splitting the group
+  // across engines — split siblings each pay a fresh prompt prefill,
+  // structurally defeating the engine's shared-prefill fork). A pin whose
+  // endpoint left the routing set (evicted/drained) is dropped and the
+  // member re-pins to a survivor — the salvage continuation path then
+  // carries the whole group there together.
+  InstancePtr next_instance(int want_local = -1, int timeout_ms = 120000,
+                            const std::string& group_id = std::string()) {
     std::unique_lock<std::mutex> lk(mu_);
     auto deadline = std::chrono::steady_clock::now() +
                     std::chrono::milliseconds(timeout_ms);
     while (!shutdown_) {
+      if (!group_id.empty()) {
+        auto pin = group_pins_.find(group_id);
+        if (pin != group_pins_.end()) {
+          auto it = instances_.find(pin->second);
+          bool routed = it != instances_.end() && active_.count(pin->second) &&
+                        !it->second->draining.load();
+          if (!routed) {
+            group_pins_.erase(pin);  // endpoint gone: re-pin below
+          } else {
+            auto& inst = it->second;
+            bool ok = (want_local < 0 ||
+                       inst->is_local == (want_local == 1)) &&
+                      !inst->updating_weight.load() &&
+                      inst->assigned_batches.load() < max_assigned_batches_ &&
+                      inst->num_queued_reqs.load() == 0;
+            if (ok) {
+              inst->assigned_batches.fetch_add(1);
+              return inst;
+            }
+            // pinned but momentarily ineligible (quota/queue): wait for it
+            // instead of splitting the group across engines
+            if (cv_.wait_until(lk, deadline) == std::cv_status::timeout)
+              return nullptr;
+            continue;
+          }
+        }
+      }
       std::vector<InstancePtr> eligible;
       for (auto& ep : active_) {
         auto it = instances_.find(ep);
@@ -313,6 +356,7 @@ class AppState {
           if (l < best) { best = l; pick = cand; }
         }
         pick->assigned_batches.fetch_add(1);
+        if (!group_id.empty()) pin_group_locked(group_id, pick->endpoint);
         return pick;
       }
       if (cv_.wait_until(lk, deadline) == std::cv_status::timeout) return nullptr;
@@ -473,12 +517,32 @@ class AppState {
     return {weight_senders_[sender_idx], group};
   }
 
+  // group-shared prefill routing pins (group_id -> endpoint), bounded FIFO:
+  // groups are batch-lived, so the oldest pins are always dead weight —
+  // evicting them cannot split a live group (its members arrive within one
+  // batch_generate call, far fewer than kMaxGroupPins groups apart)
+  static constexpr size_t kMaxGroupPins = 4096;
+  void pin_group_locked(const std::string& group_id,
+                        const std::string& endpoint) {
+    if (group_pins_.emplace(group_id, endpoint).second) {
+      group_pin_order_.push_back(group_id);
+      while (group_pin_order_.size() > kMaxGroupPins) {
+        group_pins_.erase(group_pin_order_.front());
+        group_pin_order_.pop_front();
+      }
+    } else {
+      group_pins_[group_id] = endpoint;
+    }
+  }
+
   std::mutex mu_;
   std::condition_variable cv_;
   std::map<std::string, InstancePtr> instances_;
   std::set<std::string> active_;
   std::set<std::string> pending_;
   std::vector<std::string> weight_senders_;
+  std::map<std::string, std::string> group_pins_;
+  std::deque<std::string> group_pin_order_;
   int groups_per_sender_ = 1;
   size_t sender_rr_ = 0;
   size_t rr_counter_ = 0;

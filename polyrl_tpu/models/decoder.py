@@ -880,6 +880,72 @@ def prefill_suffix_into_pages(
     return (k_pools, v_pools), last_logits[0]
 
 
+def prefill_suffix_batch_into_pages(
+    params: dict,
+    cfg: ModelConfig,
+    ids: jnp.ndarray,             # [B, pb] int32 right-padded suffix tokens
+    suffix_lens: jnp.ndarray,     # [B] int32 — real suffix tokens per row
+    prefix_len: jnp.ndarray,      # scalar int32 — cached tokens, UNIFORM
+    pools: tuple,
+    prefix_page_ids: jnp.ndarray, # [B, n_prefix_pg] int32 (null-padded tail)
+    page_ids: jnp.ndarray,        # [B, pb // page_size] int32 suffix pages
+) -> tuple[tuple, jnp.ndarray]:
+    """Batched prefix-cache prefill: B suffixes in ONE dispatch, each
+    attending over its own cached prefix pages — the group-shared-prefill
+    sibling attach. GRPO's G-samples-per-prompt means the G−1 siblings of a
+    published prompt arrive together with IDENTICAL prefix length; admitting
+    them as G−1 serialized singleton suffix dispatches made the admission
+    dispatch count linear in the rollout count (DualKV's exact target
+    workload). Requires a UNIFORM ``prefix_len`` across rows (the scratch
+    cache's write offset is one traced scalar); rows may differ in suffix
+    content/length and prefix pages. Returns (updated pools, last-token
+    logits [B, V] f32)."""
+    page_size = pools[0][0].shape[2]
+    b, pb = ids.shape
+    n_pg = pb // page_size
+    n_prefix_pg = prefix_page_ids.shape[1]
+    prefix_cap = n_prefix_pg * page_size
+    layers = cfg.num_layers
+    hkv, hd = cfg.num_kv_heads, cfg.head_dim_
+
+    # dense scratch cache per row: [prefix_cap | suffix chunk]
+    s_total = prefix_cap + pb
+    cache = make_cache(cfg, b, s_total, dtype=pools[0][0].dtype)
+    # per layer [hkv, B, n_pre, page, hd] → dense [L, B, prefix_cap, hkv, hd]
+    k_pre = jnp.stack([pools[0][l][:, prefix_page_ids] for l in range(layers)])
+    v_pre = jnp.stack([pools[1][l][:, prefix_page_ids] for l in range(layers)])
+    k_pre = k_pre.transpose(0, 2, 3, 4, 1, 5)
+    v_pre = v_pre.transpose(0, 2, 3, 4, 1, 5)
+    cache = (
+        cache[0].at[:, :, :prefix_cap].set(
+            k_pre.reshape(layers, b, prefix_cap, hkv, hd)),
+        cache[1].at[:, :, :prefix_cap].set(
+            v_pre.reshape(layers, b, prefix_cap, hkv, hd)),
+    )
+    positions = jnp.broadcast_to(
+        prefix_len + jnp.arange(pb, dtype=jnp.int32), (b, pb))
+    slot_idx = jnp.arange(s_total)
+    valid = ((slot_idx[None, :] < prefix_len)
+             | ((slot_idx[None, :] >= prefix_len)
+                & (slot_idx[None, :] < prefix_len + suffix_lens[:, None])))
+    last_logits, (k_all, v_all) = forward(
+        params, cfg, ids, positions, valid.astype(jnp.float32),
+        cache=cache, write_idx=prefix_len,
+        logits_for=jnp.maximum(suffix_lens - 1, 0))
+
+    k_sfx = jax.lax.dynamic_slice_in_dim(k_all, prefix_len, pb, axis=2)
+    v_sfx = jax.lax.dynamic_slice_in_dim(v_all, prefix_len, pb, axis=2)
+    # [L, B, pb, hkv, hd] → per layer [hkv, B·n_pg, page, hd]
+    k_r = k_sfx.reshape(layers, b * n_pg, page_size, hkv, hd).transpose(0, 3, 1, 2, 4)
+    v_r = v_sfx.reshape(layers, b * n_pg, page_size, hkv, hd).transpose(0, 3, 1, 2, 4)
+    flat_pages = page_ids.reshape(-1)
+    k_pools = tuple(_scatter_pages_kv(pools[0][l], flat_pages, k_r[l])
+                    for l in range(layers))
+    v_pools = tuple(_scatter_pages_kv(pools[1][l], flat_pages, v_r[l])
+                    for l in range(layers))
+    return (k_pools, v_pools), last_logits
+
+
 def make_cache(cfg: ModelConfig, batch: int, max_len: int, dtype=None) -> tuple:
     """Allocate a zeroed KV cache: (k, v) each [L, B, S, Hkv, D]."""
     dtype = dtype or cfg.dtype

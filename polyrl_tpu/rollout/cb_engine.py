@@ -127,6 +127,15 @@ class _Request:
     out: queue.Queue
     abort: Any  # threading.Event-like or None
     t_submit: float = 0.0  # admission timestamp (per-request latency obs)
+    # group-shared prefill hint (GRPO: rollout_n completions of one prompt
+    # submitted together): members of a group share group_id; group_size is
+    # the expected member count. The engine prefills the shared prompt ONCE
+    # and batch-attaches the siblings to the published pages — the hint
+    # sizes the pre-taken prefix refs; the attach batching itself is
+    # structural (prompt-equality through the prefix cache), so a missing
+    # or wrong hint degrades to per-request admission, never corrupts.
+    group_id: str = ""
+    group_size: int = 0
 
 
 @dataclasses.dataclass
@@ -191,6 +200,9 @@ class CBEngine:
         spec_tokens: int = 0,
         spec_rounds: int = 2,
         salvage_partials: bool = True,
+        admit_wave: int | None = None,
+        admit_reorder_window: int = 8,
+        group_share: bool = True,
     ):
         if any(b % page_size for b in prompt_buckets):
             raise ValueError("prompt buckets must be page-aligned")
@@ -339,6 +351,32 @@ class CBEngine:
         self.spec_emitted = 0     # tokens emitted by spec dispatches
         self.spec_dispatches = 0  # spec dispatch count (acceptance telemetry)
         self.chunk_dispatches = 0  # chunked-prefill extend dispatch count
+
+        # admission scheduler geometry (ARCHITECTURE.md "Group-shared
+        # prefill"). admit_wave: max admissions fused into one batched
+        # prefill dispatch. admit_reorder_window: how many blocked heads
+        # _collect_wave may SKIP past while forming a wave (a sibling
+        # waiting for its leader's publish, a prefix hit amid a fresh wave,
+        # a chunk-bound prompt) so one waiting request never freezes
+        # admission of everything queued behind it; 0 restores strict FIFO
+        # head-of-line. group_share: prefill a shared prompt once and
+        # batch-attach its siblings to the published pages (False restores
+        # per-request singleton suffix admission — the bench A/B baseline).
+        self.admit_wave = max(1, int(admit_wave if admit_wave is not None
+                                     else self.ADMIT_WAVE))
+        self.admit_reorder_window = max(0, int(admit_reorder_window))
+        self.group_share = bool(group_share)
+        # admission counters (server_info / bench): dispatches, not
+        # requests — the dispatch count is what bounds admission throughput
+        self.prefill_dispatches = 0         # all admission dispatches
+        self.sibling_attach_dispatches = 0  # batched suffix-attach dispatches
+        self.group_forked_requests = 0      # requests admitted by attach wave
+        # group pre-ref registry: leader publish pre-takes group_size-1 refs
+        # on the shared prefix entries so pool-pressure eviction can't race
+        # the siblings' attach; consumed per attach, TTL-swept for groups
+        # whose siblings never arrive, disbanded on any cache flush.
+        # Guarded by _pool_lock (same discipline as the prefix cache).
+        self._group_prerefs: dict[str, dict] = {}
 
         # token-level continuous generation (partial-rollout salvage): on
         # abort/preempt/shutdown the run-ahead pipeline is DRAINED into the
@@ -771,16 +809,22 @@ class CBEngine:
 
     def _pack_suffix(self, tokens, suffix_len: int, prefix_len: int,
                      prefix_pages: list[int], sfx_pages: list[int],
-                     row, stops, slot: int, budget: int, sp):
+                     row, stops, slot: int, budget: int, sp,
+                     pb: int | None = None, n_pre_b: int | None = None):
         """Shared packing for the suffix-attending prefill variants (cache
-        hit, chunk extend, chunk final): returns (packed, pb, n_pre_b)."""
-        pb = next_bucket(suffix_len, self.prompt_buckets)
+        hit, chunk extend, chunk final): returns (packed, pb, n_pre_b).
+        ``pb``/``n_pre_b`` override the per-request buckets — the batched
+        sibling attach packs every wave row to ONE (suffix, prefix-page)
+        bucket pair."""
+        if pb is None:
+            pb = next_bucket(suffix_len, self.prompt_buckets)
         n_sfx_pages = -(-suffix_len // self.page_size)
         page_ids = np.zeros((pb // self.page_size,), np.int32)
         page_ids[:n_sfx_pages] = sfx_pages[:n_sfx_pages]
-        n_pre_b = 1
-        while n_pre_b < len(prefix_pages):
-            n_pre_b *= 2
+        if n_pre_b is None:
+            n_pre_b = 1
+            while n_pre_b < len(prefix_pages):
+                n_pre_b *= 2
         prefix_ids = np.zeros((n_pre_b,), np.int32)
         prefix_ids[:len(prefix_pages)] = prefix_pages
         ids = np.full((pb,), self.pad_token_id, np.int32)
@@ -882,6 +926,61 @@ class CBEngine:
             self._prefill_fns[key] = jax.jit(prefill, donate_argnums=(1, 2))
         return self._prefill_fns[key]
 
+    def _get_prefill_suffix_batch(self, pb: int, nb: int, n_prefix_pg: int,
+                                  use_filters: bool):
+        """Batched sibling attach: ``nb`` full prefix hits with a UNIFORM
+        prefix length prefill their suffixes + sample + insert in ONE
+        dispatch (``decoder.prefill_suffix_batch_into_pages``). GRPO's
+        G−1 siblings of a published prompt used to admit as G−1 serialized
+        singleton suffix dispatches — admission dispatch count linear in
+        the rollout count. Wave padding rows target the SINK state row,
+        exactly like ``_get_prefill_batch``."""
+        key = ("sfxb", pb, nb, n_prefix_pg, use_filters)
+        if key not in self._prefill_fns:
+            cfg = self.cfg
+            n_pg, pps = pb // self.page_size, self.pages_per_slot
+
+            def prefill(params, kp, vp, packed, rng, **state):
+                o = 0
+                ids = packed[:, o:o + pb]; o += pb
+                page_ids = packed[:, o:o + n_pg]; o += n_pg
+                rows = packed[:, o:o + pps]; o += pps
+                stop_rows = packed[:, o:o + MAX_STOP_TOKENS]; o += MAX_STOP_TOKENS
+                prefix_ids = packed[:, o:o + n_prefix_pg]; o += n_prefix_pg
+                sc = packed[:, o:]
+                suffix_lens, slots = sc[:, 0], sc[:, 2]
+                budgets, top_ks = sc[:, 3], sc[:, 4]
+                # prefix_len is UNIFORM across the wave (attach contract);
+                # row 0 is always a real request (padding is appended)
+                prefix_len = sc[0, 1]
+                temps = jax.lax.bitcast_convert_type(sc[:, 5], jnp.float32)
+                top_ps = jax.lax.bitcast_convert_type(sc[:, 6], jnp.float32)
+                (kp, vp), last_logits = decoder.prefill_suffix_batch_into_pages(
+                    params, cfg, ids, suffix_lens, prefix_len, (kp, vp),
+                    prefix_ids, page_ids)
+                rng, sub = jax.random.split(rng)
+                token, logp = sample_token_vec(
+                    last_logits, sub, temps, top_ps, top_ks,
+                    use_filters=use_filters)
+                done = (jnp.any(token[:, None] == stop_rows, axis=-1)
+                        | (budgets <= 1))
+                st = dict(state)
+                st["seq_lens"] = st["seq_lens"].at[slots].set(
+                    prefix_len + suffix_lens)
+                st["last_tokens"] = st["last_tokens"].at[slots].set(token)
+                st["n_generated"] = st["n_generated"].at[slots].set(1)
+                st["budgets"] = st["budgets"].at[slots].set(budgets)
+                st["active"] = st["active"].at[slots].set(~done)
+                st["temps"] = st["temps"].at[slots].set(temps)
+                st["top_ps"] = st["top_ps"].at[slots].set(top_ps)
+                st["top_ks"] = st["top_ks"].at[slots].set(top_ks)
+                st["stop_table"] = st["stop_table"].at[slots].set(stop_rows)
+                st["page_table"] = st["page_table"].at[slots].set(rows)
+                return kp, vp, rng, token, logp, done, st
+
+            self._prefill_fns[key] = jax.jit(prefill, donate_argnums=(1, 2))
+        return self._prefill_fns[key]
+
     def _sink_pad_row(self, pb: int, n_pre: int = 0) -> np.ndarray:
         """A packed prefill row targeting the SINK state row (index
         max_slots): budget 0 → immediately done/inactive, pages all null.
@@ -935,6 +1034,26 @@ class CBEngine:
                                 self._get_prefill_suffix(pb, n_pre, uf),
                                 jnp.asarray(self._sink_pad_row(pb, n_pre)))
                             n_pre *= 2
+                    if (suffix and self.group_share
+                            and pb == self.prompt_buckets[0]):
+                        # batched sibling-attach variants: a true attach
+                        # wave's suffix is ≤ page_size tokens (full-hit
+                        # members), so only the FIRST suffix bucket ever
+                        # dispatches — but the prefix-page bucket spans up
+                        # to the largest prompt's pages. Warm the full-wave
+                        # batch size only (a full GRPO group's siblings);
+                        # smaller waves compile on first dispatch.
+                        nb_full = max(batch_sizes)
+                        n_pre = 1
+                        while n_pre <= max(
+                                1, self.prompt_buckets[-1] // self.page_size):
+                            self._warm_call(
+                                self._get_prefill_suffix_batch(
+                                    pb, nb_full, n_pre, uf),
+                                jnp.asarray(np.stack(
+                                    [self._sink_pad_row(pb, n_pre)]
+                                    * nb_full)))
+                            n_pre *= 2
             for uf in filter_variants:
                 st = self._dev_state
                 t0 = time.monotonic()
@@ -982,10 +1101,12 @@ class CBEngine:
     # -- submission API (server-facing) -------------------------------------
 
     def submit(self, rid: str, input_ids: list[int], sampling: SamplingParams,
-               out: queue.Queue | None = None, abort=None) -> queue.Queue:
+               out: queue.Queue | None = None, abort=None,
+               group_id: str = "", group_size: int = 0) -> queue.Queue:
         out = out if out is not None else queue.Queue()
         self._queue.put(_Request(rid, list(input_ids), sampling, out, abort,
-                                 time.monotonic()))
+                                 time.monotonic(), group_id=str(group_id),
+                                 group_size=int(group_size)))
         self.num_queued = self._queue.qsize() + len(self._pending)
         return out
 
@@ -1036,6 +1157,7 @@ class CBEngine:
             # a stopped engine's cached KV (including salvage-published
             # pages) is dead weight: hand every unreferenced page back so
             # page accounting balances after shutdown
+            self._disband_group_prerefs()
             self.prefix_cache.flush()
         while self._chunk_jobs:
             job = self._chunk_jobs.popleft()
@@ -1071,8 +1193,11 @@ class CBEngine:
         self.weight_version = self.weight_version + 1 if version is None else version
         if self.prefix_cache is not None:
             # cached KV belongs to the old weights (the reference flushes the
-            # radix cache after every update, patches.py:374-377)
+            # radix cache after every update, patches.py:374-377); group
+            # pre-refs ride the entries being flushed — disband them first
+            # or the orphans' pages stay pinned until the TTL sweep
             with self._pool_lock:
+                self._disband_group_prerefs()
                 self.prefix_cache.flush()
 
     def reset_throughput_window(self) -> None:
@@ -1088,6 +1213,7 @@ class CBEngine:
         phases)."""
         with self._pool_lock:
             if self.prefix_cache is not None:
+                self._disband_group_prerefs()
                 self.prefix_cache.flush()
 
     def release_memory(self) -> None:
@@ -1103,6 +1229,7 @@ class CBEngine:
                     # re-dispatches aborted requests)
                     self._abort_chunk_jobs()
                     if self.prefix_cache is not None:
+                        self._disband_group_prerefs()
                         self.prefix_cache.flush()
                     self._pools = None
 
@@ -1180,6 +1307,7 @@ class CBEngine:
         with self._pool_lock:
             self._abort_chunk_jobs()
             if self.prefix_cache is not None:
+                self._disband_group_prerefs()
                 self.prefix_cache.flush()
             self._pools = self._make_pools()
 
@@ -1192,10 +1320,12 @@ class CBEngine:
         self.num_queued = len(self._pending)
 
     ADMIT_WAVE = 8  # max admissions fused into one batched prefill dispatch
+    GROUP_PREREF_TTL_S = 30.0  # sibling-wait pre-ref expiry (dropped groups)
 
     def _admit(self) -> None:
+        self._sweep_group_prerefs()
         while self._pending:
-            wave = self._collect_wave()
+            wave, kind = self._collect_wave()
             if not wave:
                 break
             try:
@@ -1203,8 +1333,11 @@ class CBEngine:
                 if len(wave) == 1:
                     req, slot, pages, budget, mp, me = wave[0]
                     self._prefill_request(slot, req, pages, budget, mp, me)
+                elif kind == "attach":
+                    self._prefill_attach_wave(wave)
                 else:
                     self._prefill_wave(wave)
+                self.prefill_dispatches += 1
                 self._tmark("prefill_dispatch", t0)
                 self.deck.on_admit_wave(len(wave))
             except Exception:
@@ -1216,15 +1349,37 @@ class CBEngine:
                 raise  # pools may be donation-poisoned: let _recover reset
         self.num_queued = len(self._pending)
 
-    def _collect_wave(self) -> list:
-        """Pop up to ADMIT_WAVE admissible requests, reserving a slot + pages
-        for each: (req, slot, pages, budget, matched_pages, matched_entries).
-        A prefix-cache hit is only ever a singleton (the suffix-prefill
-        variant is per-request) and ends a forming wave."""
+    def _collect_wave(self) -> tuple[list, str]:
+        """Collect up to ``admit_wave`` admissible requests, reserving a
+        slot + pages for each: (req, slot, pages, budget, matched_pages,
+        matched_entries), plus the wave kind:
+
+        - ``"fresh"`` — no cached prefix anywhere in the wave: one batched
+          full-prompt prefill (or a singleton).
+        - ``"attach"`` — every member is a FULL prefix hit with the same
+          prefix page count (GRPO siblings of a published prompt, or any
+          equal-length full hits): one batched suffix dispatch. Partial
+          hits stay singletons — their suffix publishes fresh pages, and
+          two same-prompt partials in one dispatch would duplicate that
+          publish instead of chaining off it.
+
+        Admission reorder window: a head that cannot join the forming wave
+        (a sibling waiting for its leader's publish, a prefix hit amid a
+        fresh wave, a chunk-bound prompt) is SKIPPED — left pending while
+        scanning continues — up to ``admit_reorder_window`` skips, instead
+        of ``break``-ing admission for every unrelated request queued
+        behind it. Page exhaustion still ends the scan: skipping past a
+        page-starved head would let small requests starve big ones."""
         wave: list = []
+        kind = "fresh"
+        attach_len = -1  # prefix page count of a forming attach wave
         assigned: set[int] = set()
         wave_page_keys: set = set()
-        while self._pending and len(wave) < self.ADMIT_WAVE:
+        chunk_keys = {job.get("first_key") for job in self._chunk_jobs}
+        chunk_keys.discard(None)
+        skipped = 0
+        scan = 0
+        while len(wave) < self.admit_wave and scan < len(self._pending):
             free = [int(i) for i in np.flatnonzero(
                         ~self._active & np.asarray(
                             [s is None for s in self._slots]))
@@ -1239,58 +1394,74 @@ class CBEngine:
                     self._drain_emit_q(keep=out - 1)
                     continue
                 break
-            req = self._pending[0]
+            req = self._pending[scan]
             if req.abort is not None and req.abort.is_set():
-                self._pending.popleft()
+                del self._pending[scan]
                 self._emit_abort(req)
+                self._consume_group_preref(req)  # sibling that never attaches
                 continue
             n_prompt = len(req.input_ids)
             if n_prompt == 0 or n_prompt > min(self.max_seq_len - 1,
                                                self.prompt_buckets[-1]):
-                self._pending.popleft()
+                del self._pending[scan]
                 self._emit_error(req, f"prompt length {n_prompt} unsupported")
+                self._consume_group_preref(req)
                 continue
             budget = min(req.sampling.max_new_tokens,
                          self.max_seq_len - n_prompt)
             n_pages = -(-(n_prompt + budget) // self.page_size)
+            n_full = max(0, (n_prompt - 1) // self.page_size)
             matched_pages: list[int] = []
             matched_entries: list = []
+            first_key = None
             if self.prefix_cache is not None:
                 matched_pages, matched_entries = self.prefix_cache.match(
                     req.input_ids)
-            if matched_pages and wave:
-                # flush the no-hit wave first; re-match next round
-                self.prefix_cache.release(matched_entries)
-                break
-            if self.prefix_cache is not None and not matched_pages:
-                # a prompt sharing full pages with one ALREADY in this wave
-                # must wait for that request's publish (GRPO sends n samples
-                # of each prompt together — batching them into one wave
-                # would structurally defeat the prefix cache)
-                first_key = (self.prefix_cache._keys_for(req.input_ids, 1)[0]
-                             if (n_prompt - 1) >= self.page_size else None)
-                if first_key is not None and first_key in wave_page_keys:
-                    break
-                if first_key is not None:
-                    wave_page_keys.add(first_key)
+                if n_full > 0:
+                    first_key = self.prefix_cache._keys_for(
+                        req.input_ids, 1)[0]
+            full_hit = bool(matched_pages) and len(matched_pages) == n_full
+            # sibling wait: the prompt's first full page is being computed
+            # by a request already in this wave (GRPO siblings of an
+            # unpublished leader) or by an in-flight chunked prefill job —
+            # admitting it now would recompute the prefix that is about to
+            # be published (structurally defeating the cache)
+            sibling_blocked = (not matched_pages and first_key is not None
+                              and (first_key in wave_page_keys
+                                   or first_key in chunk_keys))
             prefix_cached = len(matched_pages) * self.page_size
             chunked = (self.prefill_chunk
                        and n_prompt - prefix_cached > self.prefill_chunk)
-            if chunked and wave:
-                # flush the formed wave first; chunk-admit next round
+            blocked = sibling_blocked
+            if wave:
+                if kind == "attach":
+                    blocked = blocked or chunked or not (
+                        full_hit and len(matched_pages) == attach_len)
+                else:
+                    blocked = blocked or chunked or bool(matched_pages)
+            if blocked:
                 if self.prefix_cache is not None:
                     self.prefix_cache.release(matched_entries)
-                break
+                if skipped >= self.admit_reorder_window:
+                    break  # window exhausted: stop reordering, flush wave
+                skipped += 1
+                scan += 1
+                continue
             need = n_pages - len(matched_pages)
             pages = self._try_alloc(need, matched_entries)
             if pages is None:
-                break  # head-of-line waits for pages to free
-            self._pending.popleft()
+                break  # pages exhausted: wait (no skip — alloc fairness)
+            del self._pending[scan]
             slot = free[0]
             assigned.add(slot)
+            if self.prefix_cache is not None:
+                self.prefix_cache.note_request(bool(matched_pages))
             if chunked:
                 # reserve the slot (placeholder keeps it out of the free
-                # scan; active stays False until the final chunk inserts)
+                # scan; active stays False until the final chunk inserts).
+                # first_key marks the in-flight prompt so group siblings
+                # WAIT for the final chunk's publish instead of
+                # re-prefilling the whole prompt in parallel
                 self._slots[slot] = _SlotInfo(
                     req, list(pages), set(req.sampling.stop_token_ids),
                     cache_entries=list(matched_entries))
@@ -1300,13 +1471,25 @@ class CBEngine:
                     "matched_entries": list(matched_entries),
                     "budget": budget, "pos": prefix_cached,
                     "own_filled": 0, "version": self.weight_version,
+                    "first_key": first_key,
                 })
+                chunk_keys.add(first_key)
                 continue
+            if not wave and matched_pages:
+                if full_hit and self.group_share:
+                    # start an attach wave: later equal-prefix full hits
+                    # (the other G-1 siblings) join this dispatch
+                    kind, attach_len = "attach", len(matched_pages)
+                else:
+                    # partial hit (or sharing disabled): singleton suffix
+                    wave.append((req, slot, pages, budget, matched_pages,
+                                 matched_entries))
+                    break
+            if not matched_pages and first_key is not None:
+                wave_page_keys.add(first_key)
             wave.append((req, slot, pages, budget, matched_pages,
                          matched_entries))
-            if matched_pages:
-                break  # prefix hits admit as singletons
-        return wave
+        return wave, kind
 
     def _try_alloc(self, need: int, matched_entries: list):
         """Page allocation with the drain + cache-evict fallbacks; releases
@@ -1398,9 +1581,151 @@ class CBEngine:
                 self._hist[slot] = list(req.input_ids)
             self._slot_gen[slot] += 1
             self.deck.on_admit(slot, req.rid, req.t_submit, n_prompt)
+            self._consume_group_preref(req)
+            self._register_group_prerefs(req, entries)
             idxs.append((slot, int(self._slot_gen[slot])))
         self._enqueue_output(("prefillb", (token, logp, done), idxs,
                               self.weight_version))
+
+    def _prefill_attach_wave(self, wave: list) -> None:
+        """Batched sibling attach: every wave member is a FULL prefix hit
+        with the SAME prefix page count (GRPO siblings of a published
+        leader, or any equal-length full hits) — one
+        ``_get_prefill_suffix_batch`` dispatch admits them all, replacing
+        G−1 serialized singleton suffix dispatches. Full hits publish
+        nothing (the whole prompt's full pages are already cached), so the
+        members' suffix/decode pages stay slot-private and their cache
+        refs are exactly the ``match()`` entries."""
+        self._ensure_dev_state()
+        state_kwargs = {k: self._dev_state[k] for k in self._STATE_KEYS}
+        attach_pages = len(wave[0][4])
+        prefix_len = attach_pages * self.page_size
+        pb = next_bucket(max(len(r.input_ids) - prefix_len
+                             for r, *_ in wave), self.prompt_buckets)
+        n_pre_b = 1
+        while n_pre_b < attach_pages:
+            n_pre_b *= 2
+        use_filters = any(r.sampling.top_p < 1.0 or r.sampling.top_k > 0
+                          for r, *_ in wave)
+        rows_np, metas = [], []
+        for req, slot, pages, budget, mp, me in wave:
+            sp = req.sampling
+            n_prompt = len(req.input_ids)
+            all_pages = mp + pages
+            row = np.zeros((self.pages_per_slot,), np.int32)
+            row[:len(all_pages)] = all_pages
+            stops = np.full((MAX_STOP_TOKENS,), -1, np.int32)
+            for i, t in enumerate(sp.stop_token_ids[:MAX_STOP_TOKENS]):
+                stops[i] = t
+            packed, _pb, _np = self._pack_suffix(
+                req.input_ids[prefix_len:], n_prompt - prefix_len,
+                prefix_len, mp, pages, row, stops, slot, budget, sp,
+                pb=pb, n_pre_b=n_pre_b)
+            rows_np.append(packed)
+            metas.append((req, slot, pages, budget, row, stops, me))
+        nb = next_bucket(len(wave), (2, 4, 8))
+        if len(rows_np) < nb:
+            pad_row = self._sink_pad_row(pb, n_pre_b)
+            while len(rows_np) < nb:
+                rows_np.append(pad_row)
+        fn = self._get_prefill_suffix_batch(pb, nb, n_pre_b, use_filters)
+        kp, vp, self._rng, token, logp, done, new_st = fn(
+            self.params, self._pools[0], self._pools[1],
+            jnp.asarray(np.stack(rows_np)), self._rng, **state_kwargs)
+        self._pools = (kp, vp)
+        self._carry_spec_state(new_st,
+                               [(slot, req.input_ids)
+                                for req, slot, *_rest in metas])
+        self._dev_state = new_st
+
+        idxs = []
+        for req, slot, pages, budget, row, stops, me in metas:
+            sp = req.sampling
+            n_prompt = len(req.input_ids)
+            self._page_table[slot] = row
+            self._seq_lens[slot] = n_prompt
+            self._last_tokens[slot] = self.pad_token_id
+            self._n_generated[slot] = 1
+            self._budgets[slot] = budget
+            self._active[slot] = True
+            self._temps[slot] = sp.temperature
+            self._top_ps[slot] = sp.top_p
+            self._top_ks[slot] = sp.top_k
+            self._stop_table[slot] = stops
+            self._slots[slot] = _SlotInfo(req, list(pages),
+                                          set(sp.stop_token_ids),
+                                          cache_entries=list(me),
+                                          admit_version=self.weight_version)
+            if self._hist is not None:
+                self._hist[slot] = list(req.input_ids)
+            self._slot_gen[slot] += 1
+            self.deck.on_admit(slot, req.rid, req.t_submit, n_prompt,
+                               cached_tokens=prefix_len)
+            self._consume_group_preref(req)
+            idxs.append((slot, int(self._slot_gen[slot])))
+        self.sibling_attach_dispatches += 1
+        self.group_forked_requests += len(wave)
+        self._enqueue_output(("prefillb", (token, logp, done), idxs,
+                              self.weight_version))
+
+    # -- group-shared prefill pre-refs ---------------------------------------
+
+    def _register_group_prerefs(self, req: _Request, entries: list) -> None:
+        """After a group leader's prompt pages publish, pre-take
+        ``group_size−1`` refs on the chain so pool-pressure eviction can't
+        reclaim the shared prefix before the siblings attach. Refs are
+        dropped one unit per sibling admission (``_consume_group_preref``),
+        TTL-swept for groups whose siblings never arrive, and disbanded
+        before any cache flush (the entries are about to be orphaned)."""
+        if (not self.group_share or self.prefix_cache is None
+                or not req.group_id or req.group_size <= 1 or not entries
+                or req.group_id in self._group_prerefs):
+            return
+        n = req.group_size - 1
+        self.prefix_cache.retain(entries, n)
+        self._group_prerefs[req.group_id] = {
+            "entries": list(entries), "remaining": n,
+            "t": time.monotonic(),
+        }
+
+    def _consume_group_preref(self, req: _Request) -> None:
+        """One group member accounted for (admitted, aborted, or errored
+        pre-admission): drop one pre-ref unit on the group's chain."""
+        if not req.group_id:
+            return
+        g = self._group_prerefs.get(req.group_id)
+        if g is None:
+            return
+        if self.prefix_cache is not None:
+            self.prefix_cache.release(g["entries"])
+        g["remaining"] -= 1
+        if g["remaining"] <= 0:
+            del self._group_prerefs[req.group_id]
+
+    def _sweep_group_prerefs(self) -> None:
+        """Expire pre-refs for groups whose siblings never arrived (dropped
+        groups, mis-sized hints) so the shared pages return to normal LRU
+        eviction instead of being pinned forever."""
+        if not self._group_prerefs:
+            return
+        now = time.monotonic()
+        for gid in [g for g, v in self._group_prerefs.items()
+                    if now - v["t"] > self.GROUP_PREREF_TTL_S]:
+            g = self._group_prerefs.pop(gid)
+            if self.prefix_cache is not None:
+                for _ in range(max(0, g["remaining"])):
+                    self.prefix_cache.release(g["entries"])
+
+    def _disband_group_prerefs(self) -> None:
+        """Release every outstanding pre-ref NOW — called before any cache
+        flush (weight swap, memory release, recover, shutdown): the flush
+        orphans the entries, and pre-refs on orphans would pin their pages
+        until the TTL sweep."""
+        for g in self._group_prerefs.values():
+            if self.prefix_cache is not None:
+                for _ in range(max(0, g["remaining"])):
+                    self.prefix_cache.release(g["entries"])
+        self._group_prerefs.clear()
 
     def _prefill_request(self, slot: int, req: _Request, pages: list[int],
                          budget: int, matched_pages: list[int] | None = None,
@@ -1467,6 +1792,8 @@ class CBEngine:
             pub_pages = {e.page for _, e in published}
             private = [p for p in pages if p not in pub_pages]
             matched_entries += [e for _, e in published]
+        self._consume_group_preref(req)
+        self._register_group_prerefs(req, matched_entries)
 
         # host mirrors: everything except the (device-side) first token;
         # _emit_prefill fills last_tokens when the output is drained, and

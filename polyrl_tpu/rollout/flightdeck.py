@@ -125,6 +125,11 @@ class EngineFlightDeck:
         # scheduler-side cumulative totals (counted at dispatch/emission)
         self.sched_prefill_tokens = 0
         self.sched_decode_tokens = 0
+        # prompt tokens served from cached/group-shared pages instead of
+        # being recomputed (group-shared prefill headline signal):
+        # prefill_reuse_frac = cached / sched_prefill. Counted at admission
+        # like sched_prefill_tokens — reuse is a scheduler-side property.
+        self.cached_prompt_tokens = 0
 
         # scheduler step ledger (updated per decode dispatch / admission)
         self.decode_dispatches = 0
@@ -165,6 +170,7 @@ class EngineFlightDeck:
                                           int(prompt_tokens),
                                           int(cached_tokens))
             self.sched_prefill_tokens += int(prompt_tokens)
+            self.cached_prompt_tokens += int(cached_tokens)
             self.admitted_requests += 1
             self.hists["queue_wait_s"].observe(qw)
         observe("engine/queue_wait_s", qw)
@@ -270,6 +276,14 @@ class EngineFlightDeck:
             return 1.0
         return (self.req_prefill_tokens + self.req_decode_tokens) / sched
 
+    def prefill_reuse_frac(self) -> float:
+        """Fraction of admitted prompt tokens whose KV came from the prefix
+        cache / a group-shared leader instead of being recomputed — the
+        group-shared-prefill headline. 0.0 before any admission."""
+        if self.sched_prefill_tokens == 0:
+            return 0.0
+        return self.cached_prompt_tokens / self.sched_prefill_tokens
+
     def server_info_fields(self) -> dict:
         """Flat keys merged into ``server_info`` — what the C++ manager's
         stats poller forwards and bench reads. Names stay flat (no ``/``)
@@ -291,6 +305,7 @@ class EngineFlightDeck:
                 "tpot_p95_s": round(p.percentile(95.0), 6),
                 "queue_wait_p95_s": round(q.percentile(95.0), 6),
                 "attributed_frac": round(self.attributed_frac(), 6),
+                "prefill_reuse_frac": round(self.prefill_reuse_frac(), 6),
             }
         return out
 
@@ -316,7 +331,9 @@ class EngineFlightDeck:
                     "req_decode": self.req_decode_tokens,
                     "sched_prefill": self.sched_prefill_tokens,
                     "sched_decode": self.sched_decode_tokens,
+                    "cached_prompt": self.cached_prompt_tokens,
                     "attributed_frac": round(self.attributed_frac(), 6),
+                    "prefill_reuse_frac": round(self.prefill_reuse_frac(), 6),
                 },
                 "occupancy": {
                     "last": round(self.occupancy_last, 4),

@@ -274,6 +274,15 @@ class PoolManager:
                 float(i.get("last_gen_throughput", 0.0)) for i in rep),
             "engine/attributed_frac_min": min(
                 float(i.get("attributed_frac", 1.0)) for i in rep),
+            # group-shared prefill: fleet-mean fraction of prompt tokens
+            # served from shared/cached pages, and the request-level
+            # (length-unbiased) prefix hit fraction
+            "engine/prefill_reuse_frac": (
+                sum(float(i.get("prefill_reuse_frac", 0.0)) for i in rep)
+                / len(rep)),
+            "engine/prefix_hit_frac": (
+                sum(float(i.get("prefix_hit_frac", 0.0)) for i in rep)
+                / len(rep)),
         }
 
     def engine_section(self) -> dict:
@@ -295,6 +304,8 @@ class PoolManager:
                 "cache_hit_rate": float(i.get("cache_hit_rate", 0.0)),
                 "spec_accept_rate": float(i.get("spec_accept_rate", 0.0)),
                 "attributed_frac": float(i.get("attributed_frac", 1.0)),
+                "prefill_reuse_frac": float(
+                    i.get("prefill_reuse_frac", 0.0)),
                 "throughput_tok_s": float(i.get("last_gen_throughput", 0.0)),
                 "running": int(i.get("num_running_reqs", 0)),
             } for i in insts if "occupancy" in i],

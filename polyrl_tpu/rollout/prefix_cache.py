@@ -41,6 +41,13 @@ class PrefixCache:
         self._tick = 0
         self.hits = 0       # pages served from cache
         self.misses = 0     # full pages prefilled fresh
+        # request-level counters: the page-granular hits/misses above are
+        # length-skewed (one 4k-prompt hit counts 64× a 128-token hit), so
+        # the reported hit RATE said nothing about how many requests
+        # actually skipped prefill work. The engine notes one hit/miss per
+        # admitted request (any matched page = hit).
+        self.req_hits = 0
+        self.req_misses = 0
 
     # -- keys ---------------------------------------------------------------
 
@@ -139,7 +146,26 @@ class PrefixCache:
         self.misses += max(0, n_full - n_cached)
         return out
 
+    def note_request(self, hit: bool) -> None:
+        """One admitted request's cache outcome (request-granular — the
+        page counters in ``match``/``publish`` stay as they are)."""
+        if hit:
+            self.req_hits += 1
+        else:
+            self.req_misses += 1
+
     # -- refs ----------------------------------------------------------------
+
+    def retain(self, entries: list[_Entry], n: int = 1) -> None:
+        """Take ``n`` extra refs on each entry (group-shared prefill
+        pre-refs: a leader's publish pre-takes group_size−1 refs so
+        pool-pressure eviction cannot race its siblings' attach; each ref
+        is dropped via ``release`` as a sibling attaches or the group's
+        pre-refs are swept/disbanded)."""
+        if n <= 0:
+            return
+        for e in entries:
+            e.refcount += n
 
     def release(self, entries: list[_Entry]) -> None:
         freed: list[int] = []
@@ -183,8 +209,17 @@ class PrefixCache:
     def num_entries(self) -> int:
         return len(self._map)
 
+    @property
+    def request_hit_frac(self) -> float:
+        """Request-level hit fraction (length-unbiased, unlike hit_rate)."""
+        total = self.req_hits + self.req_misses
+        return self.req_hits / total if total else 0.0
+
     def stats(self) -> dict:
         total = self.hits + self.misses
         return {"prefix_cache/entries": float(len(self._map)),
                 "prefix_cache/hit_pages": float(self.hits),
-                "prefix_cache/hit_rate": self.hits / total if total else 0.0}
+                "prefix_cache/hit_rate": self.hits / total if total else 0.0,
+                "prefix_cache/req_hits": float(self.req_hits),
+                "prefix_cache/req_misses": float(self.req_misses),
+                "prefix_cache/req_hit_frac": self.request_hit_frac}

@@ -291,7 +291,15 @@ class RemoteRollout:
                 window_timer.daemon = True
                 window_timer.start()
         stream_tag = f"s{next(self._stream_seq)}:"
+        # group-shared prefill hint: requests i*G..(i+1)*G-1 share a prompt
+        # (GRPO's n samples), so each carries a stream-unique group_id +
+        # group_size. The manager pins a whole group to ONE engine (its
+        # group-affinity routing) and the engine prefills the shared
+        # prompt once, batch-attaching the siblings. group_size == 1
+        # (validation/REMAX streams) sends no hint.
         reqs = [{"rid": f"{stream_tag}{i}", "input_ids": list(p),
+                 **({"group_id": f"{stream_tag}g{i // group_size}",
+                     "group_size": group_size} if group_size > 1 else {}),
                  "sampling_params": {
                      "temperature": sampling.temperature,
                      "top_p": sampling.top_p,

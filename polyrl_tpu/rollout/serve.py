@@ -47,6 +47,9 @@ def create_server(model: str, manager_endpoint: str | None = None,
                   lora_rank: int = 0,
                   lora_alpha: float = 16.0,
                   salvage_partials: bool = True,
+                  admit_wave: int | None = None,
+                  admit_reorder_window: int = 8,
+                  group_share: bool = True,
                   fault_injector=None):
     """Build engine + server, register with the manager, attach receiver.
 
@@ -152,7 +155,9 @@ def create_server(model: str, manager_endpoint: str | None = None,
             else (128, 256, 512, 1024, 2048, 4096), seed=seed, mesh=mesh,
             prefill_chunk=prefill_chunk, spec_tokens=spec_tokens,
             spec_rounds=spec_rounds, pipeline_depth=pipeline_depth,
-            salvage_partials=salvage_partials)
+            salvage_partials=salvage_partials, admit_wave=admit_wave,
+            admit_reorder_window=admit_reorder_window,
+            group_share=group_share)
     else:
         kwargs = {}
         if batch_buckets:
@@ -263,6 +268,15 @@ def main() -> None:
     p.add_argument("--spec-rounds", type=int, default=2,
                    help="fused device-side speculation rounds per dispatch "
                         "(proposals and acceptance never leave the chip)")
+    p.add_argument("--admit-wave", type=int, default=None,
+                   help="max admissions fused into one batched prefill "
+                        "dispatch (default 8)")
+    p.add_argument("--admit-reorder-window", type=int, default=8,
+                   help="blocked queue heads admission may skip past while "
+                        "forming a wave (0 = strict FIFO head-of-line)")
+    p.add_argument("--no-group-share", action="store_true",
+                   help="disable group-shared prefill (siblings admit as "
+                        "singleton suffix dispatches — the A/B baseline)")
     p.add_argument("--lora-rank", type=int, default=0,
                    help="LoRA delta sync: serve base + adapters; pushes "
                         "carry only adapters (match the trainer's rank)")
@@ -291,6 +305,9 @@ def main() -> None:
                            prefill_chunk=args.prefill_chunk,
                            spec_tokens=args.spec_tokens,
                            spec_rounds=args.spec_rounds,
+                           admit_wave=args.admit_wave,
+                           admit_reorder_window=args.admit_reorder_window,
+                           group_share=not args.no_group_share,
                            lora_rank=args.lora_rank,
                            lora_alpha=args.lora_alpha)
     log.info("rollout server on %s", server.endpoint)

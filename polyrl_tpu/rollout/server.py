@@ -210,6 +210,13 @@ class RolloutServer:
                 rid = str(body.get("rid", f"req-{time.monotonic_ns()}"))
                 input_ids = [int(t) for t in body.get("input_ids", [])]
                 sp = SamplingParams.from_dict(body.get("sampling_params", {}))
+                # group-shared prefill hint (GRPO: rollout_n samples of one
+                # prompt dispatched together): the engine prefills the
+                # shared prompt ONCE and batch-attaches the siblings.
+                # Optional fields — absent/zero degrades to per-request
+                # admission, never corrupts.
+                group_id = str(body.get("group_id", "") or "")
+                group_size = int(body.get("group_size", 0) or 0)
                 # cross-process trace adoption: the manager injects the
                 # trainer's (trace_id, span_id) into the forwarded request,
                 # so this engine span joins the trainer's trace — the last
@@ -221,10 +228,14 @@ class RolloutServer:
                 tracer = obs.get_tracer()
                 with tracer.adopt(trace_ctx), \
                         tracer.span("engine/generate", rid=rid):
-                    self._stream_generate(rid, input_ids, sp)
+                    self._stream_generate(rid, input_ids, sp,
+                                          group_id, group_size)
 
-            def _stream_generate(self, rid, input_ids, sp) -> None:
-                out_q, abort_ev = outer.submit(rid, input_ids, sp)
+            def _stream_generate(self, rid, input_ids, sp,
+                                 group_id="", group_size=0) -> None:
+                out_q, abort_ev = outer.submit(rid, input_ids, sp,
+                                               group_id=group_id,
+                                               group_size=group_size)
 
                 self.send_response(200)
                 self.send_header("Content-Type", "application/x-ndjson")
@@ -298,12 +309,14 @@ class RolloutServer:
     # -- request admission & batching loop ----------------------------------
 
     def submit(self, rid: str, input_ids: list[int],
-               sp: SamplingParams) -> tuple[queue.Queue, threading.Event]:
+               sp: SamplingParams, group_id: str = "",
+               group_size: int = 0) -> tuple[queue.Queue, threading.Event]:
         """Admit one request; returns (output queue, abort event). The
         caller that registered the abort event must pass it back to
         ``_drop_abort`` — cleanup is identity-checked so a retry that
         re-used the rid cannot have its fresh event popped by the dying
-        first attempt's teardown."""
+        first attempt's teardown. ``group_id``/``group_size`` are the
+        group-shared-prefill hint forwarded to the CB engine."""
         out: queue.Queue = queue.Queue()
         abort = threading.Event()
         if self._draining.is_set():
@@ -341,7 +354,8 @@ class RolloutServer:
             # ourselves so the engine aborts the request into a partial
             abort.set()
         if self.cb:
-            self.engine.submit(rid, input_ids, sp, out=out, abort=abort)
+            self.engine.submit(rid, input_ids, sp, out=out, abort=abort,
+                               group_id=group_id, group_size=group_size)
         else:
             self._queue.put(_PendingRequest(rid, input_ids, sp, out, abort))
         return out, abort
@@ -538,6 +552,23 @@ class RolloutServer:
         pc = getattr(self.engine, "prefix_cache", None)
         if pc is not None:
             info.update(pc.stats())
+            # flat request-level hit fraction (length-unbiased, unlike
+            # hit_rate which counts pages): flat key so the manager's
+            # stats poller can forward it per instance
+            info["prefix_hit_frac"] = round(pc.request_hit_frac, 6)
+        if hasattr(self.engine, "admit_wave"):
+            # admission scheduler geometry + group-shared prefill counters
+            # (ARCHITECTURE.md "Group-shared prefill"): the knobs are
+            # echoed so bench/statusz record what the scheduler actually
+            # ran with; the dispatch counters are what the --group-share
+            # A/B reads (dispatch count bounds admission throughput)
+            info["admit_wave"] = self.engine.admit_wave
+            info["admit_reorder_window"] = self.engine.admit_reorder_window
+            info["group_share"] = bool(self.engine.group_share)
+            info["prefill_dispatches"] = self.engine.prefill_dispatches
+            info["sibling_attach_dispatches"] = (
+                self.engine.sibling_attach_dispatches)
+            info["group_forked_requests"] = self.engine.group_forked_requests
         # partial-rollout salvage telemetry (cb engine); drained requests
         # are a server-level count (the /drain preemption path)
         if getattr(self.engine, "salvage_partials", False):
@@ -572,7 +603,9 @@ class RolloutServer:
         counters = {k: float(v) for k, v in info.items()
                     if k in ("tokens_salvaged", "salvage_published_pages",
                              "drained_requests", "spec_emitted",
-                             "spec_dispatches")}
+                             "spec_dispatches", "prefill_dispatches",
+                             "sibling_attach_dispatches",
+                             "group_forked_requests")}
         counters["total_tokens_served"] = float(
             getattr(self.engine, "total_tokens_served", 0))
         if self.fault is not None:
@@ -593,6 +626,24 @@ class RolloutServer:
                     "accept_rate": float(info.get("spec_accept_rate", 0.0)),
                     "emitted": int(self.engine.spec_emitted),
                     "dispatches": int(self.engine.spec_dispatches),
+                }
+            if hasattr(self.engine, "admit_wave"):
+                # group-shared prefill: scheduler geometry + fork counters
+                # (the "did sharing actually happen" answer for one curl)
+                engine_section["group"] = {
+                    "admit_wave": int(self.engine.admit_wave),
+                    "admit_reorder_window": int(
+                        self.engine.admit_reorder_window),
+                    "group_share": bool(self.engine.group_share),
+                    "prefill_dispatches": int(self.engine.prefill_dispatches),
+                    "sibling_attach_dispatches": int(
+                        self.engine.sibling_attach_dispatches),
+                    "group_forked_requests": int(
+                        self.engine.group_forked_requests),
+                    "prefill_reuse_frac": float(
+                        info.get("prefill_reuse_frac", 0.0)),
+                    "prefix_hit_frac": float(
+                        info.get("prefix_hit_frac", 0.0)),
                 }
         return statusz.build_snapshot(
             "rollout",

@@ -115,7 +115,10 @@ def _build_rollout(cfg: RunConfig, mcfg, params, tokenizer, cleanup: list):
                 max_slots=cfg.rollout.max_slots, page_size=cfg.rollout.page_size,
                 max_seq_len=cfg.rollout.max_seq_len,
                 prefill_chunk=cfg.rollout.prefill_chunk,
-                salvage_partials=cfg.rollout.salvage_partials, **kwargs)
+                salvage_partials=cfg.rollout.salvage_partials,
+                admit_wave=cfg.rollout.admit_wave,
+                admit_reorder_window=cfg.rollout.admit_reorder_window,
+                group_share=cfg.rollout.group_share, **kwargs)
         from polyrl_tpu.rollout.engine import RolloutEngine
 
         kwargs = {}
@@ -196,6 +199,9 @@ def _build_rollout(cfg: RunConfig, mcfg, params, tokenizer, cleanup: list):
             spec_tokens=cfg.rollout.spec_tokens,
             spec_rounds=cfg.rollout.spec_rounds,
             salvage_partials=cfg.rollout.salvage_partials,
+            admit_wave=cfg.rollout.admit_wave,
+            admit_reorder_window=cfg.rollout.admit_reorder_window,
+            group_share=cfg.rollout.group_share,
             **({"prompt_buckets": tuple(cfg.rollout.prompt_buckets)}
                if cfg.rollout.prompt_buckets else {}))
         local_server = RolloutServer(eng, host="127.0.0.1", port=0)

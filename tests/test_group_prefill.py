@@ -1,0 +1,356 @@
+"""Group-shared prefill (ARCHITECTURE.md "Group-shared prefill"): one
+prompt prefill per GRPO group + one batched sibling attach, the admission
+reorder window, group pre-refs, and the wire-protocol group hint."""
+
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from polyrl_tpu.models import decoder
+from polyrl_tpu.rollout.cb_engine import CBEngine, STREAM_END
+from polyrl_tpu.rollout.sampling import SamplingParams
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = decoder.get_config("tiny")
+    params = decoder.init_params(jax.random.PRNGKey(0), cfg)
+    return cfg, params
+
+
+def _mk_engine(tiny, **kw):
+    cfg, params = tiny
+    defaults = dict(max_slots=16, page_size=8, max_seq_len=128,
+                    prompt_buckets=(16, 32), num_pages=256)
+    defaults.update(kw)
+    return CBEngine(cfg, params, **defaults)
+
+
+def _prompt(rng, cfg, n=12):
+    # > page_size so the prompt spans at least one FULL page (sharable)
+    return rng.integers(1, cfg.vocab_size, n).tolist()
+
+
+def _collect(q, timeout=120):
+    toks, lps, reason = [], [], ""
+    while True:
+        item = q.get(timeout=timeout)
+        if item is STREAM_END:
+            break
+        toks.extend(item["token_ids"])
+        lps.extend(item["logprobs"])
+        if item["finished"]:
+            reason = item["finish_reason"]
+    return toks, lps, reason
+
+
+def test_group_dispatch_counts_g8(tiny):
+    """Acceptance: a G=8 group costs exactly ONE prompt prefill dispatch +
+    at most one batched sibling-attach dispatch."""
+    cfg, _ = tiny
+    eng = _mk_engine(tiny)
+    rng = np.random.default_rng(0)
+    prompt = _prompt(rng, cfg)
+    sp = SamplingParams(temperature=0.0, max_new_tokens=8, stop_token_ids=())
+    outs = [eng.submit(f"g0-{i}", prompt, sp, group_id="g0", group_size=8)
+            for i in range(8)]
+    eng.start()
+    results = [_collect(q) for q in outs]
+    assert eng.prefill_dispatches == 2          # 1 prompt + 1 attach
+    assert eng.sibling_attach_dispatches == 1
+    assert eng.group_forked_requests == 7
+    # all siblings decoded the full budget; greedy ⇒ identical streams
+    assert all(len(t) == 8 for t, _, _ in results)
+    assert all(t == results[0][0] for t, _, _ in results)
+    # every pre-ref consumed; token accounting reconciles at quiescence
+    assert eng._group_prerefs == {}
+    assert eng.deck.attributed_frac() == 1.0
+    assert eng.deck.prefill_reuse_frac() > 0.5  # 7/8 prompts were forks
+    eng.stop()
+    assert all(s is None for s in eng._slots)
+    assert eng.allocator.free_count == eng.num_pages - 1
+
+
+def test_group_fork_bitwise_parity_vs_independent(tiny):
+    """Greedy tokens from a group-shared fork are BITWISE identical to G
+    independent submissions (prefix cache off ⇒ every request full-
+    prefills); logprobs match within the established prefill-vs-suffix
+    numerical bound (atol 5e-4, test_prefix_cache's bound)."""
+    cfg, _ = tiny
+    rng = np.random.default_rng(1)
+    prompt = _prompt(rng, cfg, 13)  # page-unaligned suffix
+    sp = SamplingParams(temperature=0.0, max_new_tokens=10,
+                        stop_token_ids=())
+    ref_eng = _mk_engine(tiny, enable_prefix_cache=False)
+    ref = ref_eng.generate([prompt] * 4, sp)
+    ref_eng.stop()
+
+    eng = _mk_engine(tiny)
+    outs = [eng.submit(f"gA-{i}", prompt, sp, group_id="gA", group_size=4)
+            for i in range(4)]
+    eng.start()
+    shared = [_collect(q) for q in outs]
+    assert eng.sibling_attach_dispatches == 1
+    eng.stop()
+
+    for r, (toks, lps, _reason) in zip(ref, shared):
+        assert list(r["token_ids"]) == toks  # bitwise greedy parity
+        np.testing.assert_allclose(r["logprobs"], lps, rtol=0, atol=5e-4)
+
+
+def test_admission_reorder_window_unblocks_mixed_traffic(tiny):
+    """Satellite: the old ``first_key in wave_page_keys → break`` stalled
+    UNRELATED pending requests behind a waiting sibling. With the reorder
+    window the unrelated requests join the leader's wave; with window=0
+    (strict FIFO) admission serializes behind the sibling again."""
+    cfg, _ = tiny
+    rng = np.random.default_rng(2)
+    shared = _prompt(rng, cfg)
+    others = [_prompt(rng, cfg) for _ in range(2)]
+    sp = SamplingParams(temperature=0.0, max_new_tokens=4, stop_token_ids=())
+
+    def admit_all(window):
+        eng = _mk_engine(tiny, admit_reorder_window=window)
+        for i in range(2):
+            eng.submit(f"a{i}", shared, sp, group_id="gA", group_size=2)
+        for j, p in enumerate(others):
+            eng.submit(f"b{j}", p, sp)
+        eng._drain_queue()
+        with eng._pool_lock:
+            eng._admit()
+        waves = eng.deck.hists["admit_batch"]
+        sizes = (waves.count, eng.prefill_dispatches)
+        eng.stop()
+        return sizes
+
+    n_waves, n_disp = admit_all(window=8)
+    # leader + both unrelated prompts fuse into wave 1; the waiting
+    # sibling attaches in wave 2 → 2 dispatches total
+    assert (n_waves, n_disp) == (2, 2)
+    n_waves0, n_disp0 = admit_all(window=0)
+    # strict FIFO: the sibling head-of-line breaks the first wave
+    assert n_disp0 == 3
+
+
+def test_drain_mid_group_salvages_forked_siblings(tiny):
+    """Satellite chaos case: /drain mid-group — every member (leader AND
+    attach-forked siblings) aborts into a PARTIAL (finish_reason=abort,
+    never error ⇒ 0 dropped groups at the trainer), in-flight decoded
+    tokens are flushed, and slot/page accounting reconciles."""
+    from polyrl_tpu.rollout.server import RolloutServer
+
+    cfg, _ = tiny
+    eng = _mk_engine(tiny, max_seq_len=512, num_pages=512)
+    eng.pipeline_depth = 16
+    srv = RolloutServer(eng, host="127.0.0.1", port=0)
+    rng = np.random.default_rng(3)
+    prompt = _prompt(rng, cfg)
+    sp = SamplingParams(temperature=0.0, max_new_tokens=400,
+                        stop_token_ids=())
+    subs = [srv.submit(f"d{i}", prompt, sp, group_id="gD", group_size=4)
+            for i in range(4)]
+    srv.start()
+    # wait until every member is decoding (first token out)
+    firsts = [q.get(timeout=120) for q, _ev in subs]
+    assert all(f["token_ids"] for f in firsts)
+    assert eng.sibling_attach_dispatches == 1
+    res = srv.drain()
+    assert res["draining"]
+    reasons = []
+    for q, _ev in subs:
+        toks, _lps, reason = _collect(q)
+        reasons.append(reason)
+    assert reasons == ["abort"] * 4      # partials, zero dropped groups
+    # a new group member after drain is refused with an immediate abort
+    q2, _ = srv.submit("late", prompt, sp, group_id="gD", group_size=4)
+    _toks, _lps, reason = _collect(q2)
+    assert reason == "abort"
+    assert eng.deck.attributed_frac() == 1.0
+    srv.stop()
+    assert eng._group_prerefs == {}
+    assert eng.allocator.free_count == eng.num_pages - 1
+
+
+def test_group_prerefs_ttl_and_flush(tiny):
+    """Pre-refs of groups whose siblings never arrive are TTL-swept, and a
+    cache flush (weight swap) disbands them — no page is pinned forever."""
+    cfg, _ = tiny
+    eng = _mk_engine(tiny)
+    rng = np.random.default_rng(4)
+    prompt = _prompt(rng, cfg)
+    sp = SamplingParams(temperature=0.0, max_new_tokens=2, stop_token_ids=())
+    out = eng.submit("lead", prompt, sp, group_id="gT", group_size=8)
+    eng.start()
+    _collect(out)
+    assert "gT" in eng._group_prerefs
+    assert eng._group_prerefs["gT"]["remaining"] == 7
+    # one sibling arrives → one pre-ref unit consumed
+    out2 = eng.submit("sib", prompt, sp, group_id="gT", group_size=8)
+    _collect(out2)
+    assert eng._group_prerefs["gT"]["remaining"] == 6
+    # TTL sweep: pretend the group went stale
+    eng._group_prerefs["gT"]["t"] -= eng.GROUP_PREREF_TTL_S + 1
+    with eng._pool_lock:
+        eng._sweep_group_prerefs()
+    assert eng._group_prerefs == {}
+    # pre-refs dropped ⇒ the cached pages are evictable again
+    assert all(e.refcount == 0 for e in eng.prefix_cache._map.values())
+
+    # flush path: re-register via a fresh leader, then weight-swap
+    out3 = eng.submit("lead2", prompt, sp, group_id="gU", group_size=4)
+    _collect(out3)
+    assert "gU" in eng._group_prerefs
+    eng.update_weights(eng.params)
+    assert eng._group_prerefs == {}
+    eng.stop()
+    assert eng.allocator.free_count == eng.num_pages - 1
+
+
+def test_weight_swap_mid_group_reprefills_fresh(tiny):
+    """A weight swap between the leader's publish and the siblings'
+    arrival flushes the cache: siblings must NOT attach to stale KV — they
+    re-prefill fresh under the new version and still complete."""
+    cfg, _ = tiny
+    eng = _mk_engine(tiny)
+    rng = np.random.default_rng(5)
+    prompt = _prompt(rng, cfg)
+    sp = SamplingParams(temperature=0.0, max_new_tokens=4, stop_token_ids=())
+    out = eng.submit("w0", prompt, sp, group_id="gW", group_size=3)
+    eng.start()
+    _collect(out)
+    eng.update_weights(eng.params)  # flush + disband
+    before = eng.sibling_attach_dispatches
+    outs = [eng.submit(f"w{i}", prompt, sp, group_id="gW", group_size=3)
+            for i in (1, 2)]
+    res = [_collect(q) for q in outs]
+    assert all(len(t) == 4 for t, _, _ in res)
+    # the two post-swap siblings share a fresh leader/attach among
+    # themselves, but never attached to the pre-swap KV: at most one new
+    # attach dispatch of the later sibling onto the re-published prompt
+    assert eng.sibling_attach_dispatches - before <= 1
+    eng.stop()
+    assert eng.allocator.free_count == eng.num_pages - 1
+
+
+def test_attributed_frac_under_group_abort_churn(tiny):
+    """Flight-deck reconciliation stays pinned under group fork + abort
+    churn (acceptance: attributed_frac at quiescence == 1.0)."""
+    cfg, _ = tiny
+    eng = _mk_engine(tiny, max_seq_len=512, num_pages=512)
+    rng = np.random.default_rng(6)
+    prompt = _prompt(rng, cfg)
+    sp = SamplingParams(temperature=0.0, max_new_tokens=200,
+                        stop_token_ids=())
+    evs = [threading.Event() for _ in range(4)]
+    outs = [eng.submit(f"c{i}", prompt, sp, abort=evs[i],
+                       group_id="gC", group_size=4)
+            for i in range(4)]
+    eng.start()
+    for q in outs[:2]:  # wait for decode to be underway
+        assert q.get(timeout=120)["token_ids"]
+    evs[0].set()
+    evs[2].set()
+    for q in outs:
+        _collect(q)
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline and eng._active.any():
+        time.sleep(0.05)
+    assert eng.deck.attributed_frac() == 1.0
+    eng.stop()
+    assert eng.allocator.free_count == eng.num_pages - 1
+
+
+def test_server_info_and_statusz_echo_group_geometry(tiny):
+    """Satellite: admit_wave/admit_reorder_window echoed in server_info,
+    request-level prefix hit counters surfaced, statusz engine section
+    carries the group block."""
+    from polyrl_tpu.rollout.server import RolloutServer
+
+    cfg, _ = tiny
+    eng = _mk_engine(tiny, admit_wave=6, admit_reorder_window=3)
+    srv = RolloutServer(eng, host="127.0.0.1", port=0)
+    rng = np.random.default_rng(7)
+    prompt = _prompt(rng, cfg)
+    sp = SamplingParams(temperature=0.0, max_new_tokens=2, stop_token_ids=())
+    subs = [srv.submit(f"s{i}", prompt, sp, group_id="gS", group_size=2)
+            for i in range(2)]
+    srv.start()
+    for q, _ev in subs:
+        _collect(q)
+    info = srv.server_info()
+    assert info["admit_wave"] == 6
+    assert info["admit_reorder_window"] == 3
+    assert info["group_share"] is True
+    assert info["prefill_dispatches"] >= 2
+    assert info["prefix_hit_frac"] == pytest.approx(0.5)  # 1 of 2 requests
+    assert info["prefix_cache/req_hits"] == 1.0
+    assert info["prefill_reuse_frac"] > 0.0
+    snap = srv.statusz_snapshot()
+    grp = snap["engine"]["group"]
+    assert grp["admit_wave"] == 6
+    assert grp["admit_reorder_window"] == 3
+    assert grp["group_share"] is True
+    assert grp["prefix_hit_frac"] == pytest.approx(0.5)
+    assert snap["counters"]["prefill_dispatches"] >= 2.0
+    srv.stop()
+
+
+def test_group_share_off_restores_singleton_admission(tiny):
+    """The A/B baseline: group_share=False admits siblings as serialized
+    singleton suffix dispatches (dispatch count linear in G) but stays
+    correct."""
+    cfg, _ = tiny
+    eng = _mk_engine(tiny, group_share=False)
+    rng = np.random.default_rng(8)
+    prompt = _prompt(rng, cfg)
+    sp = SamplingParams(temperature=0.0, max_new_tokens=4, stop_token_ids=())
+    outs = [eng.submit(f"n{i}", prompt, sp, group_id="gN", group_size=4)
+            for i in range(4)]
+    eng.start()
+    res = [_collect(q) for q in outs]
+    assert eng.sibling_attach_dispatches == 0
+    assert eng.prefill_dispatches == 4
+    assert all(t == res[0][0] for t, _, _ in res)
+    eng.stop()
+    assert eng.allocator.free_count == eng.num_pages - 1
+
+
+def test_remote_requests_carry_group_hint():
+    """Wire protocol: RemoteRollout stamps a stream-unique group_id +
+    group_size on every member when group_size > 1, and no hint on
+    singleton streams (validation/REMAX)."""
+    from polyrl_tpu.manager.client import GenerateResult
+    from polyrl_tpu.rollout.remote import RemoteRollout
+
+    captured = []
+
+    class _Capture:
+        def batch_generate_stream(self, requests, max_local_gen_s=None):
+            captured.extend(requests)
+            for r in requests:
+                yield GenerateResult(
+                    rid=r["rid"], success=True, output_token_ids=[1, 2],
+                    output_token_logprobs=[-0.1, -0.2],
+                    finish_reason="stop", error="")
+
+    rr = RemoteRollout(_Capture())
+    list(rr.generate_stream([[1, 2]] * 4, SamplingParams(max_new_tokens=2),
+                            group_size=2, min_emit=4))
+    assert len(captured) == 4
+    gids = [r["group_id"] for r in captured]
+    assert all(r["group_size"] == 2 for r in captured)
+    assert gids[0] == gids[1] and gids[2] == gids[3]
+    assert gids[0] != gids[2]
+    # stream-unique: a second stream must not reuse the first's group ids
+    captured.clear()
+    list(rr.generate_stream([[1, 2]] * 2, SamplingParams(max_new_tokens=2),
+                            group_size=2, min_emit=2))
+    assert captured[0]["group_id"] != gids[0]
+    # singleton streams carry no hint
+    captured.clear()
+    list(rr.generate_stream([[1, 2]], SamplingParams(max_new_tokens=2),
+                            group_size=1, min_emit=1))
+    assert "group_id" not in captured[0]

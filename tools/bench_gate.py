@@ -69,6 +69,13 @@ WATCHED_EXTRA = (
     ("engine_cache_hit_rate", True),
     ("engine_ttft_p95_ms", False),
     ("engine_tpot_p95_ms", False),
+    # group-shared prefill (bench.py --group-share A/B, and the cb phase's
+    # serving default): the reuse fraction must hold, the per-group
+    # admission dispatch count must stay collapsed (1 prefill + ≤1 attach
+    # ⇒ reduction ~G/2), and sharing must keep paying off wall-clock
+    ("engine_prefill_reuse_frac", True),
+    ("group_share.engine_prefill_reuse_frac", True),
+    ("group_share.dispatch_reduction", True),
 )
 
 
