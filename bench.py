@@ -883,7 +883,21 @@ def pipeline_microbench(steps: int = 4, gen_delay_s: float = 0.4,
     pipe_step = sum(h["perf/step_time_s"] for h in hist_pipe[tail]) / max(
         len(hist_pipe[tail]), 1)
     overlap = sum(h.get("perf/pipeline_overlap_s", 0.0) for h in hist_pipe)
+
+    def _tail_mean(hist, key):
+        vals = [h[key] for h in hist if key in h]
+        return round(sum(vals) / len(vals), 5) if vals else None
+
+    # training health plane extras (obs/rlhealth.py gauges from the fit's
+    # step records): watched by bench_gate across rounds — an entropy
+    # collapse or a degenerate-group surge between rounds is a regression
+    # even when tok/s held
+    training = {
+        f"training_{k}": _tail_mean(hist_pipe[tail], f"training/{k}")
+        for k in ("entropy", "approx_kl", "tis_clip_frac",
+                  "degenerate_group_frac")}
     return {
+        **{k: v for k, v in training.items() if v is not None},
         "steps": steps, "gen_delay_s": gen_delay_s,
         "push_delay_s": push_delay_s,
         "sync_wall_s": round(wall_sync, 2),

@@ -195,6 +195,14 @@ class ObsSection:
     recorder_z: float = 4.0               # z-score anomaly threshold
     recorder_warmup: int = 5              # steps before detection arms
     recorder_max_bundles: int = 4         # bundle budget per run
+    # training health plane (obs/rlhealth.py): per-step RL-dynamics
+    # ledger — training/* distributions + group diagnostics in every step
+    # record, the /statusz training section, and training.json in
+    # post-mortem bundles. Default ON (host-side numpy over arrays the
+    # step already computed; no device work).
+    rlhealth: bool = True
+    rlhealth_tail: int = 64               # per-step rows kept for bundles
+    rlhealth_group_rows: int = 64         # group-table rows per step
 
 
 @dataclass

@@ -17,7 +17,12 @@ The pieces (ARCHITECTURE.md "Observability"):
 - :mod:`polyrl_tpu.obs.statusz` — the live ``/statusz`` health plane: one
   JSON schema served by both the trainer and the rollout server.
 - :mod:`polyrl_tpu.obs.recorder` — anomaly flight recorder: EWMA/z-score
-  detection over the step stream + post-mortem bundle dumps.
+  detection (per-key direction-aware) over the step stream + post-mortem
+  bundle dumps.
+- :mod:`polyrl_tpu.obs.rlhealth` — training health plane: per-step
+  RL-dynamics ledger (advantage/TIS/staleness distributions, GRPO group
+  diagnostics) behind the ``training/*`` namespace, the /statusz
+  ``training`` section, and ``training.json`` post-mortem bundles.
 
 Everything here is import-light (no jax at module load) and no-op-cheap
 when tracing is disabled, so hot paths can call into it unconditionally.
@@ -31,7 +36,8 @@ from polyrl_tpu.obs.goodput import GoodputLedger  # noqa: F401
 from polyrl_tpu.obs.histogram import (Histogram, drain_histograms,  # noqa: F401
                                       observe)
 from polyrl_tpu.obs.recorder import (AnomalyDetector,  # noqa: F401
-                                     FlightRecorder)
+                                     FlightRecorder, direction_violates)
+from polyrl_tpu.obs.rlhealth import TrainingHealthLedger  # noqa: F401
 from polyrl_tpu.obs.scrape import (manager_gauges,  # noqa: F401
                                    parse_prometheus_text)
 from polyrl_tpu.obs.statusz import StatuszServer, build_snapshot  # noqa: F401

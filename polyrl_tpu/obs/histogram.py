@@ -49,6 +49,30 @@ class Histogram:
         idx = min(max(math.floor(math.log2(v) * SUBDIV), _IDX_MIN), _IDX_MAX)
         self.buckets[idx] = self.buckets.get(idx, 0) + 1
 
+    def observe_many(self, values) -> None:
+        """Bulk observe: one numpy pass instead of a python loop — the
+        training health ledger (obs/rlhealth.py) feeds thousands of
+        per-token samples per step. Bucket math identical to
+        :meth:`observe` (pinned by test). numpy imported lazily so the
+        module stays import-light for the no-numpy consumers."""
+        import numpy as np
+
+        vals = np.asarray(values, np.float64).ravel()
+        if vals.size == 0:
+            return
+        self.count += int(vals.size)
+        self.total += float(vals.sum())
+        self.vmin = min(self.vmin, float(vals.min()))
+        self.vmax = max(self.vmax, float(vals.max()))
+        pos = vals[vals > 0.0]
+        self.zeros += int(vals.size - pos.size)
+        if pos.size:
+            idx = np.clip(np.floor(np.log2(pos) * SUBDIV),
+                          _IDX_MIN, _IDX_MAX).astype(np.int64)
+            uniq, counts = np.unique(idx, return_counts=True)
+            for i, n in zip(uniq.tolist(), counts.tolist()):
+                self.buckets[i] = self.buckets.get(i, 0) + n
+
     def merge(self, other: "Histogram") -> None:
         for idx, n in other.buckets.items():
             self.buckets[idx] = self.buckets.get(idx, 0) + n

@@ -13,6 +13,9 @@ bundle into the run directory:
     ``stacks.txt``     — ``faulthandler`` dump of every thread's stack
     ``counters.json``  — reason, anomaly details, fault/salvage counters,
                          detector state
+    ``engine.json``    — fleet flight-deck view (``engine_fn``; when wired)
+    ``training.json``  — training health ledger tail + last batch's GRPO
+                         group table (``training_fn``; when wired)
 
 Detector design: EWMA mean + EW variance with a **median-initialized
 warmup** (the first step carries jit compiles — seeding the mean from the
@@ -39,17 +42,48 @@ import time
 log = logging.getLogger(__name__)
 
 
+DIRECTIONS = ("low", "high", "both")
+
+
+def direction_violates(direction: str, excursion: float) -> bool:
+    """Shared per-key direction semantics — the FlightRecorder watch and
+    ``tools/bench_gate.py`` both decide "is this move in the BAD
+    direction" here instead of duplicating it. ``excursion`` is any
+    signed deviation from the baseline (a z-score, a ratio minus 1):
+    ``'high'`` fires on positive excursions (KL blowing up, a latency
+    rising), ``'low'`` on negative ones (entropy collapsing, throughput
+    dropping), ``'both'`` on either."""
+    if direction == "high":
+        return excursion > 0.0
+    if direction == "low":
+        return excursion < 0.0
+    if direction == "both":
+        return excursion != 0.0
+    raise ValueError(f"direction must be one of {DIRECTIONS}, "
+                     f"got {direction!r}")
+
+
 class AnomalyDetector:
-    """EWMA/z-score detector for one metric stream."""
+    """EWMA/z-score detector for one metric stream. ``direction`` gates
+    which excursions COUNT as anomalous: a symmetric detector over
+    ``training/entropy`` would fire on a healthy entropy rise exactly as
+    on a collapse — only the watched direction fires. Extreme samples in
+    the healthy direction still don't fold into the statistics (they are
+    outliers either way; the baseline must survive them)."""
 
     def __init__(self, z_threshold: float = 4.0, warmup: int = 5,
-                 alpha: float = 0.3, min_sigma_frac: float = 0.1):
+                 alpha: float = 0.3, min_sigma_frac: float = 0.1,
+                 direction: str = "both"):
         if warmup < 2:
             raise ValueError(f"warmup must be >= 2, got {warmup}")
+        if direction not in DIRECTIONS:
+            raise ValueError(f"direction must be one of {DIRECTIONS}, "
+                             f"got {direction!r}")
         self.z_threshold = z_threshold
         self.warmup = warmup
         self.alpha = alpha
         self.min_sigma_frac = min_sigma_frac
+        self.direction = direction
         self.mean: float | None = None
         self.var = 0.0
         self.n = 0
@@ -86,7 +120,9 @@ class AnomalyDetector:
             return None
         z = (v - self.mean) / self._sigma()
         if abs(z) > self.z_threshold:
-            return z
+            # extreme either way: never folded into the baseline; only
+            # the watched direction is REPORTED as anomalous
+            return z if direction_violates(self.direction, z) else None
         a = self.alpha
         delta = v - self.mean
         self.mean += a * delta
@@ -96,17 +132,45 @@ class AnomalyDetector:
     def state(self) -> dict:
         return {"n": self.n, "mean": self.mean, "sigma": self._sigma()
                 if self.mean is not None else None,
+                "direction": self.direction,
                 "warmed": self.mean is not None}
 
 
-# step-record keys the recorder watches by default: wall step time (a
-# stall spikes it), the rollout plane's decode throughput (a sick pool
-# collapses it), and the fleet flight-deck gauges (PoolManager.counters) —
-# a decode-occupancy collapse or page-pool exhaustion on any engine is an
-# anomaly even while aggregate throughput still looks alive. Keys absent
-# from the step record (no pool attached) are simply never fed.
-DEFAULT_WATCH = ("perf/step_time_s", "perf/rollout_throughput_tok_s",
-                 "engine/occupancy", "engine/page_util")
+# step-record keys the recorder watches by default, each with the
+# direction that IS the anomaly: wall step time (a stall spikes it), the
+# rollout plane's decode throughput (a sick pool collapses it), and the
+# fleet flight-deck gauges (PoolManager.counters) keep their original
+# symmetric watch; the training health plane (obs/rlhealth.py) is
+# direction-aware — entropy collapsing DOWN and KL / grad norm /
+# degenerate-group fraction blowing UP are the anomalies, their healthy
+# moves are not. Keys absent from the step record (no pool attached, no
+# health ledger) are simply never fed.
+DEFAULT_WATCH = {
+    "perf/step_time_s": "both",
+    "perf/rollout_throughput_tok_s": "both",
+    "engine/occupancy": "both",
+    "engine/page_util": "both",
+    "training/entropy": "low",
+    "training/approx_kl": "high",
+    "training/grad_norm": "high",
+    "training/degenerate_group_frac": "high",
+}
+
+
+def _normalize_watch(watch) -> dict[str, str]:
+    """Watch spec → ``{key: direction}``: a mapping passes through; an
+    iterable accepts bare keys (symmetric watch, the pre-direction
+    behavior) or ``(key, direction)`` pairs."""
+    if isinstance(watch, dict):
+        return dict(watch)
+    out: dict[str, str] = {}
+    for item in watch:
+        if isinstance(item, str):
+            out[item] = "both"
+        else:
+            key, direction = item
+            out[key] = direction
+    return out
 
 
 class FlightRecorder:
@@ -116,14 +180,15 @@ class FlightRecorder:
                  z_threshold: float = 4.0, warmup: int = 5,
                  alpha: float = 0.3, min_sigma_frac: float = 0.1,
                  max_bundles: int = 4,
-                 watch: tuple[str, ...] = DEFAULT_WATCH):
+                 watch=DEFAULT_WATCH):
         self.out_dir = out_dir
         self.max_bundles = max_bundles
         self._steps: collections.deque = collections.deque(maxlen=keep_steps)
         self._detectors = {
             key: AnomalyDetector(z_threshold=z_threshold, warmup=warmup,
-                                 alpha=alpha, min_sigma_frac=min_sigma_frac)
-            for key in watch}
+                                 alpha=alpha, min_sigma_frac=min_sigma_frac,
+                                 direction=direction)
+            for key, direction in _normalize_watch(watch).items()}
         self._lock = threading.Lock()
         self._seq = 0
         self.anomalies = 0        # anomalous STEPS (one per step, not per key)
@@ -136,6 +201,11 @@ class FlightRecorder:
         # (PoolManager.engine_section) — written as engine.json so the
         # bundle shows per-engine occupancy/page pressure at anomaly time
         self.engine_fn = None
+        # optional zero-arg callable returning the training health view
+        # (TrainingHealthLedger.bundle_view) — written as training.json so
+        # an entropy-collapse bundle carries the RL-dynamics tail and the
+        # last batch's GRPO group table
+        self.training_fn = None
 
     # -- step stream ---------------------------------------------------------
 
@@ -207,6 +277,15 @@ class FlightRecorder:
                 if engine_view:
                     with open(os.path.join(path, "engine.json"), "w") as f:
                         json.dump(engine_view, f, indent=2)
+            if self.training_fn is not None:
+                try:
+                    training_view = dict(self.training_fn())
+                except Exception:  # noqa: BLE001 — best-effort like counters
+                    log.exception("flight recorder training_fn failed")
+                    training_view = {}
+                if training_view:
+                    with open(os.path.join(path, "training.json"), "w") as f:
+                        json.dump(training_view, f, indent=2)
             with open(os.path.join(path, "counters.json"), "w") as f:
                 json.dump({
                     "reason": reason,

@@ -8,8 +8,8 @@ trainer mounts a standalone :class:`StatuszServer` (it has no HTTP surface
 of its own); the rollout server mounts ``/statusz`` as a route on its
 existing listener (rollout/server.py).
 
-Schema (``polyrl/statusz/v2`` — additive evolution only; v2 added the
-``engine`` section):
+Schema (``polyrl/statusz/v3`` — additive evolution only; v2 added the
+``engine`` section, v3 the ``training`` section):
 
 - ``role``      — ``trainer`` | ``rollout``
 - ``pid`` / ``time_unix_s`` / ``uptime_s``
@@ -28,8 +28,13 @@ Schema (``polyrl/statusz/v2`` — additive evolution only; v2 added the
   utilization, token-accounting reconciliation. Rollout role serves its
   own ledger; trainer role serves the fleet aggregate from PoolManager
   sweeps; empty elsewhere.
+- ``training``  — the training health plane (obs/rlhealth.py): last
+  finalized ``training/*`` gauges (entropy/KL mirrors, degenerate-group
+  fraction, per-token weight-version staleness) plus a short per-step
+  trend tail. Trainer role with a TrainingHealthLedger attached (the
+  default); empty on the rollout plane.
 
-Every v2 section is ALWAYS present on both planes (conformance-tested) so
+Every v3 section is ALWAYS present on both planes (conformance-tested) so
 consumers never need existence checks.
 
 ``GET /metrics`` on the same listener renders the snapshot's numeric
@@ -49,7 +54,7 @@ from typing import Callable
 
 log = logging.getLogger(__name__)
 
-SCHEMA = "polyrl/statusz/v2"
+SCHEMA = "polyrl/statusz/v3"
 _PROC_T0 = time.monotonic()
 _HIST_SUFFIXES = ("p50", "p95", "p99", "max", "mean", "count")
 
@@ -57,7 +62,7 @@ _HIST_SUFFIXES = ("p50", "p95", "p99", "max", "mean", "count")
 # conformance contract consumers (and the conformance test) rely on
 REQUIRED_SECTIONS = ("schema", "role", "pid", "time_unix_s", "uptime_s",
                      "step", "goodput", "histograms", "counters", "gauges",
-                     "queues", "weights", "pool", "engine")
+                     "queues", "weights", "pool", "engine", "training")
 
 
 def build_snapshot(role: str, *, step: int | None = None,
@@ -68,7 +73,8 @@ def build_snapshot(role: str, *, step: int | None = None,
                    queues: dict | None = None,
                    weights: dict | None = None,
                    pool: dict | None = None,
-                   engine: dict | None = None) -> dict:
+                   engine: dict | None = None,
+                   training: dict | None = None) -> dict:
     """The shared statusz schema; every section present (empty when the
     plane has nothing for it) so consumers never need existence checks."""
     return {
@@ -86,6 +92,7 @@ def build_snapshot(role: str, *, step: int | None = None,
         "weights": weights or {},
         "pool": pool or {},
         "engine": engine or {},
+        "training": training or {},
     }
 
 

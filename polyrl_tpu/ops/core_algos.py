@@ -242,7 +242,7 @@ def truncated_importance_weights(
     rollout_log_probs: jnp.ndarray,
     response_mask: jnp.ndarray,
     cap: float = 2.0,
-) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Per-token truncated importance-sampling (TIS) weights for off-policy
     rollouts (the pipelined trainer's one-version-stale generations; OPPO
     arxiv 2509.25762 / LlamaRL arxiv 2505.24034 both use this form):
@@ -250,14 +250,17 @@ def truncated_importance_weights(
     CURRENT policy's logprob of the rollout token (recomputed at update
     time) and ``rollout_lp`` is the behavior policy's logprob captured at
     generation. Truncation at ``cap`` bounds the variance the reweighting
-    can inject. Returns ``(weights, mean_weight, clip_frac)`` with weights
-    zeroed outside the response mask."""
+    can inject. Returns ``(weights, raw_ratio, mean_weight, clip_frac)``:
+    ``weights`` are capped and zeroed outside the response mask;
+    ``raw_ratio`` is the UNCAPPED per-token ratio so the training health
+    ledger can histogram the off-policy disagreement (and where the clip
+    bites) without a second exp/clip pass."""
     log_ratio = jnp.clip(old_log_probs - rollout_log_probs, -20.0, 20.0)
     ratio = jnp.exp(log_ratio)
     weights = jnp.minimum(ratio, cap) * response_mask
     mean_w = masked_mean(weights, response_mask)
     clip_frac = masked_mean((ratio > cap).astype(jnp.float32), response_mask)
-    return weights, mean_w, clip_frac
+    return weights, ratio, mean_w, clip_frac
 
 
 # ---------------------------------------------------------------------------

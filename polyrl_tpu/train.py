@@ -445,11 +445,23 @@ def build_trainer(cfg: RunConfig, cleanup: list | None = None):
             "on" if cfg.trainer.rollout_is_correction else "OFF",
             cfg.trainer.rollout_is_cap)
 
+    # training health plane (obs/rlhealth.py): default ON — training/*
+    # step metrics, /statusz training section, training.json bundles.
+    # obs.rlhealth=false turns it off (health=False disables the ledger).
+    if cfg.obs.rlhealth:
+        from polyrl_tpu.obs.rlhealth import TrainingHealthLedger
+
+        health = TrainingHealthLedger(
+            tail_steps=cfg.obs.rlhealth_tail,
+            max_group_rows=cfg.obs.rlhealth_group_rows)
+    else:
+        health = False
+
     val_dataset = build_dataset(cfg, "val")
     trainer = StreamRLTrainer(
         cfg.trainer, actor, rollout, tokenizer, reward_manager, loader,
         critic=critic, ref_policy=ref_policy, logger=logger,
-        val_dataset=val_dataset, recorder=recorder)
+        val_dataset=val_dataset, recorder=recorder, health=health)
     if cfg.obs.statusz and multihost.is_main():
         # live health plane: GET /statusz answers "what is this trainer
         # doing right now" (shared schema with the rollout server's route)

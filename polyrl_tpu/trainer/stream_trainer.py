@@ -48,17 +48,25 @@ log = logging.getLogger(__name__)
 
 class _ResultView:
     """Adapt a manager GenerateResult or a CBEngine output dict to the
-    engine-output field names the assembly code consumes."""
+    engine-output field names the assembly code consumes. Per-token
+    ``weight_versions`` (which push version sampled each token — the
+    training health ledger's staleness feed) ride along when the source
+    carries them; an empty array means "unknown" and the assembled batch
+    marks those tokens −1."""
 
-    __slots__ = ("output_ids", "output_token_logprobs")
+    __slots__ = ("output_ids", "output_token_logprobs",
+                 "output_token_weight_versions")
 
     def __init__(self, res):
         if isinstance(res, dict):
             ids, lps = res["token_ids"], res["logprobs"]
+            wvs = res.get("weight_versions") or []
         else:
             ids, lps = res.output_token_ids, res.output_token_logprobs
+            wvs = res.output_token_weight_versions or []
         self.output_ids = np.asarray(ids, np.int32)
         self.output_token_logprobs = np.asarray(lps, np.float32)
+        self.output_token_weight_versions = np.asarray(wvs, np.int32)
 
 
 @dataclasses.dataclass
@@ -176,6 +184,7 @@ class StreamRLTrainer:
         logger=None,
         val_dataset=None,
         recorder=None,
+        health=None,
     ):
         self.cfg = cfg
         self.actor = actor
@@ -225,9 +234,20 @@ class StreamRLTrainer:
         self._goodput = obs.GoodputLedger(flops=self._flops)
         self._last_record: dict = {}
         self._statusz = None
+        # training health plane (obs/rlhealth.py): per-step RL-dynamics
+        # ledger behind training/* step metrics and the /statusz training
+        # section. Default-on (pass health=False to disable, or a
+        # pre-built TrainingHealthLedger to configure tail sizes).
+        if health is None:
+            health = obs.TrainingHealthLedger()
+        self._health = health or None
         # anomaly flight recorder (obs/recorder.py): fed each finished
         # step record; dumps post-mortem bundles on anomaly/crash
         self._recorder = recorder
+        if recorder is not None and self._health is not None:
+            # entropy-collapse/KL-blowup bundles carry the RL-dynamics
+            # tail + the last batch's GRPO group table as training.json
+            recorder.training_fn = self._health.bundle_view
         if recorder is not None and isinstance(rollout, RemoteRollout):
             recorder.counters_fn = rollout.fault_counters
             # post-mortem bundles carry the fleet flight-deck tail (per-
@@ -328,6 +348,9 @@ class StreamRLTrainer:
         responses = np.full((n, tr), pad, np.int32)
         response_mask = np.zeros((n, tr), np.float32)
         rollout_log_probs = np.zeros((n, tr), np.float32)
+        # which push version sampled each response token (−1 = unknown):
+        # the health ledger's per-token staleness feed (obs/rlhealth.py)
+        weight_versions = np.full((n, tr), -1, np.int32)
         for i, (p, o) in enumerate(zip(prompts, outs)):
             lp = len(p)
             input_ids[i, tp - lp : tp] = p
@@ -339,6 +362,9 @@ class StreamRLTrainer:
             response_mask[i, : len(r)] = 1.0
             rollout_log_probs[i, : len(r)] = np.asarray(
                 o.output_token_logprobs[: len(r)])
+            wv = np.asarray(getattr(o, "output_token_weight_versions", []))
+            if len(wv) >= len(r) > 0:
+                weight_versions[i, : len(r)] = wv[: len(r)]
         positions = np.maximum(attention_mask.cumsum(axis=-1) - 1, 0).astype(np.int32)
 
         return TensorBatch.from_dict(
@@ -349,6 +375,7 @@ class StreamRLTrainer:
                 "responses": responses,
                 "response_mask": response_mask,
                 "rollout_log_probs": rollout_log_probs,
+                "rollout_weight_versions": weight_versions,
                 "group_ids": np.asarray(group_ids, np.int32),
             },
             non_tensors={"ground_truth": list(gts), "data_source": list(sources)},
@@ -615,19 +642,40 @@ class StreamRLTrainer:
                 raise NotImplementedError(est)
             ibatch.tensors["advantages"] = np.asarray(adv)
             ibatch.tensors["returns"] = np.asarray(ret)
+            tis_w = None
             if cfg.rollout_is_correction:
                 # stale-rollout correction (pipelined mode generates one
                 # weight-version behind the update): truncated importance
                 # reweighting of the generation-time behavior policy
                 # (rollout_log_probs) against the recomputed current-policy
                 # old_log_probs — OPPO/LlamaRL's bounded-staleness recipe
-                w, mean_w, clip_frac = core_algos.truncated_importance_weights(
-                    ibatch["old_log_probs"], ibatch["rollout_log_probs"],
-                    ibatch["response_mask"], cap=cfg.rollout_is_cap)
+                w, _ratio, mean_w, clip_frac = \
+                    core_algos.truncated_importance_weights(
+                        ibatch["old_log_probs"], ibatch["rollout_log_probs"],
+                        ibatch["response_mask"], cap=cfg.rollout_is_cap)
+                tis_w = np.asarray(w)
                 ibatch.tensors["advantages"] = (
-                    ibatch.tensors["advantages"] * np.asarray(w))
+                    ibatch.tensors["advantages"] * tis_w)
                 metrics.update({"actor/tis_weight_mean": float(mean_w),
                                 "actor/tis_clip_frac": float(clip_frac)})
+        if self._health is not None:
+            # RL-dynamics ledger feed (obs/rlhealth.py): everything is a
+            # host array this pass already produced; the per-token
+            # weight-version lag is measured against the rollout plane's
+            # CURRENT push version (tokens at −1 = version unknown)
+            self._health.observe_ibatch(
+                advantages=np.asarray(ibatch["advantages"]),
+                response_mask=np.asarray(ibatch["response_mask"]),
+                group_ids=np.asarray(ibatch["group_ids"]),
+                traj_rewards=np.asarray(token_rewards).sum(axis=-1),
+                data_sources=ibatch["data_source"],
+                old_log_probs=np.asarray(ibatch["old_log_probs"]),
+                rollout_log_probs=np.asarray(ibatch["rollout_log_probs"]),
+                tis_weights=tis_w,
+                weight_versions=ibatch.tensors.get("rollout_weight_versions"),
+                current_version=int(getattr(self.rollout,
+                                            "weight_version", 0)),
+                max_response_length=cfg.max_response_length)
         return ibatch
 
     # -- packed-sequence (remove-padding) path ---------------------------
@@ -1023,7 +1071,11 @@ class StreamRLTrainer:
             pool=pool.statusz_section() if pool is not None else None,
             # fleet flight-deck aggregate (the rollout plane serves its own
             # per-engine ledger; the trainer serves the pool-wide view)
-            engine=pool.engine_section() if pool is not None else None)
+            engine=pool.engine_section() if pool is not None else None,
+            # training health plane (always present on the trainer role
+            # unless explicitly disabled with health=False)
+            training=(self._health.snapshot()
+                      if self._health is not None else None))
 
     # -- fit --------------------------------------------------------------
 
@@ -1191,6 +1243,16 @@ class StreamRLTrainer:
                     mean_context_len=state["n_tokens"] / n_traj,
                     n_chips=jax.device_count()))
                 metrics.merge_histograms(hists)
+                if self._health is not None:
+                    # training health plane: close the step's RL-dynamics
+                    # window — training/* gauges (group diagnostics,
+                    # staleness, actor mirrors) + distribution histograms
+                    # land in this record; the recorder watches the
+                    # direction-aware keys off the same record
+                    hg, hh = self._health.finalize_step(
+                        self.global_step, metrics)
+                    metrics.update_gauge(hg)
+                    metrics.merge_histograms(hh)
                 if self.logger is not None:
                     metrics.update_gauge({"obs/log_errors": float(
                         getattr(self.logger, "log_errors", 0))})

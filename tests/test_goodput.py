@@ -203,7 +203,7 @@ def test_statusz_server_and_prometheus(tmp_path):
     srv = StatuszServer(lambda: snap).start()
     try:
         got = _get_json(f"http://{srv.endpoint}/statusz")
-        assert got["schema"] == "polyrl/statusz/v2"
+        assert got["schema"] == "polyrl/statusz/v3"
         assert got["role"] == "trainer" and got["step"] == 7
         # every schema section always present
         for section in ("goodput", "histograms", "counters", "gauges",
@@ -543,8 +543,12 @@ def test_e2e_goodput_statusz_and_stall_bundle(stall_stack, tmp_path):
         assert recorder.anomalies == 1, (times, det_state)
         assert len(recorder.bundle_paths) == 1
         bundle = recorder.bundle_paths[0]
+        # training.json: the health ledger rides every trainer bundle
         assert sorted(os.listdir(bundle)) == [
-            "counters.json", "spans.jsonl", "stacks.txt", "steps.jsonl"]
+            "counters.json", "spans.jsonl", "stacks.txt", "steps.jsonl",
+            "training.json"]
+        training = json.load(open(os.path.join(bundle, "training.json")))
+        assert training["steps"] >= 1 and training["tail"]
         spans = [json.loads(ln) for ln in
                  open(os.path.join(bundle, "spans.jsonl"))]
         assert any(s["name"] == "trainer/step" for s in spans)

@@ -234,11 +234,14 @@ def test_tis_weights_match_numpy_reference():
     beh = rng.normal(scale=0.7, size=(5, 9)).astype(np.float32)
     mask = (rng.random((5, 9)) > 0.3).astype(np.float32)
     cap = 1.5
-    w, mean_w, clip_frac = core_algos.truncated_importance_weights(
+    w, raw_ratio, mean_w, clip_frac = core_algos.truncated_importance_weights(
         old, beh, mask, cap=cap)
     ratio = np.exp(np.clip(old - beh, -20.0, 20.0))
     w_ref = np.minimum(ratio, cap) * mask
     np.testing.assert_allclose(np.asarray(w), w_ref, rtol=1e-5, atol=1e-7)
+    # the raw (uncapped, unmasked) ratio rides along for the health
+    # ledger's distribution pass — no second exp needed
+    np.testing.assert_allclose(np.asarray(raw_ratio), ratio, rtol=1e-5)
     denom = mask.sum()
     np.testing.assert_allclose(float(mean_w), w_ref.sum() / denom, rtol=1e-4)
     np.testing.assert_allclose(float(clip_frac),

@@ -1,0 +1,443 @@
+"""ISSUE 9: training health plane — RL-dynamics ledger, per-token
+staleness accounting, and drift anomalies.
+
+Covers: TrainingHealthLedger unit math (degenerate GRPO groups,
+effective-batch fraction, per-token weight-version staleness over a
+synthetic mixed-version batch), the bulk histogram path, the
+direction-aware anomaly detector (entropy collapse fires, a healthy
+entropy rise does not), statusz v3 conformance with the always-present
+``training`` section, the health_report CLI, and the e2e acceptance: a
+fake-engine fit emits ``training/*`` gauges+histograms in every step
+record and an induced entropy collapse produces exactly ONE post-mortem
+bundle containing ``training.json``.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+import os
+import urllib.request
+
+import numpy as np
+import pytest
+
+from polyrl_tpu.obs.histogram import Histogram
+from polyrl_tpu.obs.recorder import (DEFAULT_WATCH, AnomalyDetector,
+                                     FlightRecorder, direction_violates)
+from polyrl_tpu.obs.rlhealth import TrainingHealthLedger
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# -- ledger unit math --------------------------------------------------------
+
+
+def _mk_ibatch(*, rewards, group_ids, lens, tr=8, adv=None, versions=None,
+               sources=None):
+    """Synthetic per-ibatch arrays: trajectory i has ``lens[i]`` response
+    tokens; ``adv`` per trajectory broadcast over its tokens (GRPO
+    outcome-advantage shape); ``versions`` per trajectory applied to every
+    token (None → omitted)."""
+    n = len(rewards)
+    mask = np.zeros((n, tr), np.float32)
+    advantages = np.zeros((n, tr), np.float32)
+    wv = np.full((n, tr), -1, np.int32)
+    for i, ln in enumerate(lens):
+        mask[i, :ln] = 1.0
+        if adv is not None:
+            advantages[i, :ln] = adv[i]
+        if versions is not None:
+            wv[i, :ln] = versions[i]
+    return dict(
+        advantages=advantages, response_mask=mask,
+        group_ids=np.asarray(group_ids, np.int32),
+        traj_rewards=np.asarray(rewards, np.float64),
+        data_sources=sources,
+        weight_versions=wv if versions is not None else None)
+
+
+def test_ledger_degenerate_group_math():
+    """Group 0: all rewards equal → degenerate (zero advantage teaches
+    nothing); group 1: spread rewards → healthy. Truncation/empty and
+    per-source reward stats ride the same pass."""
+    led = TrainingHealthLedger()
+    led.observe_ibatch(
+        **_mk_ibatch(rewards=[1.0, 1.0, 0.0, 2.0],
+                     group_ids=[0, 0, 1, 1],
+                     lens=[8, 4, 0, 8],          # one truncated, one empty
+                     adv=[0.0, 0.0, -1.0, 1.0],
+                     sources=["gsm8k", "gsm8k", "math", "math"]),
+        max_response_length=8)
+    gauges, hists = led.finalize_step(1)
+    assert gauges["training/degenerate_group_frac"] == 0.5
+    assert gauges["training/groups"] == 2.0
+    # 2 of 4 trajectories carry any nonzero masked advantage (the empty
+    # response has no tokens → nothing nonzero even at adv=-1)... group 1
+    # row 3 has tokens; row 2 has len 0
+    assert gauges["training/effective_batch_frac"] == 0.25
+    assert gauges["training/truncated_frac"] == 0.5   # lens 8 of max 8: rows 0+3
+    assert gauges["training/empty_response_frac"] == 0.25
+    assert gauges["training/reward_mean/gsm8k"] == 1.0
+    assert gauges["training/reward_std/gsm8k"] == 0.0
+    assert gauges["training/reward_mean/math"] == 1.0
+    assert gauges["training/reward_std/math"] == pytest.approx(1.0)
+    assert "training/adv_abs" in hists
+    assert hists["training/response_len"].vmax == 8.0
+    # the group table kept one row per group with the degeneracy verdict
+    view = led.bundle_view()
+    degen = {row["group"]: row["degenerate"] for row in view["last_groups"]}
+    assert degen == {0: True, 1: False}
+    assert view["last_groups"][0]["data_source"] == "gsm8k"
+
+
+def test_ledger_staleness_mixed_version_batch():
+    """Per-token weight-version lag vs the current push version: a
+    synthetic batch mixing current (v5), one-stale (v4), three-stale (v2)
+    and unknown (−1) tokens — the staleness ledger the async k>1 roadmap
+    item trains against."""
+    led = TrainingHealthLedger()
+    led.observe_ibatch(
+        **_mk_ibatch(rewards=[1.0, 0.0, 2.0, 1.0],
+                     group_ids=[0, 0, 1, 1],
+                     lens=[4, 4, 4, 4],
+                     adv=[1.0, -1.0, 1.0, -1.0],
+                     versions=[5, 4, 2, -1]),
+        current_version=5, max_response_length=8)
+    gauges, hists = led.finalize_step(1)
+    # 12 of 16 masked tokens carry a known version; 8 of those are stale
+    assert gauges["training/staleness_known_frac"] == pytest.approx(12 / 16)
+    assert gauges["training/staleness_frac_stale"] == pytest.approx(8 / 12)
+    assert gauges["training/staleness_max"] == 3.0
+    st = hists["training/staleness"]
+    assert st.count == 12
+    assert st.mean == pytest.approx((0 * 4 + 1 * 4 + 3 * 4) / 12)
+    assert st.vmax == 3.0
+    # the step tail row carries the compact staleness view
+    row = led.tail[-1]
+    assert row["staleness_max"] == 3.0
+    assert row["staleness_p95"] >= 2.0
+
+
+def test_ledger_tis_and_logprob_delta_distributions():
+    led = TrainingHealthLedger()
+    n, tr = 2, 4
+    mask = np.ones((n, tr), np.float32)
+    old = np.zeros((n, tr)) + 0.5
+    beh = np.zeros((n, tr))
+    tis = np.full((n, tr), 1.5)
+    led.observe_ibatch(
+        advantages=np.ones((n, tr)), response_mask=mask,
+        group_ids=np.asarray([0, 1]), traj_rewards=np.asarray([1.0, 0.0]),
+        old_log_probs=old, rollout_log_probs=beh, tis_weights=tis,
+        max_response_length=tr)
+    gauges, hists = led.finalize_step(1)
+    assert hists["training/tis_weight"].mean == pytest.approx(1.5)
+    assert hists["training/logprob_delta_abs"].mean == pytest.approx(0.5)
+    assert gauges["training/logprob_delta_mean"] == pytest.approx(0.5)
+
+
+def test_histogram_observe_many_matches_observe():
+    """The bulk numpy path must bucket exactly like the scalar path."""
+    rng = np.random.default_rng(3)
+    vals = np.concatenate([rng.lognormal(0.0, 2.0, 500),
+                           np.zeros(7), -rng.random(5)])
+    a, b = Histogram(), Histogram()
+    for v in vals:
+        a.observe(float(v))
+    b.observe_many(vals)
+    assert a.buckets == b.buckets
+    assert (a.count, a.zeros) == (b.count, b.zeros)
+    assert a.total == pytest.approx(b.total)
+    assert (a.vmin, a.vmax) == (b.vmin, b.vmax)
+    for q in (50.0, 95.0, 99.0):
+        assert a.percentile(q) == b.percentile(q)
+
+
+# -- direction-aware anomaly detection ---------------------------------------
+
+
+def test_direction_violates_semantics():
+    assert direction_violates("high", +1.0) and not direction_violates(
+        "high", -1.0)
+    assert direction_violates("low", -1.0) and not direction_violates(
+        "low", +1.0)
+    assert direction_violates("both", -1.0) and direction_violates(
+        "both", +1.0)
+    with pytest.raises(ValueError):
+        direction_violates("sideways", 1.0)
+
+
+def test_detector_collapse_fires_healthy_rise_does_not():
+    """An entropy watch (direction='low'): a 2x healthy RISE stays silent
+    (the symmetric detector's false positive), a collapse fires — and the
+    rise was not folded into the baseline, so the later collapse is still
+    judged against the healthy mean."""
+    det = AnomalyDetector(z_threshold=4.0, warmup=3, min_sigma_frac=0.1,
+                          direction="low")
+    for v in (2.0, 2.05, 1.95, 2.0):
+        assert det.observe(v) is None
+    assert det.observe(4.0) is None          # healthy spike: no anomaly
+    assert abs(det.mean - 2.0) < 0.1         # ... and not folded
+    assert det.observe(2.0) is None
+    z = det.observe(0.01)                    # collapse: fires
+    assert z is not None and z < -4.0
+    # same series on a 'high' watch: the collapse is the healthy direction
+    det_hi = AnomalyDetector(z_threshold=4.0, warmup=3, min_sigma_frac=0.1,
+                             direction="high")
+    for v in (2.0, 2.05, 1.95, 2.0):
+        det_hi.observe(v)
+    assert det_hi.observe(0.01) is None
+    assert det_hi.observe(40.0) is not None
+
+
+def test_default_watch_directions_and_spec_forms():
+    """DEFAULT_WATCH keeps the original systems keys symmetric and adds
+    the direction-aware training keys; the watch spec still accepts the
+    legacy bare-key tuple (symmetric) and (key, direction) pairs."""
+    assert DEFAULT_WATCH["perf/step_time_s"] == "both"
+    assert DEFAULT_WATCH["engine/occupancy"] == "both"
+    assert DEFAULT_WATCH["training/entropy"] == "low"
+    assert DEFAULT_WATCH["training/approx_kl"] == "high"
+    assert DEFAULT_WATCH["training/grad_norm"] == "high"
+    assert DEFAULT_WATCH["training/degenerate_group_frac"] == "high"
+    rec = FlightRecorder("/tmp/unused", watch=("perf/step_time_s",
+                                               ("training/entropy", "low")))
+    assert rec._detectors["perf/step_time_s"].direction == "both"
+    assert rec._detectors["training/entropy"].direction == "low"
+
+
+# -- statusz v3 conformance ---------------------------------------------------
+
+
+def test_statusz_v3_training_section_always_present():
+    from polyrl_tpu.obs import statusz
+
+    assert statusz.SCHEMA == "polyrl/statusz/v3"
+    assert "training" in statusz.REQUIRED_SECTIONS
+    # both roles, no args: every required section present (empty ok)
+    for role in ("trainer", "rollout"):
+        snap = statusz.build_snapshot(role)
+        for section in statusz.REQUIRED_SECTIONS:
+            assert section in snap, f"{role} missing {section}"
+        assert snap["training"] == {}
+    led = TrainingHealthLedger()
+    led.observe_ibatch(**_mk_ibatch(rewards=[1.0, 0.0], group_ids=[0, 0],
+                                    lens=[2, 2], adv=[1.0, -1.0]),
+                       max_response_length=4)
+    led.finalize_step(1)
+    snap = statusz.build_snapshot("trainer", training=led.snapshot())
+    assert snap["training"]["steps"] == 1
+    assert snap["training"]["tail"][-1]["step"] == 1
+    assert "training/degenerate_group_frac" in snap["training"]["last"]
+
+
+# -- health_report CLI --------------------------------------------------------
+
+
+def _load_health_report():
+    spec = importlib.util.spec_from_file_location(
+        "health_report", os.path.join(REPO, "tools", "health_report.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_health_report_renders_trend_and_flags_collapse(tmp_path, capsys):
+    hr = _load_health_report()
+    path = tmp_path / "steps.jsonl"
+    with open(path, "w") as f:
+        for i in range(8):
+            ent = 2.0 if i < 7 else 0.01
+            f.write(json.dumps({
+                "step": i + 1, "training/entropy": ent,
+                "training/approx_kl": 0.01,
+                "training/degenerate_group_frac": 0.25,
+                "training/staleness/p95": 1.0,
+                "perf/step_time_s": 1.0}) + "\n")
+    assert hr.main([str(path), "--warmup", "3"]) == 0
+    out = capsys.readouterr().out
+    assert "training health report" in out
+    assert "entropy" in out and "staleness_p95" in out
+    assert "anomalies (1 flagged" in out
+    assert "step 8: entropy" in out
+    # empty input is a usage error, not a traceback
+    empty = tmp_path / "empty.jsonl"
+    empty.write_text("")
+    assert hr.main([str(empty)]) == 2
+
+
+# -- e2e acceptance: fake-engine fit → training/* records + induced
+# -- entropy collapse → exactly one bundle with training.json ----------------
+
+
+class _FakeStaleRollout:
+    """Colocated-engine-shaped stub whose outputs carry per-token
+    weight_versions one behind the current push version — deterministic
+    staleness for the ledger to account."""
+
+    def __init__(self):
+        self.pad_token_id = 0
+        self.weight_version = 0
+        self.last_gen_throughput = 0.0
+
+    def generate(self, prompts, sampling, rng=None, **kw):
+        out = []
+        for i, p in enumerate(prompts):
+            n = sampling.max_new_tokens if i % 2 else \
+                max(sampling.max_new_tokens // 2, 1)
+            out.append({
+                "token_ids": [1 + (len(p) + j) % 200 for j in range(n)],
+                "logprobs": [-0.5] * n,
+                # alternate current/one-stale per token
+                "weight_versions": [max(self.weight_version - (j % 2), 0)
+                                    for j in range(n)]})
+        return out
+
+    def update_weights(self, params, version=None):
+        self.weight_version += 1
+
+
+def test_e2e_fit_training_records_and_entropy_collapse_bundle(tmp_path):
+    """ISSUE 9 acceptance: every step record of a fake-engine fit carries
+    training/* gauges AND distributions (incl. per-token staleness); an
+    induced entropy collapse (healthy spike first — must NOT fire) dumps
+    exactly one anomaly bundle whose training.json holds the ledger tail
+    + the last batch's GRPO group table; the trainer /statusz serves the
+    v3 training section."""
+    import jax.numpy as jnp
+
+    from polyrl_tpu.data.dataset import (PromptDataLoader,
+                                         make_arithmetic_dataset)
+    from polyrl_tpu.models import decoder
+    from polyrl_tpu.rewards.manager import load_reward_manager
+    from polyrl_tpu.trainer.actor import ActorConfig, StreamActor
+    from polyrl_tpu.trainer.stream_trainer import (StreamRLTrainer,
+                                                   TrainerConfig)
+    from polyrl_tpu.utils.tokenizer import ByteTokenizer
+
+    import jax
+
+    mcfg = decoder.get_config("tiny", dtype=jnp.float32, vocab_size=512,
+                              max_position_embeddings=128)
+    params = decoder.init_params(jax.random.PRNGKey(0), mcfg)
+    tok = ByteTokenizer()
+    tcfg = TrainerConfig(
+        train_batch_size=4, rollout_n=2, ppo_mini_batch_size=8,
+        micro_batch_size=4, min_stream_batch_size=4,
+        max_prompt_length=16, max_response_length=8,
+        adv_estimator="grpo", total_steps=7, rollout_is_correction=True)
+    actor = StreamActor(mcfg, ActorConfig(lr=1e-4, remat=False), params)
+    # scripted entropy per 0-based step: 3-step warmup at 2.0, a HEALTHY
+    # 2x rise at step 4 (the symmetric detector's false positive), then
+    # the collapse at the last step
+    script = {4: 4.0, 6: 0.01}
+    trainer_box = []
+    orig_update = actor.update_stream
+
+    def scripted_update(feed, is_opt, loss_scale=1.0):
+        m = dict(orig_update(feed, is_opt, loss_scale=loss_scale))
+        step = trainer_box[0].global_step
+        m["actor/entropy"] = script.get(step, 2.0)
+        return m
+
+    actor.update_stream = scripted_update
+    recorder = FlightRecorder(str(tmp_path), keep_steps=16,
+                              z_threshold=4.0, warmup=3,
+                              min_sigma_frac=0.1,
+                              watch={"training/entropy": "low"})
+    trainer = StreamRLTrainer(
+        tcfg, actor, _FakeStaleRollout(), tok,
+        load_reward_manager("naive", tok, num_workers=1),
+        PromptDataLoader(make_arithmetic_dataset(64), 4),
+        recorder=recorder)
+    trainer_box.append(trainer)
+    statusz_srv = trainer.start_statusz()
+    try:
+        history = trainer.fit()
+        assert len(history) == 7
+
+        # training/* gauges + distributions in EVERY step record
+        for rec in history:
+            assert "training/degenerate_group_frac" in rec
+            assert "training/effective_batch_frac" in rec
+            assert "training/entropy" in rec
+            assert "training/adv_abs/p50" in rec
+            assert "training/response_len/max" in rec
+            assert "training/tis_weight/mean" in rec
+            # per-token staleness: the fake's alternating versions give
+            # lag 1 on half the known tokens once a push has happened
+            assert "training/staleness/p95" in rec
+            assert rec["training/staleness_known_frac"] == 1.0
+        assert history[-1]["training/staleness_max"] >= 1.0
+        assert history[-1]["training/staleness_frac_stale"] > 0.0
+        assert history[3]["training/entropy"] == 2.0
+        assert history[4]["training/entropy"] == 4.0
+
+        # exactly one bundle: the healthy rise stayed silent, the
+        # collapse fired once
+        assert recorder.anomalies == 1
+        assert len(recorder.bundle_paths) == 1
+        bundle = recorder.bundle_paths[0]
+        counters = json.load(open(os.path.join(bundle, "counters.json")))
+        assert counters["reason"] == "anomaly"
+        assert "training/entropy" in counters["detail"]
+        training = json.load(open(os.path.join(bundle, "training.json")))
+        assert training["steps"] == 7
+        assert len(training["tail"]) == 7
+        assert training["tail"][-1]["entropy"] == pytest.approx(0.01)
+        groups = training["last_groups"]
+        assert groups and all("reward_mean" in g and "degenerate" in g
+                              for g in groups)
+
+        # trainer /statusz: v3 with the live training section
+        with urllib.request.urlopen(
+                f"http://{statusz_srv.endpoint}/statusz", timeout=10.0) as r:
+            snap = json.loads(r.read())
+        assert snap["schema"] == "polyrl/statusz/v3"
+        assert snap["training"]["steps"] == 7
+        assert snap["training"]["last"][
+            "training/entropy"] == pytest.approx(0.01)
+        assert snap["gauges"]["training/staleness_max"] >= 1.0
+        # the health_report CLI reads the bundle directly
+        hr = _load_health_report()
+        report = hr.render(*hr.load_records(bundle), last=0, z=4.0,
+                           warmup=3)
+        assert "bundle: anomaly" in report
+        assert "GRPO group table" in report
+    finally:
+        trainer.stop_statusz()
+
+
+def test_health_ledger_can_be_disabled():
+    """health=False: no training/* emission, statusz training section
+    empty — the conformance contract still holds (section present)."""
+    from polyrl_tpu.trainer.stream_trainer import StreamRLTrainer
+
+    # constructor-level check without running a fit
+    class _R:
+        pad_token_id = 0
+        weight_version = 0
+        last_gen_throughput = 0.0
+
+    import jax.numpy as jnp
+
+    from polyrl_tpu.models import decoder
+    from polyrl_tpu.trainer.actor import ActorConfig, StreamActor
+    from polyrl_tpu.trainer.stream_trainer import TrainerConfig
+    from polyrl_tpu.utils.tokenizer import ByteTokenizer
+
+    import jax
+
+    mcfg = decoder.get_config("tiny", dtype=jnp.float32, vocab_size=512,
+                              max_position_embeddings=128)
+    params = decoder.init_params(jax.random.PRNGKey(0), mcfg)
+    actor = StreamActor(mcfg, ActorConfig(lr=1e-4, remat=False), params)
+    tcfg = TrainerConfig(train_batch_size=4, rollout_n=2,
+                         ppo_mini_batch_size=8, micro_batch_size=4,
+                         min_stream_batch_size=4, total_steps=1)
+    trainer = StreamRLTrainer(tcfg, actor, _R(), ByteTokenizer(),
+                              None, None, health=False)
+    assert trainer._health is None
+    snap = trainer.statusz_snapshot()
+    assert snap["training"] == {}

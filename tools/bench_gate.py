@@ -38,44 +38,63 @@ import os
 import re
 import sys
 
+# the per-key direction semantics are SHARED with the FlightRecorder's
+# direction-aware watch (polyrl_tpu/obs/recorder.py) — one definition of
+# "which way is bad", used by both the live anomaly detector and this
+# offline gate
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+from polyrl_tpu.obs.recorder import direction_violates  # noqa: E402
+
 DEFAULT_THRESHOLD = 0.15
 
-# watched extra.* paths: (dotted path, higher_is_better). Missing paths
-# are skipped — rounds measure what their phases reached.
+# watched extra.* paths: (dotted path, direction-that-is-bad) — "low"
+# fails when the value DROPS beyond the threshold (throughput, rates),
+# "high" when it RISES (latencies, clip/degeneracy fractions). Missing
+# paths are skipped — rounds measure what their phases reached.
 WATCHED_EXTRA = (
-    ("cb.serve_tok_s", True),
-    ("cb.direct_tok_s", True),
-    ("cb.serve_peak_tok_s", True),
-    ("cb.util.mfu_pct", True),
-    ("cb.ttft_p95_ms", False),
-    ("cb.req_p95_s", False),
-    ("llama3_8b.tok_s", True),
-    ("llama3_8b.util.mfu_pct", True),
-    ("bucketed.tok_s", True),
-    ("bucketed.util.mfu_pct", True),
-    ("weight_sync.eff_mb_s", True),
-    ("weight_sync.total_s", False),
-    ("spec.speedup_continuation", True),
+    ("cb.serve_tok_s", "low"),
+    ("cb.direct_tok_s", "low"),
+    ("cb.serve_peak_tok_s", "low"),
+    ("cb.util.mfu_pct", "low"),
+    ("cb.ttft_p95_ms", "high"),
+    ("cb.req_p95_s", "high"),
+    ("llama3_8b.tok_s", "low"),
+    ("llama3_8b.util.mfu_pct", "low"),
+    ("bucketed.tok_s", "low"),
+    ("bucketed.util.mfu_pct", "low"),
+    ("weight_sync.eff_mb_s", "low"),
+    ("weight_sync.total_s", "high"),
+    ("spec.speedup_continuation", "low"),
     # elastic-pool topology (bench.py --pool N): aggregate throughput must
     # hold, the preemption/rejoin drill must not slow down, and a round
     # that silently shrank its pool is a regression
-    ("pool.tok_s", True),
-    ("pool.pool_engines", True),
-    ("pool.recovery_s", False),
+    ("pool.tok_s", "low"),
+    ("pool.pool_engines", "low"),
+    ("pool.recovery_s", "high"),
     # engine flight deck (server-side ledger, promoted from the cb phase):
     # decode occupancy and prefix-cache hit rate must hold; the
     # server-measured TTFT/TPOT tails must not blow up
-    ("engine_occupancy", True),
-    ("engine_cache_hit_rate", True),
-    ("engine_ttft_p95_ms", False),
-    ("engine_tpot_p95_ms", False),
+    ("engine_occupancy", "low"),
+    ("engine_cache_hit_rate", "low"),
+    ("engine_ttft_p95_ms", "high"),
+    ("engine_tpot_p95_ms", "high"),
     # group-shared prefill (bench.py --group-share A/B, and the cb phase's
     # serving default): the reuse fraction must hold, the per-group
     # admission dispatch count must stay collapsed (1 prefill + ≤1 attach
     # ⇒ reduction ~G/2), and sharing must keep paying off wall-clock
-    ("engine_prefill_reuse_frac", True),
-    ("group_share.engine_prefill_reuse_frac", True),
-    ("group_share.dispatch_reduction", True),
+    ("engine_prefill_reuse_frac", "low"),
+    ("group_share.engine_prefill_reuse_frac", "low"),
+    ("group_share.dispatch_reduction", "low"),
+    # training health plane (bench.py --pipeline-microbench fit records,
+    # obs/rlhealth.py): entropy collapsing between rounds is a regression
+    # even when tok/s held; KL, TIS clipping and degenerate-group
+    # fraction must not blow up
+    ("training_entropy", "low"),
+    ("training_approx_kl", "high"),
+    ("training_tis_clip_frac", "high"),
+    ("training_degenerate_group_frac", "high"),
 )
 
 
@@ -139,18 +158,21 @@ def gate(rounds: list[dict], threshold: float = DEFAULT_THRESHOLD) -> dict:
                 "newest_n": newest["n"], "history": 0,
                 "note": "no successful prior rounds to gate against"}
 
-    def check(name: str, new, base, higher_better: bool) -> None:
+    def check(name: str, new, base, direction: str) -> None:
         if new is None or base is None or base == 0:
             return
         ratio = new / base
-        bad = ratio < 1.0 - threshold if higher_better \
-            else ratio > 1.0 + threshold
+        # shared direction semantics with the FlightRecorder watch: the
+        # excursion is the relative move (ratio − 1); it only fails when
+        # it is BOTH beyond the threshold AND in the bad direction
+        bad = (abs(ratio - 1.0) > threshold
+               and direction_violates(direction, ratio - 1.0))
         checks.append({"field": name, "new": new, "baseline": round(base, 4),
                        "ratio": round(ratio, 4), "ok": not bad})
         if bad:
-            direction = "dropped" if higher_better else "rose"
+            moved = "rose" if ratio > 1.0 else "dropped"
             failures.append(
-                f"{name} {direction} beyond {threshold:.0%}: "
+                f"{name} {moved} beyond {threshold:.0%}: "
                 f"{new:.4g} vs baseline {base:.4g} "
                 f"(ratio {ratio:.3f})")
 
@@ -163,14 +185,14 @@ def gate(rounds: list[dict], threshold: float = DEFAULT_THRESHOLD) -> dict:
                 f"newest round (n={newest['n']}) recorded no headline "
                 f"value (baseline {base:.4g})")
         else:
-            check("value", newest["value"], base, True)
-    for path, higher in WATCHED_EXTRA:
+            check("value", newest["value"], base, "low")
+    for path, direction in WATCHED_EXTRA:
         base_vals = [v for v in (_dig(r["extra"], path) for r in prior)
                      if v is not None]
         if not base_vals:
             continue
         check(f"extra.{path}", _dig(newest["extra"], path),
-              _median(base_vals), higher)
+              _median(base_vals), direction)
 
     return {"ok": not failures, "failures": failures, "checks": checks,
             "newest_n": newest["n"], "history": len(prior)}

@@ -33,7 +33,15 @@ and the injector's ``fault/injected_*`` (rollout/faults.py ``counters``)
 distributions (``engine/ttft_s``, ``engine/tpot_s``,
 ``engine/queue_wait_s``, ``engine/prefill_s``) into the global histogram
 registry and fleet aggregates (``engine/occupancy``, ``engine/page_util``,
-``engine/ttft_p95_s``, ...) via PoolManager.counters. New metric emitters in
+``engine/ttft_p95_s``, ...) via PoolManager.counters. The training health
+plane (obs/rlhealth.py) emits ``training/*`` — distribution summaries
+(``training/adv_abs``, ``training/tis_weight``, ``training/staleness``,
+...), GRPO group diagnostics (``training/degenerate_group_frac``,
+``training/effective_batch_frac``), per-source reward gauges
+(``training/reward_mean/<src>``) and actor mirrors
+(``training/{entropy,approx_kl,grad_norm}``) — sharing the pre-existing
+``training`` namespace with the trainer's step counter and balancer
+budget. New metric emitters in
 ``polyrl_tpu/`` are linted automatically; nothing needs registering —
 EXCEPT a new top-level namespace, which must be added to ``NAMESPACES``
 below and documented in ARCHITECTURE.md in the same change (an
@@ -64,7 +72,9 @@ NAMESPACES = frozenset({
     "val",           # validation scores
     "perf",          # step wall / throughput / MFU / pipeline gauges
     "goodput",       # per-step wall-time phase attribution (obs/goodput.py)
-    "training",      # step counter / balancer budget
+    "training",      # step counter / balancer budget + the training
+                     # health plane: RL-dynamics distributions, GRPO
+                     # group diagnostics, staleness (obs/rlhealth.py)
     "fault",         # control-plane + salvage fault counters
     "manager",       # scraped manager gauges + client RTT
     "pool",          # elastic-pool membership + balance estimator gauges
