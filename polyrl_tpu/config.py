@@ -23,6 +23,7 @@ from polyrl_tpu.rollout.pool import PoolConfig
 from polyrl_tpu.trainer.actor import ActorConfig
 from polyrl_tpu.trainer.critic import CriticConfig
 from polyrl_tpu.trainer.stream_trainer import TrainerConfig
+from polyrl_tpu.transfer.agents import TransferConfig
 
 
 @dataclass
@@ -211,6 +212,12 @@ class RunConfig:
     tokenizer: TokenizerSection = field(default_factory=TokenizerSection)
     data: DataSection = field(default_factory=DataSection)
     rollout: RolloutSection = field(default_factory=RolloutSection)
+    # weight-push fabric supervision (transfer/agents.py TransferConfig;
+    # ARCHITECTURE.md "Weight-fabric fault tolerance"): bandwidth-keyed
+    # push deadlines, verify/resume toggle, retry budget + backoff, and
+    # the transfer-plane fault injector — knobs echoed in step records
+    # via the transfer/* gauges
+    transfer: TransferConfig = field(default_factory=TransferConfig)
     parallel: ParallelSection = field(default_factory=ParallelSection)
     reward: RewardSection = field(default_factory=RewardSection)
     trainer: TrainerConfig = field(default_factory=TrainerConfig)

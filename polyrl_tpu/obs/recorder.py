@@ -154,6 +154,10 @@ DEFAULT_WATCH = {
     "training/approx_kl": "high",
     "training/grad_norm": "high",
     "training/degenerate_group_frac": "high",
+    # weight-fabric supervision (transfer/agents.py): a cumulative failed-
+    # push counter starting to climb means the sync fabric is degrading —
+    # only a RISE is the anomaly
+    "transfer/push_failures": "high",
 }
 
 

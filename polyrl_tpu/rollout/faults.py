@@ -17,6 +17,13 @@ One :class:`FaultInjector` instance can be attached at two seams:
   tokens — killing the stream at the worst possible moment so the salvage
   ledger's suffix re-issue is exercised for EVERY request.
 
+The weight-push fabric has its own sibling pair —
+:class:`TransferFaultConfig` / :class:`TransferFaultInjector` (config
+``transfer.fault_injection.*``) — injecting frame corruption on the wire,
+stream stalls past the bandwidth-keyed push deadline, and control-channel
+kills mid-round, so the verified/resumable push path (ARCHITECTURE.md
+"Weight-fabric fault tolerance") is drillable end to end.
+
 Faults are keyed by the request's *base* rid (the manager appends ``#a<n>``
 per attempt), so ``once_per_request`` means once per logical request across
 every retry/continuation/suffix-resume, which keeps fault runs terminating.
@@ -69,6 +76,129 @@ def base_rid(rid: str) -> str:
     """Strip the manager's per-attempt ``#a<n>`` suffix: fault bookkeeping
     must follow the logical request across retries and continuations."""
     return rid.rsplit("#a", 1)[0]
+
+
+# --------------------------------------------------------------------------
+# Transfer-plane faults (the weight-push fabric's chaos surface)
+# --------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class TransferFaultConfig:
+    """Transfer-plane faults (config ``transfer.fault_injection.*``).
+
+    All triggers are budgeted and optionally targeted at one instance by
+    endpoint substring (empty = any), and each can be gated behind N clean
+    push attempts to the matching instance (``*_after_attempts``) so a
+    run's bootstrap catch-up push lands clean before the chaos arms —
+    attempts are counted by ``SenderAgent`` via :meth:`note_attempt`."""
+    enabled: bool = False
+    # flip one payload byte of this many wire frames (the CRC32 trailer is
+    # computed over the TRUE bytes, so the receiver detects and rejects)
+    corrupt_frames: int = 0
+    corrupt_instance: str = ""
+    corrupt_after_attempts: int = 0
+    # stall a stream before its first frame — a stall longer than the
+    # bandwidth-keyed push deadline fails the attempt by timeout
+    stall_s: float = 0.0
+    stall_streams: int = -1        # total stall budget (-1 = unlimited)
+    stall_instance: str = ""
+    stall_after_attempts: int = 0
+    # close the sender->receiver control channel right before the verify
+    # handshake (mid-round control-plane death: the receiver must
+    # reconnect and the retry re-push the round)
+    kill_control_rounds: int = 0
+    kill_control_instance: str = ""
+    kill_control_after_attempts: int = 0
+
+
+class TransferFaultInjector:
+    """Sibling of :class:`FaultInjector` for the weight-push fabric;
+    counters are cumulative and public (tests and ``bench.py
+    --push-chaos`` report them). Stalls sleep interruptibly —
+    ``SenderAgent.stop()`` calls :meth:`stop` so a teardown mid-drill
+    never waits out a sleeping fault."""
+
+    def __init__(self, cfg: TransferFaultConfig | None = None, **overrides):
+        if cfg is None:
+            cfg = TransferFaultConfig(enabled=True, **overrides)
+        elif overrides:
+            cfg = dataclasses.replace(cfg, **overrides)
+        self.cfg = cfg
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._attempts: dict[str, int] = {}  # instance -> push attempts
+        # telemetry
+        self.corruptions = 0
+        self.stalls = 0
+        self.control_kills = 0
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def counters(self) -> dict[str, float]:
+        return {
+            "fault/transfer_corruptions": float(self.corruptions),
+            "fault/transfer_stalls": float(self.stalls),
+            "fault/transfer_control_kills": float(self.control_kills),
+        }
+
+    def note_attempt(self, instance: str) -> None:
+        """Called by the sender at the start of every push attempt — the
+        ``*_after_attempts`` gates count these."""
+        with self._lock:
+            self._attempts[instance] = self._attempts.get(instance, 0) + 1
+
+    def _armed(self, instance: str, target: str, after: int) -> bool:
+        if not self.cfg.enabled:
+            return False
+        if target and target not in instance:
+            return False
+        return self._attempts.get(instance, 0) > after
+
+    def take_corruption(self, instance: str, stream_idx: int) -> bool:
+        """One corrupt frame off the budget (called per frame send)."""
+        with self._lock:
+            fire = (self.cfg.corrupt_frames > 0
+                    and self._armed(instance, self.cfg.corrupt_instance,
+                                    self.cfg.corrupt_after_attempts)
+                    and self.corruptions < self.cfg.corrupt_frames)
+            if fire:
+                self.corruptions += 1
+        if fire:
+            log.warning("transfer fault: corrupting a frame on stream %d "
+                        "-> %s", stream_idx, instance)
+        return fire
+
+    def maybe_stall(self, instance: str, stream_idx: int) -> None:
+        """Stall this stream before its first frame (interruptible)."""
+        with self._lock:
+            fire = (self.cfg.stall_s > 0
+                    and self._armed(instance, self.cfg.stall_instance,
+                                    self.cfg.stall_after_attempts)
+                    and (self.cfg.stall_streams < 0
+                         or self.stalls < self.cfg.stall_streams))
+            if fire:
+                self.stalls += 1
+        if fire:
+            log.warning("transfer fault: stalling stream %d -> %s for "
+                        "%.1fs", stream_idx, instance, self.cfg.stall_s)
+            self._stop.wait(self.cfg.stall_s)
+
+    def take_control_kill(self, instance: str) -> bool:
+        """One mid-round control-channel kill off the budget."""
+        with self._lock:
+            fire = (self.cfg.kill_control_rounds > 0
+                    and self._armed(instance,
+                                    self.cfg.kill_control_instance,
+                                    self.cfg.kill_control_after_attempts)
+                    and self.control_kills < self.cfg.kill_control_rounds)
+            if fire:
+                self.control_kills += 1
+        if fire:
+            log.warning("transfer fault: killing the control channel to "
+                        "%s mid-round", instance)
+        return fire
 
 
 class FaultInjector:

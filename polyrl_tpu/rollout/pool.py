@@ -90,6 +90,15 @@ class PoolManager:
         # /reconcile; these are the drills THIS control plane initiated)
         self.preemptions = 0
         self.hard_evictions = 0
+        # weight-fabric escalations (ARCHITECTURE.md "Weight-fabric fault
+        # tolerance"): engines drained + deregistered after exhausting
+        # their push retry budget — dead capacity removed, not re-pushed
+        self.laggards = 0
+        # optional zero-arg callable returning the sender-side per-engine
+        # sync health ({endpoint: {pushed_version, push_failures, ...}};
+        # train.py wires TransferInterface.sync_health) — merged into the
+        # /statusz pool section's engine rows as their "transfer" block
+        self.transfer_health_fn = None
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         if self.cfg.sweep_interval_s > 0:
@@ -221,6 +230,29 @@ class PoolManager:
         self.hard_evictions += 1
         self.manager.deregister_rollout_instance(endpoint, drained=False)
 
+    def escalate_laggard(self, endpoint: str, reason: str = "") -> None:
+        """Weight-fabric escalation (``SenderAgent.laggard_cb``): this
+        engine exhausted its push retry budget — its weights can never
+        catch up, the bootstrap gate already holds it out of routing, and
+        until now it was re-pushed every ``poll_s`` forever. Drain it
+        (best-effort: salvageable partials re-route to survivors) and
+        deregister, booking an eviction — it is dead capacity, not a
+        graceful departure."""
+        self.laggards += 1
+        log.error("pool: escalating laggard %s (%s) — drain + deregister",
+                  endpoint, reason or "push retry budget exhausted")
+        try:
+            _http_post(endpoint, "/drain")
+        except Exception:  # noqa: BLE001 — it may be fully dead already
+            log.warning("laggard drain of %s failed; deregistering anyway",
+                        endpoint, exc_info=True)
+        try:
+            self.manager.deregister_rollout_instance(endpoint,
+                                                     drained=False)
+        except Exception:  # noqa: BLE001 — heartbeat eviction backstops
+            log.warning("laggard deregister of %s failed; heartbeat will "
+                        "evict", endpoint, exc_info=True)
+
     # -- telemetry ---------------------------------------------------------
 
     def counters(self, refresh: bool = True) -> dict[str, float]:
@@ -241,6 +273,7 @@ class PoolManager:
             "pool/evictions": float(pool.get("evictions", 0)),
             "pool/drain_departures": float(pool.get("drain_departures", 0)),
             "pool/preemption_drills": float(self.preemptions),
+            "pool/laggard_escalations": float(self.laggards),
         }
         versions = [int(i.get("weight_version", -1)) for i in insts]
         if versions:
@@ -313,16 +346,27 @@ class PoolManager:
 
     def statusz_section(self) -> dict:
         """The /statusz ``pool`` block: membership + per-engine health,
-        queue depth, and weight version (served from the cached sweep so
-        the exporter never blocks on a respawning manager)."""
+        queue depth, weight version, and — with the transfer fabric
+        attached — each engine's weight-sync health (pushed version, push
+        failures, verify rejections, resume bytes, laggard flag), all
+        served from the cached sweep so the exporter never blocks on a
+        respawning manager."""
         with self._lock:
             st = dict(self._last_status)
             age = time.monotonic() - self._last_sweep if self._last_sweep \
                 else -1.0
+        sync: dict = {}
+        if self.transfer_health_fn is not None:
+            try:
+                sync = dict(self.transfer_health_fn() or {})
+            except Exception:  # noqa: BLE001 — health is best-effort
+                log.warning("transfer sync-health probe failed",
+                            exc_info=True)
         return {
             "counts": {k.split("/", 1)[1]: v
                        for k, v in self.counters(refresh=False).items()},
             "engines": [{
+                "transfer": sync.get(i.get("endpoint", ""), {}),
                 "endpoint": i.get("endpoint", ""),
                 "is_local": bool(i.get("is_local")),
                 "healthy": bool(i.get("healthy")),

@@ -178,6 +178,13 @@ class RemoteRollout:
             # same step-record gauges the recovery counters do, so a drill
             # record shows cause and effect side by side
             out.update(self.fault_injector.counters())
+        transfer_counters = getattr(self.transfer, "counters", None)
+        if transfer_counters is not None:
+            # weight-fabric supervision (transfer/* gauges: push failures/
+            # retries, verify rejections, resumed bytes, laggard
+            # escalations + knob echo) — rides every step record, which is
+            # what the FlightRecorder's transfer/push_failures watch reads
+            out.update(transfer_counters())
         retries = getattr(self.manager, "retry_count", None)
         if retries is not None:
             out["fault/client_retries"] = float(retries)

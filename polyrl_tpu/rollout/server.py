@@ -590,6 +590,14 @@ class RolloutServer:
             # TTFT+TPOT tails / token-accounting reconciliation — flat keys
             # the manager's stats poller forwards and bench reads
             info.update(deck.server_info_fields())
+        if self.receiver is not None:
+            # weight-sync health (transfer/agents.py ReceiverAgent.health):
+            # control-channel reconnects, rejected CRC frames, verify
+            # failures, resume bytes — a flapping sender or a corrupting
+            # link is visible per engine in server_info and /statusz
+            health = getattr(self.receiver, "health", None)
+            if health is not None:
+                info.update(health())
         return info
 
     def statusz_snapshot(self) -> dict:

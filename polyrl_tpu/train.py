@@ -172,13 +172,23 @@ def _build_rollout(cfg: RunConfig, mcfg, params, tokenizer, cleanup: list):
         from polyrl_tpu.models import lora as lora_mod
 
         template = lora_mod.adapter_template(mcfg, cfg.actor.lora_rank)
+    transfer_fault = None
+    if cfg.transfer.fault_injection.enabled:
+        # transfer-plane chaos: frame corruption / stream stalls /
+        # control-channel kills on the weight-push fabric
+        from polyrl_tpu.rollout.faults import TransferFaultInjector
+
+        transfer_fault = TransferFaultInjector(cfg.transfer.fault_injection)
+        log.warning("transfer fault injection ENABLED: %s",
+                    cfg.transfer.fault_injection)
     iface = TransferInterface(
         template, manager_client=mgr,
         num_streams=cfg.rollout.transfer_streams,
         advertise_host=cfg.rollout.advertise_host,
         sender_groups=cfg.rollout.sender_groups,
         sender_nic_cidr=cfg.rollout.sender_nic_cidr,
-        groups_per_sender=cfg.rollout.groups_per_sender)
+        groups_per_sender=cfg.rollout.groups_per_sender,
+        cfg=cfg.transfer, fault=transfer_fault)
     cleanup.append(iface.close)
 
     local_server = None
@@ -220,6 +230,13 @@ def _build_rollout(cfg: RunConfig, mcfg, params, tokenizer, cleanup: list):
 
     pool = PoolManager(mgr, cfg.rollout.pool)
     cleanup.append(pool.close)
+    # weight-fabric supervision loop closure (ARCHITECTURE.md
+    # "Weight-fabric fault tolerance"): a receiver that exhausts its push
+    # retry budget is drained + deregistered by the fleet control plane,
+    # and the sender's per-engine sync health rides the /statusz pool
+    # section's engine rows
+    iface.set_laggard_callback(pool.escalate_laggard)
+    pool.transfer_health_fn = iface.sync_health
     return RemoteRollout(mgr, transfer=iface, local_server=local_server,
                          pad_token_id=pad,
                          resume_budget=cfg.rollout.resume_budget,

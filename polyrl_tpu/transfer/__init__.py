@@ -9,7 +9,7 @@ Layers (SURVEY §3.3):
                    is a ``device_put`` reshard
 """
 
-from .agents import ReceiverAgent, SenderAgent, SenderGroup
+from .agents import ReceiverAgent, SenderAgent, SenderGroup, TransferConfig
 from .interface import TransferInterface, colocated_update
 from .nic import filter_ips_by_cidr, get_node_ips, pick_sender_ips
 from .layout import (
@@ -28,6 +28,7 @@ __all__ = [
     "SenderAgent",
     "SenderGroup",
     "TcpTransferEngine",
+    "TransferConfig",
     "TransferInterface",
     "alloc_buffer",
     "build_layout",

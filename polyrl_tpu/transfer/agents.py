@@ -14,9 +14,22 @@ Flow (mirrors §3.3 of the survey):
   version on trainer signal AND polls the manager every ``poll_s`` seconds
   (pull model — enables late joiners, sender_agent.py:324-340):
   /get_receive_instances -> stale instances -> parallel TCP fan-out ->
-  per-instance "transfer_done" on the control channel -> async
+  per-instance verify handshake on the control channel -> async
   POST /update_weights so each instance rejoins the pool ASAP
   (sender_agent.py:617-624).
+
+Every push is **verified, resumable, and supervised** (ARCHITECTURE.md
+"Weight-fabric fault tolerance"): after the wire, the sender ships the
+round's frame manifest (per-range CRC32 digests) on the control channel;
+the receiver checks coverage + digests against its landed buffer and only
+a verified round installs the version. A ``verify_failed`` answer carries
+the failed ranges, and the retry re-pushes ONLY those (the receiver's
+coverage ledger survives into the resume round). Each attempt runs under a
+bandwidth-keyed deadline (``bytes / min_bandwidth_mbps + slack`` instead
+of the old flat 600 s / 3600 s), retries ride a jittered exponential
+backoff up to ``retry_budget``, and budget exhaustion escalates the
+instance to the laggard callback (``PoolManager.escalate_laggard`` drains
++ deregisters it — dead capacity stops being re-pushed every poll).
 """
 
 from __future__ import annotations
@@ -25,6 +38,7 @@ import contextlib
 import json
 import logging
 import queue
+import random
 import socket
 import threading
 import time
@@ -34,11 +48,51 @@ from dataclasses import dataclass, field
 import numpy as np
 
 from polyrl_tpu import obs
+from polyrl_tpu.rollout.faults import TransferFaultConfig
 
 from .layout import ParamLayout, alloc_buffer
 from .tcp_engine import ReceiverSockets, TcpTransferEngine
 
 log = logging.getLogger(__name__)
+
+
+@dataclass
+class TransferConfig:
+    """``transfer.*`` config: supervision knobs for the weight-push fabric
+    (README "Weight-fabric fault tolerance" knob blurb). The previously
+    hardcoded flat timeouts (600 s serial / 3600 s streamed) survive only
+    as CAPS — the operative per-attempt deadline is bandwidth-keyed."""
+    # minimum acceptable effective push bandwidth, MB/s: an attempt's
+    # deadline is bytes / (min_bandwidth_mbps * 1e6) + slack, capped below
+    min_bandwidth_mbps: float = 50.0
+    # deadline slack: fixed per-attempt overhead allowance (connection
+    # setup, receiver arming, verify hand-off). Streamed rounds gate the
+    # wire behind the in-place pack, so they get the larger slack.
+    deadline_slack_s: float = 30.0
+    stream_slack_s: float = 120.0
+    # hard caps on any single attempt (the old flat timeouts)
+    push_timeout_s: float = 600.0
+    stream_push_timeout_s: float = 3600.0
+    # prepare -> ready control handshake budget
+    prepare_timeout_s: float = 60.0
+    # integrity: CRC32 frame trailers are always on the wire; verify=False
+    # skips the manifest handshake and installs on bare completion (the
+    # pre-verification trusting path, kept as an escape hatch)
+    verify: bool = True
+    # per-push-call retry budget (attempts = retry_budget + 1) and the
+    # jittered exponential backoff between attempts
+    retry_budget: int = 2
+    backoff_base_s: float = 0.5
+    backoff_max_s: float = 10.0
+    # transfer-plane chaos (rollout/faults.py TransferFaultInjector)
+    fault_injection: TransferFaultConfig = field(
+        default_factory=TransferFaultConfig)
+
+    def push_deadline_s(self, nbytes: int, streamed: bool) -> float:
+        cap = self.stream_push_timeout_s if streamed else self.push_timeout_s
+        slack = self.stream_slack_s if streamed else self.deadline_slack_s
+        bw = max(self.min_bandwidth_mbps, 1e-6) * 1e6
+        return min(cap, nbytes / bw + slack)
 
 
 def _send_json(sock: socket.socket, obj: dict) -> None:
@@ -81,7 +135,9 @@ class ReceiverAgent:
 
     def __init__(self, layout: ParamLayout, instance_endpoint: str,
                  sender_endpoint: str, num_streams: int = 4,
-                 listen_host: str = "0.0.0.0", advertise_host: str | None = None):
+                 listen_host: str = "0.0.0.0", advertise_host: str | None = None,
+                 reconnect_backoff_s: float = 0.2,
+                 reconnect_backoff_max_s: float = 10.0):
         self.layout = layout
         self.buffer = alloc_buffer(layout)
         self.instance_endpoint = instance_endpoint
@@ -90,6 +146,15 @@ class ReceiverAgent:
         self.advertise_host = advertise_host or "127.0.0.1"
         self.version = -1
         self.error: str | None = None
+        # sync-health telemetry (server_info "transfer_*" flat keys via
+        # health(): a flapping control channel, rejected rounds, and the
+        # resume traffic are all visible per engine)
+        self.control_reconnects = 0
+        self.verify_failures = 0   # rounds answered verify_failed
+        self.rounds_verified = 0
+        self.resumed_bytes = 0     # bytes landed via partial re-pushes
+        self._reconnect_backoff_s = reconnect_backoff_s
+        self._reconnect_backoff_max_s = reconnect_backoff_max_s
         self._armed_version = -1  # version of the round currently landing
         # held around every on_tensor emission batch (and the completion
         # tail): the prepare handler takes it before arming the NEXT round,
@@ -105,12 +170,12 @@ class ReceiverAgent:
         self._thread.start()
 
     def _run(self) -> None:
-        backoff = 0.2
+        backoff = self._reconnect_backoff_s
         while not self._stop.is_set():
             try:
                 with socket.create_connection(
                         (self.sender_host, self.sender_port), timeout=30.0) as s:
-                    backoff = 0.2
+                    backoff = self._reconnect_backoff_s
                     _send_json(s, {
                         "cmd": "register",
                         "instance": self.instance_endpoint,
@@ -128,14 +193,37 @@ class ReceiverAgent:
                             # install: its buffer reads must finish before
                             # this round's bytes land over them (sender
                             # retries if "ready" is delayed past its gate)
+                            resume = msg.get("resume") or None
                             with self._install_lock:
                                 with self._version_cv:
                                     self._armed_version = int(
                                         msg.get("version", -1))
-                                self.sockets.arm(int(msg["round"]))
+                                self.sockets.arm(
+                                    int(msg["round"]),
+                                    reset=resume is None,
+                                    clear=[(int(o), int(ln))
+                                           for o, ln in resume]
+                                    if resume else None)
                             _send_json(s, {"event": "ready",
                                            "instance": self.instance_endpoint})
+                        elif msg.get("event") == "verify":
+                            # verified install: coverage + manifest digests
+                            # must check out against the landed buffer
+                            # BEFORE the version installs; a failure
+                            # answers the ranges the sender must re-push
+                            ok, missing, detail = self._verify_round(msg)
+                            _send_json(s, {
+                                "event": "verify_result",
+                                "instance": self.instance_endpoint,
+                                "round": int(msg.get("round", -1)),
+                                "version": int(msg.get("version", -1)),
+                                "ok": ok,
+                                "missing": [[o, ln] for o, ln in missing],
+                                "error": detail,
+                            })
                         elif msg.get("event") == "transfer_done":
+                            # trusting path (transfer.verify=false) and the
+                            # sender's best-effort failure notification
                             if msg.get("status") != "success":
                                 log.error("transfer failed: %s", msg)
                                 continue
@@ -153,9 +241,64 @@ class ReceiverAgent:
             except (OSError, ConnectionError) as exc:
                 if self._stop.is_set():
                     return
-                log.warning("receiver control reconnect (%s)", exc)
-                time.sleep(backoff)
-                backoff = min(backoff * 2, 5.0)
+                # capped + jittered: a fleet of receivers losing one sender
+                # must not reconnect in lockstep, and a dead sender must
+                # not be hammered at 5 Hz forever
+                self.control_reconnects += 1
+                sleep = backoff * (0.5 + random.random())
+                log.warning("receiver control reconnect #%d in %.2fs (%s)",
+                            self.control_reconnects, sleep, exc)
+                self._stop.wait(sleep)
+                backoff = min(backoff * 2, self._reconnect_backoff_max_s)
+
+    def _verify_round(self, msg: dict) -> tuple[bool, list, str]:
+        """The receiver's side of the verify handshake: wait for the armed
+        round's streams to terminate, then check the sender's manifest
+        (range digests) AND full-buffer coverage against the ledger. Only
+        a clean round installs the version — a corrupt or torn round is
+        rejected *without* installing, and the answer carries exactly the
+        ranges the sender must re-push."""
+        rnd = int(msg.get("round", -1))
+        version = int(msg.get("version", -1))
+        manifest = [(int(o), int(ln), int(c))
+                    for o, ln, c in msg.get("manifest") or []]
+        wait_s = float(msg.get("wait_s", 30.0))
+        if self.sockets._round != rnd:
+            return False, [], (f"round {rnd} superseded by "
+                               f"{self.sockets._round}")
+        resume = self.sockets.resume_round
+        # best-effort completion wait: a dead stream just leaves gaps,
+        # which the ledger check below turns into resumable ranges
+        self.sockets.wait_done(timeout=wait_s)
+        missing = self.sockets.verify_ranges(manifest)
+        if not missing:
+            # belt and braces beyond the manifest: the union of verified
+            # manifests must cover the whole buffer (gap detection)
+            missing = self.sockets.gaps(int(self.buffer.nbytes))
+        if missing:
+            self.verify_failures += 1
+            return False, missing, f"{len(missing)} ranges failed verify"
+        if resume:
+            self.resumed_bytes += sum(ln for _, ln, _ in manifest)
+        self.rounds_verified += 1
+        with self._version_cv:
+            if version > self.version:
+                self.version = version
+            self._version_cv.notify_all()
+        return True, [], ""
+
+    def health(self) -> dict[str, int]:
+        """Flat ``transfer_*`` sync-health keys for the rollout server's
+        ``server_info`` (→ /statusz gauges): is this engine's receiver
+        flapping, rejecting rounds, or riding resume traffic?"""
+        return {
+            "transfer_control_reconnects": int(self.control_reconnects),
+            "transfer_crc_frame_failures": int(self.sockets.crc_failures),
+            "transfer_verify_failures": int(self.verify_failures),
+            "transfer_rounds_verified": int(self.rounds_verified),
+            "transfer_resumed_bytes": int(self.resumed_bytes),
+            "transfer_weight_version": int(self.version),
+        }
 
     def wait_for_version(self, version: int, timeout: float = 600.0,
                          on_tensor=None) -> int:
@@ -320,6 +463,10 @@ class _Registration:
     sock: socket.socket
     lock: threading.Lock = field(default_factory=threading.Lock)
     ready: threading.Event = field(default_factory=threading.Event)
+    # verify handshake response slot: _handle_conn parks the receiver's
+    # verify_result here and sets the event; _push_one round-checks it
+    verify_evt: threading.Event = field(default_factory=threading.Event)
+    verify_msg: dict | None = None
     pushed_version: int = -1
 
 
@@ -331,19 +478,38 @@ class SenderAgent:
     def __init__(self, buffer: np.ndarray, manager_client=None,
                  listen_host: str = "0.0.0.0", num_streams: int = 4,
                  poll_s: float = 1.0, advertise_host: str | None = None,
-                 bind_host: str | None = None):
+                 bind_host: str | None = None,
+                 cfg: TransferConfig | None = None, fault=None):
         self.buffer = buffer
         self.manager = manager_client
+        self.cfg = cfg or TransferConfig()
+        # transfer-plane chaos injector (rollout/faults.py); interruptible
+        # on stop() so a sleeping stall never pins teardown
+        self.fault = fault
         # bind_host pins this sender's outbound data streams to one NIC
-        # (SenderGroup runs one agent per interface for aggregate bandwidth)
+        # (SenderGroup runs one agent per interface for aggregate
+        # bandwidth). Worker headroom beyond num_streams: multi-instance
+        # fan-out shares this pool, and one instance's stalled stream must
+        # not head-of-line-block another instance's sends into a spurious
+        # deadline miss.
         self.engine = TcpTransferEngine(num_streams=num_streams,
+                                        workers=max(num_streams * 4, 8),
                                         bind_host=bind_host)
         self._notify_pool = ThreadPoolExecutor(max_workers=4)
+        # per-instance push fan-out: an executor (not bare threads) so
+        # teardown mid-push can cancel queued pushes (cancel_futures) and
+        # the conftest thread-leak guard sees pool workers, not strays
+        self._push_pool = ThreadPoolExecutor(max_workers=16)
         self.poll_s = poll_s
         self.reg_wait_s = 10.0
         self.version = -1
         self._regs: dict[str, _Registration] = {}
         self._regs_lock = threading.Lock()
+        # supervision ledgers (under _regs_lock): per-instance sync health
+        # for /statusz, and the escalated-instances blocklist that stops a
+        # laggard from being re-pushed at the same version every poll
+        self._health: dict[str, dict] = {}
+        self._escalated: dict[str, int] = {}  # instance -> version
         self._cmds: queue.Queue = queue.Queue()
         self._stop = threading.Event()
         # (buffer, version) pairing protocol: a push round snapshots both
@@ -356,11 +522,19 @@ class SenderAgent:
         self._packing = False
         self._watermark = None  # streaming push: gates sends behind the pack
         self._poisoned_version = -1  # streamed pack died: never push this
-        # serial rounds start the clock after the pack; a streamed round's
-        # wire trails the pack, so it gets the combined budget
-        self.push_timeout_s = 600.0
-        self.stream_push_timeout_s = 3600.0
         self._round_counter = 0  # unique per push attempt (stale-stream guard)
+        # laggard escalation hook: called as cb(instance, reason) when an
+        # instance exhausts its retry budget (train.py wires
+        # PoolManager.escalate_laggard — drain + deregister)
+        self.laggard_cb = None
+        # supervision telemetry (cumulative; TransferInterface.counters()
+        # folds these into transfer/* step-record gauges)
+        self.push_failures = 0       # failed push attempts (any cause)
+        self.push_retries = 0        # attempts re-run after a failure
+        self.verify_failures = 0     # attempts rejected by receiver verify
+        self.resumed_bytes = 0       # bytes re-pushed via partial resumes
+        self.rounds_verified = 0     # verified installs
+        self.laggard_escalations = 0
         # elastic-pool telemetry: full pushes to instances this sender had
         # never pushed before — the scale-up catch-up path (a late joiner
         # registers, the idle poll finds it stale, it gets the CURRENT
@@ -384,14 +558,28 @@ class SenderAgent:
 
     def stop(self) -> None:
         self._stop.set()
+        if self.fault is not None:
+            # wake any injected stall so teardown never waits it out
+            self.fault.stop()
         try:
             self._server.close()
         except OSError:
             pass
+        # break registered control channels: blocked handshake waits and
+        # the receivers' readers return immediately instead of timing out
+        with self._regs_lock:
+            regs = list(self._regs.values())
+        for reg in regs:
+            try:
+                reg.sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
         self.engine.shutdown()
+        self._push_pool.shutdown(wait=False, cancel_futures=True)
         self._notify_pool.shutdown(wait=False, cancel_futures=True)
         for t in self._threads:
             t.join(timeout=5.0)
+        self._threads.clear()
 
     # -- trainer API --------------------------------------------------------
 
@@ -494,11 +682,18 @@ class SenderAgent:
                                         ports=list(msg["ports"]), sock=conn)
                     with self._regs_lock:
                         self._regs[reg.instance] = reg
+                        # a fresh registration clears any standing laggard
+                        # escalation: a restarted/recovered receiver gets a
+                        # fresh retry budget
+                        self._escalated.pop(reg.instance, None)
                     _send_json(conn, {"event": "registered",
                                       "version": self.version})
                     log.info("receiver registered: %s", reg.instance)
                 elif msg.get("event") == "ready" and reg is not None:
                     reg.ready.set()
+                elif msg.get("event") == "verify_result" and reg is not None:
+                    reg.verify_msg = msg
+                    reg.verify_evt.set()
         except (ConnectionError, OSError):
             pass
         finally:
@@ -527,11 +722,20 @@ class SenderAgent:
     def _stale_instances(self, version: int) -> list[str]:
         if self.manager is None:
             with self._regs_lock:
-                return [i for i, r in self._regs.items()
-                        if r.pushed_version < version]
-        resp = self.manager.get_receive_instances(self.endpoint)
-        return [i["endpoint"] if isinstance(i, dict) else i
-                for i in resp.get("instances", [])]
+                stale = [i for i, r in self._regs.items()
+                         if r.pushed_version < version]
+        else:
+            resp = self.manager.get_receive_instances(self.endpoint)
+            stale = [i["endpoint"] if isinstance(i, dict) else i
+                     for i in resp.get("instances", [])]
+        # escalated laggards are dead capacity at this version: the
+        # laggard callback drains+deregisters them, but until that lands
+        # (and forever in manager-less mode) the poll must not re-push
+        # them every poll_s. A NEW version or a fresh registration clears
+        # the blocklist entry.
+        with self._regs_lock:
+            esc = dict(self._escalated)
+        return [i for i in stale if esc.get(i) != version]
 
     def _wait_registration(self, instance: str) -> _Registration | None:
         """Bootstrap race: the manager may hand us an instance whose receiver
@@ -563,14 +767,11 @@ class SenderAgent:
             stale = self._stale_instances(version)
             if not stale:
                 return
-            threads = [threading.Thread(
-                           target=self._push_instance,
-                           args=(i, version, buffer, watermark), daemon=True)
+            futures = [self._push_pool.submit(self._push_instance, i,
+                                              version, buffer, watermark)
                        for i in stale]
-            for t in threads:
-                t.start()
-            for t in threads:
-                t.join()
+            for f in futures:
+                f.result()
         finally:
             with self._cv:
                 self._inflight -= 1
@@ -588,60 +789,249 @@ class SenderAgent:
                 # side times the CAS out on its own
                 pass
 
+    def _note_health(self, instance: str, inc: dict | None = None,
+                     **set_kv) -> None:
+        """Fold one event into the per-instance sync-health ledger (the
+        ``transfer`` block of the /statusz pool section)."""
+        with self._regs_lock:
+            h = self._health.setdefault(instance, {
+                "pushed_version": -1, "push_failures": 0,
+                "verify_failures": 0, "resumed_bytes": 0,
+                "last_push_s": None, "escalated": False, "last_error": ""})
+            for k, v in (inc or {}).items():
+                h[k] = h.get(k, 0) + v
+            h.update(set_kv)
+
+    def sync_health(self) -> dict[str, dict]:
+        """Per-instance push health: ``{endpoint: {pushed_version,
+        push_failures, verify_failures, resumed_bytes, last_push_s,
+        escalated, registered, last_error}}`` — PoolManager merges this
+        into the /statusz pool section's engine rows."""
+        with self._regs_lock:
+            regs = set(self._regs)
+            esc = set(self._escalated)
+            out = {i: dict(h) for i, h in self._health.items()}
+        for i in regs:
+            out.setdefault(i, {})
+        for i, h in out.items():
+            h["registered"] = i in regs
+            h["escalated"] = bool(h.get("escalated")) or i in esc
+        return out
+
+    def counters(self) -> dict[str, float]:
+        """Cumulative ``transfer/*`` supervision gauges for step records."""
+        return {
+            "transfer/push_failures": float(self.push_failures),
+            "transfer/push_retries": float(self.push_retries),
+            "transfer/verify_failures": float(self.verify_failures),
+            "transfer/resumed_bytes": float(self.resumed_bytes),
+            "transfer/rounds_verified": float(self.rounds_verified),
+            "transfer/laggard_escalations": float(self.laggard_escalations),
+            "transfer/catchup_pushes": float(self.catchup_pushes),
+        }
+
+    def _escalate(self, instance: str, version: int, err: str) -> None:
+        """Retry budget exhausted: the instance is a laggard — dead
+        capacity the bootstrap gate already holds out of routing. Stop
+        re-pushing it (same-version blocklist) and hand it to the fleet
+        control plane (PoolManager.escalate_laggard drains + deregisters).
+        Without a callback the manager CAS is cleared so a FUTURE version
+        may retry — but the blocklist stops the every-``poll_s`` re-push
+        of this one."""
+        self.laggard_escalations += 1
+        self._note_health(instance, escalated=True, last_error=err)
+        log.error("weight push to %s exhausted its retry budget at v%d "
+                  "(%s); escalating laggard", instance, version, err)
+        with self._regs_lock:
+            self._escalated[instance] = version
+        cb = self.laggard_cb
+        if cb is not None:
+            try:
+                # off the push thread: the callback drains + deregisters
+                # over HTTP and must not block the round's fan-out join
+                self._notify_pool.submit(cb, instance, err)
+            except RuntimeError:
+                pass  # agent closing
+        else:
+            self._abort_on_manager(instance)
+
     def _push_instance(self, instance: str, version: int,
                        buffer: np.ndarray, watermark=None) -> None:
-        reg = self._wait_registration(instance)
-        if reg is None:
-            log.error("no receiver registration for %s; skipping push", instance)
-            self._abort_on_manager(instance)
-            return
-        self._push_one(reg, version, buffer, watermark)
+        """Supervised push: attempts = 1 + retry_budget, each under the
+        bandwidth-keyed deadline, separated by jittered exponential
+        backoff. A ``verify_failed`` attempt resumes — the next attempt
+        re-pushes ONLY the failed ranges; a transport failure re-pushes in
+        full. Budget exhaustion escalates the laggard."""
+        cfg = self.cfg
+        missing: list[tuple[int, int]] | None = None
+        registered_once = False
+        last_err = ""
+        attempt = 0
+        while not self._stop.is_set():
+            reg = self._wait_registration(instance)
+            if reg is None:
+                if not registered_once:
+                    # bootstrap race, not a laggard: the manager handed us
+                    # an instance whose receiver never connected. Clear
+                    # the CAS so a later poll retries once it registers.
+                    log.error("no receiver registration for %s; "
+                              "skipping push", instance)
+                    self._abort_on_manager(instance)
+                    return
+                last_err = "receiver registration lost"
+                missing = None
+            else:
+                registered_once = True
+                try:
+                    missing = self._push_one(reg, version, buffer,
+                                             watermark, ranges=missing)
+                    if not missing:
+                        return  # verified + installed
+                    self.verify_failures += 1
+                    self._note_health(instance, inc={"verify_failures": 1})
+                    last_err = f"verify_failed ({len(missing)} ranges)"
+                    log.warning("push v%d to %s rejected by verify: %s",
+                                version, instance, last_err)
+                except Exception as exc:  # noqa: BLE001 — retried below
+                    last_err = f"{type(exc).__name__}: {exc}"
+                    missing = None  # transport failure: full re-push
+                    self._notify_transfer_failed(reg, version, last_err)
+                    log.error("push v%d to %s failed: %s", version,
+                              instance, last_err)
+            self.push_failures += 1
+            self._note_health(instance, inc={"push_failures": 1},
+                              last_error=last_err)
+            attempt += 1
+            if attempt > cfg.retry_budget:
+                self._escalate(instance, version, last_err)
+                return
+            self.push_retries += 1
+            sleep = min(cfg.backoff_base_s * (2 ** (attempt - 1)),
+                        cfg.backoff_max_s) * (0.5 + random.random())
+            if self._stop.wait(sleep):
+                return
+
+    @staticmethod
+    def _notify_transfer_failed(reg: _Registration, version: int,
+                                err: str) -> None:
+        """Best-effort failure notice so the receiver's log shows cause."""
+        try:
+            _send_json(reg.sock, {"event": "transfer_done",
+                                  "status": "failure", "version": version,
+                                  "error": err})
+        except OSError:
+            pass
 
     def _push_one(self, reg: _Registration, version: int,
-                  buffer: np.ndarray, watermark=None) -> None:
+                  buffer: np.ndarray, watermark=None,
+                  ranges: list[tuple[int, int]] | None = None,
+                  ) -> list[tuple[int, int]]:
+        """One push attempt: prepare/arm, wire under the bandwidth-keyed
+        deadline, then the verify handshake. Returns [] on a verified
+        install, or the ranges the receiver reported failed (the caller
+        resumes with exactly those); raises on transport failure."""
+        cfg = self.cfg
         with self._cv:
             self._round_counter += 1
             round_id = self._round_counter
-        try:
-            with reg.lock:
-                reg.ready.clear()
-                _send_json(reg.sock, {"event": "prepare", "version": version,
-                                      "round": round_id})
-                if not reg.ready.wait(timeout=60.0):
-                    raise TimeoutError("receiver did not arm listeners")
-                t0 = time.monotonic()
-                batch = self.engine.transfer_submit_write(
-                    reg.host, reg.ports, buffer, round_id=round_id,
-                    watermark=watermark)
-                batch.result(timeout=self.push_timeout_s if watermark is None
-                             else self.stream_push_timeout_s)
-                dt = time.monotonic() - t0
+        push_bytes = (sum(ln for _, ln in ranges) if ranges
+                      else buffer.nbytes)
+        deadline = cfg.push_deadline_s(push_bytes,
+                                       streamed=watermark is not None)
+        with reg.lock:
+            reg.ready.clear()
+            reg.verify_evt.clear()
+            reg.verify_msg = None
+            prep = {"event": "prepare", "version": version,
+                    "round": round_id}
+            if ranges:
+                # resume: the receiver keeps the superseded round's
+                # coverage and clears only these ranges
+                prep["resume"] = [[o, ln] for o, ln in ranges]
+            _send_json(reg.sock, prep)
+            if not reg.ready.wait(timeout=cfg.prepare_timeout_s):
+                raise TimeoutError("receiver did not arm listeners")
+            t0 = time.monotonic()
+            if self.fault is not None:
+                self.fault.note_attempt(reg.instance)
+            batch = self.engine.transfer_submit_write(
+                reg.host, reg.ports, buffer, round_id=round_id,
+                watermark=watermark, ranges=ranges,
+                gate_timeout_s=deadline + 1.0,
+                fault=self.fault, instance=reg.instance)
+            manifest = batch.result(timeout=deadline)
+            if (self.fault is not None
+                    and self.fault.take_control_kill(reg.instance)):
+                # chaos: control-plane death right before the verify
+                # handshake — the receiver must reconnect, the retry
+                # must re-push the round
+                try:
+                    reg.sock.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
+            if cfg.verify:
+                _send_json(reg.sock, {
+                    "event": "verify", "round": round_id,
+                    "version": version,
+                    "manifest": [[o, ln, c] for o, ln, c in manifest],
+                    # receiver-side completion wait for straggler frames
+                    # still in the kernel after our futures resolved
+                    "wait_s": min(30.0, deadline),
+                })
+                evt_deadline = time.monotonic() + deadline + 30.0
+                while not reg.verify_evt.wait(timeout=0.2):
+                    if self._stop.is_set():
+                        raise ConnectionError("sender stopping")
+                    if time.monotonic() > evt_deadline:
+                        raise TimeoutError(
+                            "receiver never answered verify")
+                vr = reg.verify_msg or {}
+                if int(vr.get("round", -1)) != round_id:
+                    raise ConnectionError("verify result round mismatch")
+                if vr.get("ok"):
+                    missing = []
+                else:
+                    missing = [(int(o), int(ln))
+                               for o, ln in vr.get("missing") or []]
+                    if not missing:
+                        raise ConnectionError(
+                            "verify failed without resumable ranges: "
+                            f"{vr.get('error')}")
+            else:
+                # trusting path: bare completion installs the version
                 _send_json(reg.sock, {"event": "transfer_done",
-                                      "status": "success", "version": version})
-            if reg.pushed_version < 0:
-                self.catchup_pushes += 1
-            reg.pushed_version = version
-            mbps = buffer.nbytes / max(dt, 1e-9) / 1e6
-            # per-instance push duration distribution: one slow receiver
-            # (bad NIC, busy engine) shows up as a p99/max outlier that the
-            # fleet-wide MB/s mean would average away
-            obs.observe("transfer/push_s", dt)
-            log.info("pushed v%d to %s: %.0f MB/s", version, reg.instance, mbps)
-            if self.manager is not None:
-                # async notify so the instance rejoins the pool without the
-                # trainer's next pack blocking on the engine's weight load
-                # (sender_agent.py:617-624)
-                self._notify_pool.submit(
-                    self.manager.update_weights, [reg.instance], version)
-        except Exception as exc:  # noqa: BLE001
-            log.error("push to %s failed: %s", reg.instance, exc)
-            self._abort_on_manager(reg.instance)
-            try:
-                _send_json(reg.sock, {"event": "transfer_done",
-                                      "status": "failure", "version": version,
-                                      "error": str(exc)})
-            except OSError:
-                pass
+                                      "status": "success",
+                                      "version": version})
+                missing = []
+            dt = time.monotonic() - t0
+        if missing:
+            return missing
+        if ranges:
+            resumed = sum(ln for _, ln in ranges)
+            self.resumed_bytes += resumed
+            self._note_health(reg.instance, inc={"resumed_bytes": resumed})
+        self.rounds_verified += 1
+        if reg.pushed_version < 0:
+            self.catchup_pushes += 1
+        reg.pushed_version = version
+        with self._regs_lock:
+            self._escalated.pop(reg.instance, None)
+        self._note_health(reg.instance, pushed_version=version,
+                          last_push_s=round(dt, 4), escalated=False)
+        mbps = push_bytes / max(dt, 1e-9) / 1e6
+        # per-instance push duration distribution: one slow receiver
+        # (bad NIC, busy engine) shows up as a p99/max outlier that the
+        # fleet-wide MB/s mean would average away
+        obs.observe("transfer/push_s", dt)
+        log.info("pushed v%d to %s: %.0f MB/s%s", version, reg.instance,
+                 mbps, " (resume)" if ranges else "")
+        if self.manager is not None:
+            # async notify so the instance rejoins the pool without the
+            # trainer's next pack blocking on the engine's weight load
+            # (sender_agent.py:617-624)
+            self._notify_pool.submit(
+                self.manager.update_weights, [reg.instance], version)
+        return []
 
 
 class SenderGroup:
@@ -664,16 +1054,43 @@ class SenderGroup:
 
     def __init__(self, buffer: np.ndarray, sender_ips: list[str],
                  manager_client=None, num_streams: int = 4,
-                 poll_s: float = 1.0, listen_host: str = "0.0.0.0"):
+                 poll_s: float = 1.0, listen_host: str = "0.0.0.0",
+                 cfg: TransferConfig | None = None, fault=None):
         if not sender_ips:
             raise ValueError("SenderGroup needs at least one sender IP")
         self.manager = manager_client
         self.senders = [
             SenderAgent(buffer, manager_client=manager_client,
                         listen_host=listen_host, num_streams=num_streams,
-                        poll_s=poll_s, advertise_host=ip, bind_host=ip)
+                        poll_s=poll_s, advertise_host=ip, bind_host=ip,
+                        cfg=cfg, fault=fault)
             for ip in sender_ips
         ]
+
+    @property
+    def laggard_cb(self):
+        return self.senders[0].laggard_cb
+
+    @laggard_cb.setter
+    def laggard_cb(self, cb) -> None:
+        for s in self.senders:
+            s.laggard_cb = cb
+
+    def counters(self) -> dict[str, float]:
+        """Fleet-summed ``transfer/*`` gauges across the per-NIC agents."""
+        out: dict[str, float] = {}
+        for s in self.senders:
+            for k, v in s.counters().items():
+                out[k] = out.get(k, 0.0) + v
+        return out
+
+    def sync_health(self) -> dict[str, dict]:
+        """Per-instance health; the manager partitions instances across
+        the groups, so the per-agent dicts are disjoint by construction."""
+        out: dict[str, dict] = {}
+        for s in self.senders:
+            out.update(s.sync_health())
+        return out
 
     @property
     def endpoints(self) -> list[str]:

@@ -26,7 +26,7 @@ import numpy as np
 
 from polyrl_tpu import obs
 
-from .agents import SenderAgent, SenderGroup
+from .agents import SenderAgent, SenderGroup, TransferConfig
 from .layout import ParamLayout, alloc_buffer, build_layout, pack_params
 from .nic import pick_sender_ips
 
@@ -38,8 +38,13 @@ class TransferInterface:
                  num_streams: int = 4, poll_s: float = 1.0,
                  advertise_host: str | None = None,
                  sender_groups: int = 1, sender_nic_cidr: str = "",
-                 groups_per_sender: int = 1):
+                 groups_per_sender: int = 1,
+                 cfg: TransferConfig | None = None, fault=None):
         self.layout: ParamLayout = build_layout(params_template)
+        # supervision knobs (config ``transfer.*``) + optional transfer-
+        # plane fault injector (rollout/faults.py TransferFaultInjector)
+        self.cfg = cfg or TransferConfig()
+        self.fault = fault
         # serial mode double-buffers: pack into _back while the sender
         # pushes from its front buffer (lazy — the default streamed mode
         # packs in place and never needs the second copy of the weights)
@@ -55,12 +60,14 @@ class TransferInterface:
             ips = pick_sender_ips(sender_groups, sender_nic_cidr)
             self.sender: SenderAgent | SenderGroup = SenderGroup(
                 front, ips, manager_client=manager_client,
-                num_streams=num_streams, poll_s=poll_s)
+                num_streams=num_streams, poll_s=poll_s,
+                cfg=self.cfg, fault=fault)
             endpoints = self.sender.endpoints
         else:
             self.sender = SenderAgent(front, manager_client=manager_client,
                                       num_streams=num_streams, poll_s=poll_s,
-                                      advertise_host=advertise_host)
+                                      advertise_host=advertise_host,
+                                      cfg=self.cfg, fault=fault)
             endpoints = [self.sender.endpoint]
         self.manager = manager_client
         # async push state: at most ONE background round in flight; a new
@@ -206,6 +213,31 @@ class TransferInterface:
             err, self._push_err = self._push_err, None
             raise RuntimeError("async weight push failed") from err
 
+    def set_laggard_callback(self, cb) -> None:
+        """Wire the retry-budget-exhaustion escalation: ``cb(instance,
+        reason)`` — train.py passes ``PoolManager.escalate_laggard`` so a
+        dead receiver is drained + deregistered instead of re-pushed
+        every poll forever."""
+        self.sender.laggard_cb = cb
+
+    def counters(self) -> dict[str, float]:
+        """Cumulative ``transfer/*`` supervision gauges + config echo for
+        step records (RemoteRollout.fault_counters merges these, so they
+        ride every step record and the FlightRecorder's
+        ``transfer/push_failures`` watch)."""
+        out = dict(self.sender.counters())
+        out["transfer/min_bandwidth_mbps"] = float(
+            self.cfg.min_bandwidth_mbps)
+        out["transfer/retry_budget"] = float(self.cfg.retry_budget)
+        if self.fault is not None:
+            out.update(self.fault.counters())
+        return out
+
+    def sync_health(self) -> dict[str, dict]:
+        """Per-instance push health (``PoolManager.transfer_health_fn``
+        feeds the /statusz pool section's per-engine ``transfer`` block)."""
+        return self.sender.sync_health()
+
     def close(self) -> None:
         try:
             # a push mid-flight holds the sender's buffer/round state;
@@ -213,6 +245,9 @@ class TransferInterface:
             self.wait_pushed(timeout=30.0)
         except Exception:  # noqa: BLE001 — teardown must proceed
             log.exception("async weight push failed during close")
+        # SenderAgent.stop shuts the push/notify executors down with
+        # cancel_futures and joins the accept/event threads, so a teardown
+        # mid-push cannot leak threads past the conftest guard
         self.sender.stop()
 
 

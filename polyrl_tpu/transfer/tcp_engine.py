@@ -6,6 +6,15 @@ per transfer, 16-byte (offset, length) header per stream, receiver
 ``recv_into`` directly into a registered buffer memoryview (zero-copy), and
 an async submit/poll API. Hardware-agnostic — this is the cross-host (DCN)
 path; in-slice weight movement uses ``jax.device_put`` resharding instead.
+
+Integrity (ARCHITECTURE.md "Weight-fabric fault tolerance"): every frame's
+payload is followed by a 4-byte CRC32 trailer computed over the TRUE source
+bytes. The receiver verifies it incrementally as bytes land; a mismatching
+frame is rejected — its bytes are dropped from the coverage ledger so the
+round's control-channel verify step demands a re-push of exactly that
+range. ``transfer_submit_write`` returns the per-frame (offset, length,
+crc) manifest through ``TransferBatch.result`` so the sender can ship it
+on the control channel for the receiver's authoritative whole-round check.
 """
 
 from __future__ import annotations
@@ -14,6 +23,7 @@ import socket
 import struct
 import threading
 import time
+import zlib
 from concurrent.futures import Future, ThreadPoolExecutor
 from dataclasses import dataclass, field
 
@@ -24,6 +34,7 @@ SEND_CHUNK = 64 * 1024 * 1024  # 64 MB send chunks
 # packer (all streams active the whole round), big enough to amortize frames
 STREAM_STRIPE = 16 * 1024 * 1024
 HEADER = struct.Struct("<QQQQ")  # (round_id, offset, length, total_streams)
+FOOTER = struct.Struct("<I")     # per-frame payload CRC32 trailer
 
 
 def _tune(sock: socket.socket) -> None:
@@ -70,8 +81,9 @@ class Watermark:
             self._cv.notify_all()
 
     def wait_until(self, target: int, timeout: float = 3600.0) -> None:
-        # default budget matches the sender's stream_push_timeout_s: the
-        # gate spans pack progress, which shares the combined round clock
+        # default budget matches the sender's streamed-round cap; callers
+        # with a bandwidth-keyed round deadline pass it through so a dead
+        # pack can never pin a sender thread for the full hour
         deadline = time.monotonic() + timeout
         with self._cv:
             while self._value < target and self._failed is None:
@@ -118,6 +130,11 @@ class ReceiverSockets:
         self._conns: dict[int, list] = {}  # round -> live data connections
         self._lock = threading.Lock()
         self._closed = False
+        # integrity ledger: frames whose CRC32 trailer mismatched are
+        # rejected (their bytes dropped from the coverage so the round's
+        # verify step demands a re-push); cumulative counter for telemetry
+        self.crc_failures = 0
+        self._resume = False  # current round re-pushes ranges of the prior
         self.ports: list[int] = []
         for _ in range(num_streams):
             s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
@@ -134,13 +151,25 @@ class ReceiverSockets:
         for t in self._threads:
             t.start()
 
-    def arm(self, round_id: int) -> None:
-        """Begin accepting one transfer round tagged ``round_id``."""
+    def arm(self, round_id: int, reset: bool = True,
+            clear: list[tuple[int, int]] | None = None) -> None:
+        """Begin accepting one transfer round tagged ``round_id``.
+
+        ``reset=False`` arms a RESUME round: the coverage ledger of the
+        superseded round is kept (its landed, CRC-verified bytes stay
+        valid — same version, byte-identical source) and only the
+        ``clear`` ranges about to be re-pushed are dropped, so a partial
+        re-push completes the round instead of restarting it."""
         with self._lock:
             self._round = round_id
             self._completed = 0
             self._expected: int | None = None
-            self._progress = {}
+            if reset:
+                self._progress = {}
+            elif clear:
+                for off, _length in clear:
+                    self._progress.pop(int(off), None)
+            self._resume = not reset
             self._errors.clear()
             self._done.clear()
             # force-close dangling streams from older rounds: their header
@@ -186,16 +215,30 @@ class ReceiverSockets:
                     while True:
                         view = self._mv[offset : offset + length]
                         got = 0
+                        crc = 0
                         while got < length:
                             n = conn.recv_into(view[got:],
                                                min(length - got, SOCK_BUF))
                             if n == 0:
                                 raise ConnectionError(
                                     f"eof at {got}/{length}")
+                            crc = zlib.crc32(view[got:got + n], crc)
                             got += n
                             with self._lock:
                                 if round_id == self._round:
                                     self._progress[offset] = got
+                        want = FOOTER.unpack(
+                            self._recv_exact(conn, FOOTER.size))[0]
+                        if want != crc:
+                            # integrity: reject the frame — its bytes are
+                            # dropped from the coverage ledger so the
+                            # verify step demands a re-push of exactly
+                            # this range. The stream itself stays healthy
+                            # (framing is intact), so later frames land.
+                            with self._lock:
+                                if round_id == self._round:
+                                    self.crc_failures += 1
+                                    self._progress.pop(offset, None)
                         hdr = self._recv_header(conn, first=False)
                         if hdr is None:
                             break  # clean EOF: stream complete
@@ -235,11 +278,81 @@ class ReceiverSockets:
             hdr += chunk
         return HEADER.unpack(hdr)
 
+    @staticmethod
+    def _recv_exact(conn: socket.socket, n: int) -> bytes:
+        buf = b""
+        while len(buf) < n:
+            chunk = conn.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError(
+                    f"eof mid-frame-trailer ({len(buf)}/{n})")
+            buf += chunk
+        return buf
+
     def coverage(self) -> list[tuple[int, int]]:
         """Snapshot of (range_offset, bytes_landed) for the armed round —
         the receive-side watermark an incremental installer polls."""
         with self._lock:
             return sorted(self._progress.items())
+
+    def _merged(self) -> list[list[int]]:
+        """Merged [lo, hi) covered intervals (caller holds ``_lock``)."""
+        merged: list[list[int]] = []
+        for off, got in sorted(self._progress.items()):
+            if got <= 0:
+                continue
+            if merged and off <= merged[-1][1]:
+                merged[-1][1] = max(merged[-1][1], off + got)
+            else:
+                merged.append([off, off + got])
+        return merged
+
+    def gaps(self, total: int) -> list[tuple[int, int]]:
+        """Uncovered (offset, length) holes of [0, total) in the armed
+        round's ledger — what a partial re-push must still deliver."""
+        with self._lock:
+            merged = self._merged()
+        out: list[tuple[int, int]] = []
+        pos = 0
+        for lo, hi in merged:
+            if lo > pos:
+                out.append((pos, lo - pos))
+            pos = max(pos, hi)
+        if pos < total:
+            out.append((pos, total - pos))
+        return out
+
+    def verify_ranges(self, manifest) -> list[tuple[int, int]]:
+        """Manifest entries ``(offset, length, crc32)`` that did NOT land
+        intact: not fully covered by the ledger, or the buffer bytes'
+        recomputed CRC mismatches the sender's digest. This is the
+        receiver's authoritative whole-round check — the per-frame trailer
+        already rejected corrupt frames at land time; this re-derivation
+        from the buffer catches anything that slipped past it (torn
+        writes, a stale stream, a frame the trailer happened to match)."""
+        with self._lock:
+            merged = self._merged()
+        bad: list[tuple[int, int]] = []
+        for off, length, want in manifest:
+            off, length, want = int(off), int(length), int(want)
+            covered = any(lo <= off and off + length <= hi
+                          for lo, hi in merged)
+            if not covered or zlib.crc32(
+                    self._mv[off:off + length]) != want:
+                bad.append((off, length))
+        return bad
+
+    @property
+    def resume_round(self) -> bool:
+        """True while the armed round is a partial re-push."""
+        with self._lock:
+            return self._resume
+
+    def wait_done(self, timeout: float | None = None) -> bool:
+        """Non-raising completion wait: True once every expected stream of
+        the armed round terminated (cleanly or with an error). The verify
+        step reads the ledger either way — a dead stream is just a gap."""
+        return self._done.wait(timeout)
 
     def wait(self, timeout: float | None = None) -> None:
         if not self._done.wait(timeout):
@@ -264,9 +377,14 @@ class TransferBatch:
     def done(self) -> bool:
         return all(f.done() for f in self.futures)
 
-    def result(self, timeout: float | None = None) -> None:
+    def result(self, timeout: float | None = None) -> list[tuple[int, int, int]]:
+        """Wait for every stream; returns the round's frame manifest —
+        ``(offset, length, crc32)`` per frame actually sent — which the
+        sender ships on the control channel for receiver-side verify."""
+        manifest: list[tuple[int, int, int]] = []
         for f in self.futures:
-            f.result(timeout)
+            manifest.extend(f.result(timeout) or [])
+        return manifest
 
 
 class TcpTransferEngine:
@@ -286,32 +404,60 @@ class TcpTransferEngine:
     def _send_ranges(self, host: str, port: int, mv: memoryview,
                      round_id: int, ranges: list[tuple[int, int]],
                      nstreams: int,
-                     watermark: "Watermark | None" = None) -> None:
+                     watermark: "Watermark | None" = None,
+                     gate_timeout_s: float | None = None,
+                     fault=None, instance: str = "",
+                     stream_idx: int = 0) -> list[tuple[int, int, int]]:
         """One stream = one connection carrying a sequence of framed
         (offset, length) ranges; closing the connection at a frame boundary
-        terminates the stream (ReceiverSockets._serve_loop)."""
+        terminates the stream (ReceiverSockets._serve_loop). Each frame's
+        payload is followed by a CRC32 trailer over the TRUE source bytes
+        (computed before any injected wire corruption, so a corrupted
+        payload is detectable). Returns this stream's frame manifest."""
         src = (self.bind_host, 0) if self.bind_host else None
         # smaller chunks under a watermark: the gate advances per packed
         # tensor group, and a 64 MB chunk would add that much latency to
         # every gate crossing
         chunk = SEND_CHUNK if watermark is None else SOCK_BUF
+        manifest: list[tuple[int, int, int]] = []
         with socket.create_connection((host, port), timeout=60.0,
                                       source_address=src) as s:
             _tune(s)
+            if fault is not None:
+                # transfer-plane chaos: a stalled stream blows the round
+                # past its bandwidth-keyed deadline (rollout/faults.py)
+                fault.maybe_stall(instance, stream_idx)
             for offset, length in ranges:
                 s.sendall(HEADER.pack(round_id, offset, length, nstreams))
+                corrupt = (fault is not None
+                           and fault.take_corruption(instance, stream_idx))
                 end = offset + length
                 pos = offset
+                crc = 0
                 while pos < end:
                     nxt = min(pos + chunk, end)
                     if watermark is not None:
-                        watermark.wait_until(nxt)
-                    s.sendall(mv[pos:nxt])
+                        watermark.wait_until(
+                            nxt, timeout=gate_timeout_s or 3600.0)
+                    payload = mv[pos:nxt]
+                    crc = zlib.crc32(payload, crc)  # TRUE bytes, pre-fault
+                    if corrupt:
+                        bad = bytearray(payload)
+                        bad[0] ^= 0xFF
+                        payload = bytes(bad)
+                        corrupt = False  # one flipped chunk is enough
+                    s.sendall(payload)
                     pos = nxt
+                s.sendall(FOOTER.pack(crc))
+                manifest.append((offset, length, crc))
+        return manifest
 
     def transfer_submit_write(self, host: str, ports: list[int], buffer,
                               round_id: int = 0,
                               watermark: "Watermark | None" = None,
+                              ranges: list[tuple[int, int]] | None = None,
+                              gate_timeout_s: float | None = None,
+                              fault=None, instance: str = "",
                               ) -> TransferBatch:
         """Split ``buffer`` across ``ports`` and send concurrently.
 
@@ -320,10 +466,20 @@ class TcpTransferEngine:
         chunks assigned round-robin, so every stream works just behind the
         packer — contiguous ranges would leave stream k idle until the
         watermark crossed its start offset, serializing the round's wire
-        behind pack order (advisor r4)."""
+        behind pack order (advisor r4). Explicit ``ranges`` is the RESUME
+        path: only the given (offset, length) ranges are sent, assigned
+        round-robin across the streams — a post-``verify_failed`` re-push
+        delivers the failed ranges without restarting the round."""
         mv = memoryview(buffer).cast("B")
         batch = TransferBatch()
-        if watermark is None:
+        if ranges is not None:
+            rs = [(int(o), int(ln)) for o, ln in ranges if int(ln) > 0]
+            n_active = min(len(ports), len(rs)) or 1
+            assignments = [c for c in
+                           (rs[i::n_active] for i in range(n_active)) if c]
+            if not assignments:
+                assignments = [[(0, 0)]] if not rs else assignments
+        elif watermark is None:
             assignments = [[r] for r in split_ranges(len(mv), len(ports))]
         else:
             total = len(mv)
@@ -333,10 +489,11 @@ class TcpTransferEngine:
             assignments = [c for c in
                            (chunks[i::n_active] for i in range(n_active))
                            if c]
-        for ranges, port in zip(assignments, ports):
+        for i, (rngs, port) in enumerate(zip(assignments, ports)):
             batch.futures.append(self._pool.submit(
-                self._send_ranges, host, port, mv, round_id, ranges,
-                len(assignments), watermark))
+                self._send_ranges, host, port, mv, round_id, rngs,
+                len(assignments), watermark, gate_timeout_s, fault,
+                instance, i))
         return batch
 
     def shutdown(self) -> None:

@@ -87,6 +87,15 @@ WATCHED_EXTRA = (
     ("engine_prefill_reuse_frac", "low"),
     ("group_share.engine_prefill_reuse_frac", "low"),
     ("group_share.dispatch_reduction", "low"),
+    # weight-fabric fault drill (bench.py --push-chaos): the recovery wall
+    # after injected corruption + a stalled stream must not blow up, the
+    # resume must stay PARTIAL (resumed bytes climbing toward the full
+    # buffer means the range ledger degraded to full re-pushes), and the
+    # verify-rejection count must stay at the injected number (a rise
+    # means the fabric rejects clean rounds)
+    ("push_chaos.transfer_recovery_s", "high"),
+    ("push_chaos.transfer_resumed_bytes", "high"),
+    ("push_chaos.transfer_verify_failures", "high"),
     # training health plane (bench.py --pipeline-microbench fit records,
     # obs/rlhealth.py): entropy collapsing between rounds is a regression
     # even when tok/s held; KL, TIS clipping and degenerate-group

@@ -81,7 +81,13 @@ NAMESPACES = frozenset({
     "engine",        # engine flight deck: occupancy / TTFT / TPOT /
                      # page-pool + fleet aggregates (rollout/flightdeck.py)
     "rollout",       # rollout-plane latency/throughput distributions
-    "transfer",      # weight-fabric pack/push timings
+    "transfer",      # weight-fabric pack/push timings + supervision
+                     # gauges (transfer/{push_failures,push_retries,
+                     # verify_failures,resumed_bytes,rounds_verified,
+                     # laggard_escalations,catchup_pushes} and the
+                     # min_bandwidth_mbps/retry_budget knob echo —
+                     # transfer/agents.py, ARCHITECTURE.md "Weight-fabric
+                     # fault tolerance")
     "prefix_cache",  # engine prefix-cache hit telemetry
     "timing_s",      # marked_timer phase timings
     "obs",           # observability self-telemetry (scrape/log/anomaly)
