@@ -281,6 +281,80 @@ def warmup_cb(engine, cfg, rng, prompt_len):
     engine.flush_prefix_cache()
 
 
+def _cb_async_rl_drill(engine, params, cfg, rng, prompt_len, new_tokens,
+                       groups=8, g=8, push_period_s=2.0):
+    """RL-shaped rollout drill inside the cb phase: GRPO group traffic
+    (``groups`` shared prompts × ``g`` siblings — the group-shared prefill
+    path, with the engine's dispatch pipelining on) while a background
+    thread installs weight versions at the bounded-staleness cadence, so
+    sequences legitimately span versions mid-decode exactly as a
+    ``staleness_limit>1`` training run produces them. This is the
+    post-PR-3/8 ``rollout_decode_tok_s_per_chip`` headline shape the
+    ROADMAP bench debt names: decode throughput with pipelining +
+    group-share + async-k on, gated by the staleness extras bench_gate
+    watches (per-token ``weight_versions`` measure the spread directly)."""
+    import numpy as np
+
+    from polyrl_tpu.rollout.cb_engine import STREAM_END
+    from polyrl_tpu.rollout.sampling import SamplingParams
+
+    sp = SamplingParams(temperature=1.0, max_new_tokens=new_tokens,
+                        stop_token_ids=())
+    prompts = [rng.integers(1, cfg.vocab_size, prompt_len).tolist()
+               for _ in range(groups)]
+    outs = []
+    for gi, p in enumerate(prompts):
+        for si in range(g):
+            outs.append(engine.submit(f"rl-{gi}-{si}", p, sp,
+                                      group_id=f"rl-{gi}", group_size=g))
+    stop = threading.Event()
+    installs = [0]
+
+    def pusher() -> None:
+        # the async-k cadence: new versions land WHILE decode streams
+        # (re-installing the same values, so later phases see identical
+        # weights — only the version counter moves)
+        while not stop.wait(push_period_s):
+            engine.update_weights(params, version=engine.weight_version + 1)
+            installs[0] += 1
+
+    pt = threading.Thread(target=pusher, daemon=True)
+    t0 = time.monotonic()
+    pt.start()
+    total = 0
+    mixed = 0
+    all_vs: list = []
+    try:
+        for q in outs:
+            vs: list = []
+            while True:
+                item = q.get(timeout=1200)
+                if item is STREAM_END:
+                    break
+                total += len(item["token_ids"])
+                vs.extend([int(item.get("weight_version", -1))]
+                          * len(item["token_ids"]))
+            if len(set(vs)) > 1:
+                mixed += 1
+            all_vs.extend(vs)
+    finally:
+        stop.set()
+        pt.join(timeout=60.0)
+    wall = time.monotonic() - t0
+    final_v = int(engine.weight_version)
+    lag = final_v - np.asarray([v for v in all_vs if v >= 0], np.int64)
+    return {
+        "decode_tok_s": round(total / wall, 1) if wall > 0 else 0.0,
+        "wall_s": round(wall, 2),
+        "groups": groups, "g": g, "new_tokens": new_tokens,
+        "weight_installs": installs[0],
+        "mixed_version_seq_frac": round(mixed / max(len(outs), 1), 4),
+        "staleness_p95": round(float(np.percentile(lag, 95)), 2)
+        if lag.size else 0.0,
+        "staleness_max": int(lag.max()) if lag.size else 0,
+    }
+
+
 def bench_cb(cfg, params, batch, prompt_len, new_tokens, max_slots=64,
              page_size=64, steps_per_dispatch=8):
     """CB engine: direct in-process batch, then concurrent HTTP serving
@@ -386,11 +460,22 @@ def bench_cb(cfg, params, batch, prompt_len, new_tokens, max_slots=64,
     # prefill split, decode interval). Captured before stop() tears the
     # engine down.
     srv_info = server.server_info()
+    # RL-shaped sub-phase AFTER the serve flight-deck capture (so the
+    # serving numbers stay unpolluted): group-shared GRPO traffic with
+    # async-cadence weight installs overlapping decode — the post-PR-3/8
+    # rollout-decode headline shape (promoted by assemble_result as
+    # extra.rollout_decode_tok_s_per_chip, watched by bench_gate)
+    rl = _cb_async_rl_drill(engine, params, cfg, rng, prompt_len,
+                            new_tokens,
+                            groups=int(os.environ.get("POLYRL_BENCH_RL_GROUPS",
+                                                      "8")),
+                            g=int(os.environ.get("POLYRL_BENCH_RL_G", "8")))
     server.stop()
     trace = {k: round(v, 3) for k, v in sorted(engine.trace_report().items())}
     del engine
     gc.collect()
     return {
+        "rl": rl,        # group-share + async-k rollout drill
         "trace": trace,  # cumulative s (and n_*) per engine phase
         "direct_tok_s": round(direct_tokens / dt_direct, 1),
         "serve_tok_s": round(serve_tokens / dt_serve, 1),
@@ -794,6 +879,203 @@ def bench_8b(preset: str):
     return out
 
 
+class FakeAsyncRollout:
+    """Engine-shaped stub for the bounded-staleness A/B (``--async-sweep``;
+    also driven by tests/test_async_pipeline.py): deterministic tokens
+    produced token-by-token over ``gen_delay_s``, each stamped with the
+    version INSTALLED at its sample time; ``update_weights_async`` installs
+    on a background timer (``push_delay_s``) and exposes the same
+    ``push_lag``/``wait_push_lag`` admission-gate surface as the transfer
+    fabric — so a push issued mid-generation lands mid-stream and the
+    sequence legitimately spans weight versions, exactly like the real
+    verify-before-install fabric at ``staleness_limit > 1``."""
+
+    def __init__(self, gen_delay_s: float, push_delay_s: float):
+        self.pad_token_id = 0
+        self.weight_version = 0       # issued inline (trainer-visible)
+        self.installed_version = 0    # what generation samples against
+        self.last_gen_throughput = 0.0
+        self.gen_delay_s = gen_delay_s
+        self.push_delay_s = push_delay_s
+        self.mixed_version_batches = 0
+        self.gen_during_push = 0      # generations observed mid-push
+        self._cv = threading.Condition()
+        self._issued = 0
+        self._landed = 0
+
+    def generate(self, prompts, sampling, rng=None, **kw):
+        n_new = max(sampling.max_new_tokens, 1)
+        per_tok = self.gen_delay_s / n_new
+        outs = [{"token_ids": [], "logprobs": [], "weight_versions": []}
+                for _ in prompts]
+        t0 = time.monotonic()
+        during_push = False
+        for i in range(sampling.max_new_tokens):
+            time.sleep(per_tok)
+            during_push = during_push or self.push_lag() > 0
+            v = self.installed_version
+            for j, p in enumerate(prompts):
+                outs[j]["token_ids"].append(1 + (len(p) + i) % 200)
+                outs[j]["logprobs"].append(-0.5)
+                outs[j]["weight_versions"].append(v)
+        if during_push:
+            self.gen_during_push += 1
+        if outs and len(set(outs[0]["weight_versions"])) > 1:
+            self.mixed_version_batches += 1
+        dt = time.monotonic() - t0
+        if dt > 0:
+            self.last_gen_throughput = (
+                len(prompts) * sampling.max_new_tokens / dt)
+        return outs
+
+    def update_weights(self, params, version=None):
+        time.sleep(self.push_delay_s)
+        self.weight_version += 1
+        self.installed_version = self.weight_version
+
+    def update_weights_async(self, params, version=None):
+        self.weight_version += 1
+        v = self.weight_version
+        with self._cv:
+            self._issued += 1
+
+        def _land() -> None:
+            time.sleep(self.push_delay_s)
+            with self._cv:
+                self.installed_version = max(self.installed_version, v)
+                self._landed += 1
+                self._cv.notify_all()
+
+        threading.Thread(target=_land, name="weight-push",
+                         daemon=True).start()
+        return v
+
+    def push_lag(self) -> int:
+        with self._cv:
+            return self._issued - self._landed
+
+    def wait_push_lag(self, max_lag: int, timeout: float = 60.0) -> None:
+        deadline = time.monotonic() + timeout
+        with self._cv:
+            while self._issued - self._landed > max_lag:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise TimeoutError("fake push-lag gate timed out")
+                self._cv.wait(remaining)
+
+    def wait_pushed(self, timeout: float = 60.0) -> None:
+        self.wait_push_lag(0, timeout)
+
+
+def _microbench_fit(rollout, steps: int, depth: int,
+                    staleness_limit: int = 1,
+                    correction: bool | None = None) -> tuple[float, list]:
+    """One tiny CPU fit for the pipeline/async microbenches: the shared
+    trainer geometry behind ``--pipeline-microbench`` and
+    ``--async-sweep`` (and their tests)."""
+    import jax
+    import jax.numpy as jnp
+
+    from polyrl_tpu.data.dataset import PromptDataLoader, make_arithmetic_dataset
+    from polyrl_tpu.models import decoder
+    from polyrl_tpu.rewards.manager import load_reward_manager
+    from polyrl_tpu.trainer.actor import ActorConfig, StreamActor
+    from polyrl_tpu.trainer.stream_trainer import StreamRLTrainer, TrainerConfig
+    from polyrl_tpu.utils.tokenizer import ByteTokenizer
+
+    mcfg = decoder.get_config("tiny", dtype=jnp.float32, vocab_size=512,
+                              max_position_embeddings=128)
+    params = decoder.init_params(jax.random.PRNGKey(0), mcfg)
+    tok = ByteTokenizer()
+    tcfg = TrainerConfig(
+        train_batch_size=4, rollout_n=2, ppo_mini_batch_size=8,
+        micro_batch_size=4, min_stream_batch_size=4,
+        max_prompt_length=16, max_response_length=8,
+        adv_estimator="grpo", total_steps=steps,
+        pipeline_depth=depth, staleness_limit=staleness_limit,
+        rollout_is_correction=(depth > 0 if correction is None
+                               else correction))
+    actor = StreamActor(mcfg, ActorConfig(lr=1e-4, remat=False), params)
+    trainer = StreamRLTrainer(
+        tcfg, actor, rollout, tok,
+        load_reward_manager("naive", tok, num_workers=1),
+        PromptDataLoader(make_arithmetic_dataset(64), 4))
+    t0 = time.monotonic()
+    hist = trainer.fit()
+    return time.monotonic() - t0, hist
+
+
+def _hist_tail_mean(hist: list, key: str, tail: slice = slice(1, None)):
+    vals = [h[key] for h in hist[tail] if key in h]
+    return round(sum(vals) / len(vals), 5) if vals else None
+
+
+def async_sweep_bench(steps: int = 6, gen_delay_s: float = 0.25,
+                      push_delay_s: float = 0.25,
+                      depths: tuple = (0, 1, 2, 4)) -> dict:
+    """Bounded-staleness async A/B (``python bench.py --async-sweep``; also
+    driven by tests/test_async_pipeline.py): the tiny CPU trainer swept
+    over pipeline depth {0,1,2,4} with ``staleness_limit = depth`` (>=1) on
+    a :class:`FakeAsyncRollout` whose pushes install on a background timer.
+    Depth 0 is the serial loop, depth 1 the fenced PR-3 pipeline (the
+    ``wait_pushed()`` hard fence — gen and push walls serialize on the
+    prefetch lane), depth k>1 the bounded-staleness admission gate with
+    mixed-version per-token TIS — the push wall disappears behind
+    generation, which is the whole point. Emits the flat ``async_*``
+    extras bench_gate watches (speedup + tok/s hold, ``training/staleness``
+    p95 bounded, entropy/KL in their PR 9 directions)."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    tail = slice(1, None)
+    rows: dict[int, dict] = {}
+    hists: dict[int, list] = {}
+    for depth in depths:
+        rollout = FakeAsyncRollout(gen_delay_s, push_delay_s)
+        wall, hist = _microbench_fit(rollout, steps, depth,
+                                     staleness_limit=max(depth, 1))
+        step_s = sum(h["perf/step_time_s"] for h in hist[tail]) / max(
+            len(hist[tail]), 1)
+        rows[depth] = {
+            "depth": depth, "staleness_limit": max(depth, 1),
+            "wall_s": round(wall, 2), "step_s": round(step_s, 3),
+            "overlap_s_total": round(sum(
+                h.get("perf/pipeline_overlap_s", 0.0) for h in hist), 3),
+            "gate_wait_s": _hist_tail_mean(hist,
+                                           "perf/staleness_gate_wait_s"),
+            "staleness_p95": _hist_tail_mean(hist, "training/staleness/p95"),
+            "staleness_max": max(h.get("training/staleness_max", 0.0)
+                                 for h in hist),
+            "mixed_version_batches": rollout.mixed_version_batches,
+            "gen_during_push": rollout.gen_during_push,
+            "tok_s": _hist_tail_mean(hist, "perf/throughput_tokens_per_s"),
+        }
+        hists[depth] = hist
+    fenced = rows.get(1) or rows[min(d for d in rows if d > 0)]
+    async_depths = [d for d in rows if d > 1]
+    best_d = (min(async_depths, key=lambda d: rows[d]["step_s"])
+              if async_depths else fenced["depth"])
+    best = rows[best_d]
+    out = {
+        "steps": steps, "gen_delay_s": gen_delay_s,
+        "push_delay_s": push_delay_s,
+        "sweep": {f"d{d}": rows[d] for d in sorted(rows)},
+        "async_best_depth": best_d,
+        # fenced depth-1 vs best bounded-staleness depth: the win from
+        # letting the push wall hide behind generation
+        "async_step_speedup": round(
+            fenced["step_s"] / max(best["step_s"], 1e-9), 3),
+        "async_tok_s": best["tok_s"],
+        "async_staleness_p95": best["staleness_p95"],
+        "async_staleness_max": best["staleness_max"],
+        "async_mixed_version_batches": best["mixed_version_batches"],
+    }
+    for k in ("entropy", "approx_kl", "tis_clip_frac"):
+        v = _hist_tail_mean(hists[best_d], f"training/{k}")
+        if v is not None:
+            out[f"async_training_{k}"] = v
+    return out
+
+
 def pipeline_microbench(steps: int = 4, gen_delay_s: float = 0.4,
                         push_delay_s: float = 0.15) -> dict:
     """Pipelined-vs-sync A/B on a CPU fake engine (``python bench.py
@@ -806,15 +1088,6 @@ def pipeline_microbench(steps: int = 4, gen_delay_s: float = 0.4,
     the previous step's update + the async push hidden behind bookkeeping).
     Runs on CPU, never dials the TPU, and prints one JSON line."""
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
-    import jax
-    import jax.numpy as jnp
-
-    from polyrl_tpu.data.dataset import PromptDataLoader, make_arithmetic_dataset
-    from polyrl_tpu.models import decoder
-    from polyrl_tpu.rewards.manager import load_reward_manager
-    from polyrl_tpu.trainer.actor import ActorConfig, StreamActor
-    from polyrl_tpu.trainer.stream_trainer import StreamRLTrainer, TrainerConfig
-    from polyrl_tpu.utils.tokenizer import ByteTokenizer
 
     class FakeSlowRollout:
         """Engine-shaped stub: deterministic tokens after a fixed delay,
@@ -854,24 +1127,8 @@ def pipeline_microbench(steps: int = 4, gen_delay_s: float = 0.4,
                 t.join(timeout)
 
     def run(depth: int) -> tuple[float, list]:
-        mcfg = decoder.get_config("tiny", dtype=jnp.float32, vocab_size=512,
-                                  max_position_embeddings=128)
-        params = decoder.init_params(jax.random.PRNGKey(0), mcfg)
-        tok = ByteTokenizer()
-        tcfg = TrainerConfig(
-            train_batch_size=4, rollout_n=2, ppo_mini_batch_size=8,
-            micro_batch_size=4, min_stream_batch_size=4,
-            max_prompt_length=16, max_response_length=8,
-            adv_estimator="grpo", total_steps=steps,
-            pipeline_depth=depth, rollout_is_correction=depth > 0)
-        actor = StreamActor(mcfg, ActorConfig(lr=1e-4, remat=False), params)
-        trainer = StreamRLTrainer(
-            tcfg, actor, FakeSlowRollout(gen_delay_s, push_delay_s), tok,
-            load_reward_manager("naive", tok, num_workers=1),
-            PromptDataLoader(make_arithmetic_dataset(64), 4))
-        t0 = time.monotonic()
-        hist = trainer.fit()
-        return time.monotonic() - t0, hist
+        return _microbench_fit(FakeSlowRollout(gen_delay_s, push_delay_s),
+                               steps, depth)
 
     wall_sync, hist_sync = run(0)
     wall_pipe, hist_pipe = run(1)
@@ -1399,6 +1656,14 @@ def assemble_result(state: dict) -> dict:
         if isinstance(v, (int, float)) and not isinstance(v, bool):
             extra[k] = v
     meta = state.get("meta") or {}
+    # promote the cb phase's RL-shaped drill (group-share + async-cadence
+    # weight installs): the post-PR-3/8 rollout decode headline plus the
+    # staleness spread the gate bounds
+    rl = cb.get("rl") or {}
+    if rl.get("decode_tok_s"):
+        extra["rollout_decode_tok_s_per_chip"] = round(
+            rl["decode_tok_s"] / max(meta.get("n_chips", 1), 1), 1)
+        extra["rl_staleness_p95"] = rl.get("staleness_p95", 0.0)
     preset = meta.get("preset", "qwen3-1.7b")
     batch = meta.get("batch", 256)
     prompt_len = meta.get("prompt_len", 128)
@@ -1856,6 +2121,17 @@ if __name__ == "__main__":
         print(json.dumps({"metric": "group_share_dispatch_reduction",
                           "value": res["dispatch_reduction"], "unit": "x",
                           "extra": {"group_share": res}}))
+    elif "--async-sweep" in sys.argv:
+        # bounded-staleness async A/B over pipeline depth {0,1,2,4} with
+        # staleness_limit=depth — CPU-only, its own entry (never touches
+        # the TPU phase state machine or the relay)
+        res = async_sweep_bench(
+            steps=int(_cli_float("--steps", 6)),
+            gen_delay_s=_cli_float("--gen-delay-s", 0.25),
+            push_delay_s=_cli_float("--push-delay-s", 0.25))
+        print(json.dumps({"metric": "async_step_speedup",
+                          "value": res["async_step_speedup"], "unit": "x",
+                          "extra": res}))
     elif "--pipeline-microbench" in sys.argv:
         # CPU-only A/B of the trainer's pipelined mode — its own entry so
         # it never touches the TPU phase state machine or the relay

@@ -17,8 +17,13 @@ already-computed arrays into distributions and group diagnostics:
   — the per-token weight-version lag (current push version minus the
   version that sampled the token, from the wire-carried
   ``output_token_weight_versions``). The staleness ledger is what the
-  fully-async (k>1) roadmap item will train against: per-token TIS over
-  mixed-version sequences is tuned by exactly this distribution.
+  fully-async (``trainer.staleness_limit`` k>1) pipeline trains against:
+  per-token TIS over mixed-version sequences is tuned by exactly this
+  distribution, and the mixed-version TIS pass feeds back
+  ``training/tis_unknown_version_tokens`` (tokens excluded from
+  correction because their version is unknown) plus per-version-lag
+  ``training/tis_weight_mean/lag<k>`` / ``training/tis_clip_frac/lag<k>``
+  gauges.
 - **GRPO group diagnostics** (gauges): ``training/degenerate_group_frac``
   (zero-reward-variance groups — their advantages are identically 0, the
   batch fraction that teaches nothing), ``training/effective_batch_frac``
@@ -105,6 +110,13 @@ class TrainingHealthLedger:
         self._tok_known_version = 0
         self._tok_stale = 0
         self._staleness_max = 0
+        # mixed-version TIS accounting (trainer passes the stats dict from
+        # core_algos.mixed_version_importance_weights): unknown-version
+        # tokens excluded from correction, and per-version-lag raw sums
+        # lag -> [tokens, weight_sum, clipped]
+        self._tis_seen = False
+        self._tis_unknown = 0
+        self._tis_lag: dict[int, list] = {}
         self._lp_delta_sum = 0.0
         self._lp_delta_n = 0
         # per-source reward moments: slug -> [n, sum, sumsq]
@@ -116,7 +128,8 @@ class TrainingHealthLedger:
     def observe_ibatch(self, *, advantages, response_mask, group_ids,
                        traj_rewards, data_sources=None,
                        old_log_probs=None, rollout_log_probs=None,
-                       tis_weights=None, weight_versions=None,
+                       tis_weights=None, tis_stats=None,
+                       weight_versions=None,
                        current_version=None,
                        max_response_length: int = 0) -> None:
         """Fold one processed ibatch into the current step window. All
@@ -161,6 +174,19 @@ class TrainingHealthLedger:
             if tis_weights is not None:
                 h["tis_weight"].observe_many(
                     np.asarray(tis_weights, np.float64)[mask])
+
+            if tis_stats is not None:
+                # mixed-version TIS breakdown: unknown-version exclusions
+                # (training/tis_unknown_version_tokens) and per-lag
+                # weight/clip sums (training/tis_{weight_mean,
+                # clip_frac}/lag<k> at finalize)
+                self._tis_seen = True
+                self._tis_unknown += int(tis_stats.get("unknown_tokens", 0))
+                for lag, row in (tis_stats.get("per_lag") or {}).items():
+                    agg = self._tis_lag.setdefault(int(lag), [0, 0.0, 0])
+                    agg[0] += int(row["tokens"])
+                    agg[1] += float(row["weight_sum"])
+                    agg[2] += int(row["clipped"])
 
             if weight_versions is not None and current_version is not None:
                 wv = np.asarray(weight_versions)
@@ -250,6 +276,14 @@ class TrainingHealthLedger:
                 self._tok_stale / self._tok_known_version
                 if self._tok_known_version else 0.0)
             gauges["training/staleness_max"] = float(self._staleness_max)
+            if self._tis_seen:
+                gauges["training/tis_unknown_version_tokens"] = float(
+                    self._tis_unknown)
+                for lag in sorted(self._tis_lag):
+                    n, ws, cl = self._tis_lag[lag]
+                    if n:
+                        gauges[f"training/tis_weight_mean/lag{lag}"] = ws / n
+                        gauges[f"training/tis_clip_frac/lag{lag}"] = cl / n
             for slug, (cnt, tot, sq) in self._sources.items():
                 smean = tot / cnt
                 gauges[f"training/reward_mean/{slug}"] = smean

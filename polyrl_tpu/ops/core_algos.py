@@ -263,6 +263,91 @@ def truncated_importance_weights(
     return weights, ratio, mean_w, clip_frac
 
 
+def mixed_version_importance_weights(
+    old_log_probs,
+    rollout_log_probs,
+    response_mask,
+    weight_versions,
+    current_version: int,
+    cap: float = 2.0,
+):
+    """Mixed-version per-token truncated importance sampling for
+    bounded-staleness async rollouts (``trainer.staleness_limit > 1``;
+    ARCHITECTURE.md "Bounded-staleness async training").
+
+    Generalizes :func:`truncated_importance_weights` from "one behavior
+    policy per sequence" to sequences whose tokens were sampled under
+    DIFFERENT weight versions: with pushes overlapping generation
+    mid-stream, ``rollout_weight_versions`` records which push version
+    sampled each token. The per-token ratio already keys off each token's
+    own behavior-policy logprob (captured at sampling time under that
+    token's version), so the correction itself stays
+    ``min(exp(old_lp - rollout_lp), cap)``; what the version tensor adds:
+
+    - the **exclusion set** — tokens whose version is unknown
+      (``weight_versions == -1``: locally-finished degraded completions,
+      pre-version-stamping engines) get weight 1.0 instead of a
+      correction keyed to a behavior policy of unknown provenance, and
+      are counted in ``stats["unknown_tokens"]`` (the
+      ``training/tis_unknown_version_tokens`` gauge);
+    - **per-version-lag clip statistics** — the off-policy disagreement
+      and where the clip bites, bucketed by ``current_version − token
+      version``, feeding the ``training/tis_{weight_mean,clip_frac}/
+      lag<k>`` gauges next to the ``training/staleness`` ledger.
+
+    Host-side numpy by design: the trainer calls this on host arrays the
+    advantage pass already produced, and the per-lag bucketing is
+    data-dependent (not jit-safe).
+
+    Returns ``(weights, raw_ratio, stats)``: ``weights`` are capped,
+    1.0 on unknown-version tokens, zeroed outside the mask; ``raw_ratio``
+    is the uncapped per-token ratio (unmasked); ``stats`` carries
+    ``mean_weight`` (over masked tokens — the applied correction),
+    ``clip_frac`` (clipped / known-version tokens), ``known_tokens``,
+    ``unknown_tokens``, ``max_lag``, and ``per_lag`` as
+    ``{lag: {"tokens", "weight_sum", "clipped"}}`` raw sums so per-step
+    accumulation stays exact (obs/rlhealth.py aggregates them)."""
+    import numpy as np
+
+    old = np.asarray(old_log_probs, np.float32)
+    beh = np.asarray(rollout_log_probs, np.float32)
+    mask = np.asarray(response_mask) > 0
+    if weight_versions is None:
+        wv = np.full(old.shape, -1, np.int32)
+    else:
+        wv = np.asarray(weight_versions, np.int32)
+    ratio = np.exp(np.clip(old - beh, -20.0, 20.0)).astype(np.float32)
+    known = mask & (wv >= 0)
+    unknown = mask & (wv < 0)
+    weights = np.where(known, np.minimum(ratio, np.float32(cap)),
+                       np.float32(0.0)).astype(np.float32)
+    weights[unknown] = 1.0
+    clipped = known & (ratio > cap)
+    n_known = int(known.sum())
+    n_mask = int(mask.sum())
+    per_lag: dict[int, dict] = {}
+    max_lag = 0
+    if n_known:
+        lags = np.maximum(int(current_version) - wv, 0)
+        for lag in np.unique(lags[known]):
+            sel = known & (lags == lag)
+            per_lag[int(lag)] = {
+                "tokens": int(sel.sum()),
+                "weight_sum": float(weights[sel].sum()),
+                "clipped": int(clipped[sel].sum()),
+            }
+        max_lag = int(lags[known].max())
+    stats = {
+        "mean_weight": float(weights[mask].mean()) if n_mask else 1.0,
+        "clip_frac": float(clipped.sum()) / n_known if n_known else 0.0,
+        "known_tokens": n_known,
+        "unknown_tokens": int(unknown.sum()),
+        "max_lag": max_lag,
+        "per_lag": per_lag,
+    }
+    return weights, ratio, stats
+
+
 # ---------------------------------------------------------------------------
 # loss aggregation (verl agg_loss; consumed at stream_dp_actor.py:178-193)
 # ---------------------------------------------------------------------------

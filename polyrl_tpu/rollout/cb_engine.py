@@ -2455,6 +2455,7 @@ class CBEngine:
         for out_q in outs:
             toks: list[int] = []
             lps: list[float] = []
+            wvs: list[int] = []
             reason = "error"
             while True:
                 item = out_q.get(timeout=max(0.0, deadline - time.monotonic()))
@@ -2462,8 +2463,15 @@ class CBEngine:
                     break
                 toks.extend(item["token_ids"])
                 lps.extend(item["logprobs"])
+                # each chunk carries the version that sampled it; expanded
+                # per token here so colocated trainers see the same
+                # weight_versions the wire protocol streams (a weight swap
+                # mid-request legitimately makes these mixed)
+                wvs.extend([int(item.get("weight_version", -1))]
+                           * len(item["token_ids"]))
                 if item["finished"]:
                     reason = item["finish_reason"]
             results.append({"token_ids": toks, "logprobs": lps,
+                            "weight_versions": wvs,
                             "finish_reason": reason})
         return results

@@ -64,6 +64,10 @@ class GenerationOutput:
     finish_reason: str              # "stop" | "length" | "abort"
     prompt_tokens: int
     completion_tokens: int
+    # which push version sampled each token (the wire protocol's per-token
+    # weight_version, carried in-process too so colocated pipelined runs
+    # feed the same staleness ledger / mixed-version TIS as remote ones)
+    output_token_weight_versions: list | None = None
 
 
 class RolloutEngine:
@@ -223,6 +227,9 @@ class RolloutEngine:
                     finish_reason=finish,
                     prompt_tokens=len(prompt_ids[i]),
                     completion_tokens=n_new,
+                    # one jitted dispatch samples the whole batch, so every
+                    # token shares the version installed at dispatch time
+                    output_token_weight_versions=[self.weight_version] * n_new,
                 )
             )
         dt = time.monotonic() - t0

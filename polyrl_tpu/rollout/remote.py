@@ -597,11 +597,27 @@ class RemoteRollout:
         return self.weight_version
 
     def wait_pushed(self, timeout: float = 600.0) -> None:
-        """Block until the last async push's pack round has landed;
+        """Block until every queued async push's pack round has landed;
         re-raises a background push failure. No-op with no fabric."""
         if self.transfer is not None and hasattr(self.transfer,
                                                  "wait_pushed"):
             self.transfer.wait_pushed(timeout)
+
+    def push_lag(self) -> int:
+        """Async push rounds issued but not yet landed on the fabric —
+        the pipelined trainer's ``perf/staleness_lag`` gauge feed."""
+        fn = getattr(self.transfer, "push_lag", None)
+        return int(fn()) if fn is not None else 0
+
+    def wait_push_lag(self, max_lag: int, timeout: float = 600.0) -> None:
+        """Bounded-staleness admission gate (``trainer.staleness_limit``):
+        block until at most ``max_lag`` pushes are in flight. Falls back
+        to the full fence on fabrics without the lag surface."""
+        fn = getattr(self.transfer, "wait_push_lag", None)
+        if fn is not None:
+            fn(max_lag, timeout)
+        else:
+            self.wait_pushed(timeout)
 
     def _update_local_copy(self, params: Any) -> None:
         if self.local_server is None:

@@ -453,12 +453,17 @@ def build_trainer(cfg: RunConfig, cleanup: list | None = None):
         log.info("flight recorder armed: bundles -> %s/postmortem", rec_dir)
 
     if cfg.trainer.pipeline_depth > 0:
-        # pipelined rollout (ARCHITECTURE.md "Pipeline overlap"): announce
-        # the mode + staleness handling up front, since the step records
-        # will look different (perf/pipeline_* keys, async weight pushes)
+        # pipelined rollout (ARCHITECTURE.md "Pipeline overlap" +
+        # "Bounded-staleness async training"): announce the mode +
+        # staleness handling up front, since the step records will look
+        # different (perf/pipeline_* + perf/staleness_* keys, async
+        # weight pushes that may overlap generation at staleness_limit>1)
         log.info(
-            "pipelined rollout enabled: depth=%d, stale-rollout IS "
-            "correction=%s (cap=%.2f)", cfg.trainer.pipeline_depth,
+            "pipelined rollout enabled: depth=%d, staleness_limit=%d "
+            "(%s), stale-rollout IS correction=%s (cap=%.2f)",
+            cfg.trainer.pipeline_depth, cfg.trainer.staleness_limit,
+            "hard wait_pushed fence" if cfg.trainer.staleness_limit <= 1
+            else "bounded-staleness admission gate",
             "on" if cfg.trainer.rollout_is_correction else "OFF",
             cfg.trainer.rollout_is_cap)
 

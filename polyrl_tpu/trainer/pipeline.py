@@ -11,10 +11,17 @@ lanes:
   data prep prefetch), derives the per-step rng, and drives the trainer's
   ``_ibatch_iter_local`` stream for up to ``depth`` steps ahead of training,
   pushing assembled ibatches into a bounded queue. Before each step's first
-  generation request it takes the trainer's ``wait_pushed()`` fence, so a
-  stream never races a half-landed weight push. The per-step manager
-  ``/metrics`` scrape and the ``update_metrics`` balancer round-trip also
-  run here, off the foreground hot path.
+  generation request it takes the bounded-staleness ADMISSION GATE
+  (``trainer.staleness_limit``; ARCHITECTURE.md "Bounded-staleness async
+  training"): with the default limit 1 this is the hard ``wait_pushed()``
+  fence — a stream never races a half-landed weight push; with limit k>1
+  the stream may start while up to k-1 pushes are still in flight
+  (``wait_push_lag(k-1)``) — generation then overlaps pushes MID-STREAM
+  (safe: receivers verify-before-install), sequences legitimately span
+  weight versions, and mixed-version per-token TIS corrects the
+  off-policyness at update time. The per-step manager ``/metrics`` scrape
+  and the ``update_metrics`` balancer round-trip also run here, off the
+  foreground hot path.
 - **consumer lane** (the trainer's foreground thread): drains the queue via
   :meth:`step_ibatches` and runs reward → logprob → advantage → update as
   today. In multi-host runs the foreground re-broadcasts each ibatch, so
@@ -117,13 +124,25 @@ class RolloutPipeline:
                 self._drain_stats()
                 prod_metrics = MetricsTracker()
                 try:
-                    # fence: the previous async push must have fully landed
-                    # before this stream's first request, or the pool could
-                    # serve a version the pack is still writing
+                    # admission gate: limit=1 is the hard fence (the
+                    # previous async push fully landed before this
+                    # stream's first request — today's bitwise behavior);
+                    # limit=k>1 only blocks when k-1 pushes are already in
+                    # flight, so generation overlaps the pack/wire walls
+                    limit = max(int(getattr(trainer.cfg,
+                                            "staleness_limit", 1)), 1)
                     t_fence = time.monotonic()
-                    trainer._wait_pushed()
-                    prod_metrics.add_timing("prefetch_fence",
-                                            time.monotonic() - t_fence)
+                    if limit <= 1:
+                        trainer._wait_pushed()
+                    else:
+                        trainer._wait_push_headroom(limit - 1)
+                    gate_wait = time.monotonic() - t_fence
+                    prod_metrics.add_timing("prefetch_fence", gate_wait)
+                    prod_metrics.update(
+                        {"perf/staleness_gate_wait_s": gate_wait})
+                    prod_metrics.update_gauge({
+                        "perf/staleness_lag": float(trainer._push_lag()),
+                        "perf/staleness_limit": float(limit)})
                     version = trainer._push_count
                     gen_t0 = time.monotonic()
                     with obs.span("trainer/prefetch", step=step + 1):

@@ -103,13 +103,28 @@ class TrainerConfig:
     # pipelined rollout (trainer/pipeline.py; ARCHITECTURE.md "Pipeline
     # overlap"): 0 = the serial loop, bitwise-identical to the pre-pipeline
     # behavior; N >= 1 lets a background lane generate up to N steps ahead
-    # of training — rollouts then arrive up to one weight-version stale
+    # of training — rollouts then arrive weight-version stale
     # (see rollout_is_correction) and the per-step weight push goes async
-    # behind a wait_pushed() fence
     pipeline_depth: int = 0
+    # bounded-staleness admission gate (ARCHITECTURE.md "Bounded-staleness
+    # async training"): a prefetched stream may START while up to
+    # staleness_limit-1 weight pushes are still in flight — i.e. against
+    # any weight version within staleness_limit of the trainer's current
+    # push version; only breaching the bound blocks the lane. 1 (default)
+    # = the hard wait_pushed() fence (every push fully landed before the
+    # next stream — the PR-3 pipeline, bitwise). >1 lets pushes overlap
+    # generation MID-STREAM (the verify-before-install fabric makes a
+    # half-landed push unobservable), so sequences legitimately span
+    # versions and rollout_is_correction (REQUIRED then) applies
+    # mixed-version per-token TIS keyed off rollout_weight_versions.
+    staleness_limit: int = 1
     # truncated importance-sampling correction for stale rollouts: scale
     # advantages by min(exp(old_log_probs - rollout_log_probs),
-    # rollout_is_cap) per token (core_algos.truncated_importance_weights)
+    # rollout_is_cap) per token, keyed off each token's own behavior
+    # version; unknown-version tokens (rollout_weight_versions == -1) are
+    # excluded — weight 1.0 — and counted in
+    # training/tis_unknown_version_tokens
+    # (core_algos.mixed_version_importance_weights)
     rollout_is_correction: bool = False
     rollout_is_cap: float = 2.0
     # run
@@ -157,6 +172,25 @@ class TrainerConfig:
         if self.pipeline_depth < 0:
             raise ValueError(
                 f"pipeline_depth must be >= 0, got {self.pipeline_depth}")
+        if self.staleness_limit < 1:
+            raise ValueError(
+                f"staleness_limit must be >= 1, got {self.staleness_limit}")
+        if self.staleness_limit > 1 and self.pipeline_depth == 0:
+            raise ValueError(
+                f"staleness_limit={self.staleness_limit} requires the "
+                f"pipelined trainer (pipeline_depth >= 1): the serial loop "
+                f"has no async push to bound")
+        if self.staleness_limit > 1 and not self.rollout_is_correction:
+            # k>1 trains k versions off-policy; uncorrected that is
+            # silently wrong, not a log line (the depth>0/limit=1 case
+            # stays a warning — one version stale is the classic
+            # one-step-off-policy regime)
+            raise ValueError(
+                f"staleness_limit={self.staleness_limit} without "
+                f"rollout_is_correction: bounded-staleness rollouts train "
+                f"up to {self.staleness_limit} weight versions off-policy "
+                f"and MUST be importance-corrected — set "
+                f"trainer.rollout_is_correction=true (and rollout_is_cap)")
         if self.rollout_is_cap <= 0:
             raise ValueError(
                 f"rollout_is_cap must be > 0, got {self.rollout_is_cap}")
@@ -475,6 +509,15 @@ class StreamRLTrainer:
             params = jax.tree_util.tree_map(lambda x: np.asarray(x), params)
             self.rollout.update_weights_async(params)
         else:
+            if not block:
+                # pipelined COLOCATED engine without an async fabric: the
+                # engine must own a copy — the prefetch lane generates
+                # while the next step's update micros donate the actor's
+                # param buffers (same rationale as RemoteRollout's
+                # _update_local_copy)
+                import jax.numpy as jnp
+
+                params = jax.tree_util.tree_map(jnp.copy, params)
             self.rollout.update_weights(params)
         self._push_count += 1
 
@@ -484,6 +527,22 @@ class StreamRLTrainer:
         fn = getattr(self.rollout, "wait_pushed", None)
         if fn is not None:
             fn()
+
+    def _wait_push_headroom(self, max_lag: int) -> None:
+        """Bounded-staleness admission gate (``staleness_limit > 1``):
+        block until at most ``max_lag`` async pushes are still in flight.
+        Rollouts without a lag surface fall back to the full fence
+        (conservative — lag 0 satisfies any bound)."""
+        fn = getattr(self.rollout, "wait_push_lag", None)
+        if fn is not None:
+            fn(max_lag)
+        else:
+            self._wait_pushed()
+
+    def _push_lag(self) -> int:
+        """In-flight async push count (``perf/staleness_lag`` gauge)."""
+        fn = getattr(self.rollout, "push_lag", None)
+        return int(fn()) if fn is not None else 0
 
     def _gather_push_params(self):
         if self.cfg.weight_sync == "lora_delta":
@@ -643,21 +702,31 @@ class StreamRLTrainer:
             ibatch.tensors["advantages"] = np.asarray(adv)
             ibatch.tensors["returns"] = np.asarray(ret)
             tis_w = None
+            tis_stats = None
             if cfg.rollout_is_correction:
-                # stale-rollout correction (pipelined mode generates one
-                # weight-version behind the update): truncated importance
-                # reweighting of the generation-time behavior policy
-                # (rollout_log_probs) against the recomputed current-policy
-                # old_log_probs — OPPO/LlamaRL's bounded-staleness recipe
-                w, _ratio, mean_w, clip_frac = \
-                    core_algos.truncated_importance_weights(
+                # stale-rollout correction (pipelined mode generates up to
+                # staleness_limit weight-versions behind the update):
+                # MIXED-VERSION per-token truncated importance reweighting
+                # of each token's own behavior policy (rollout_log_probs,
+                # captured under the version that sampled the token —
+                # rollout_weight_versions) against the recomputed
+                # current-policy old_log_probs — OPPO/LlamaRL's
+                # bounded-staleness recipe. Unknown-version tokens (−1:
+                # degraded local completions) are EXCLUDED (weight 1.0)
+                # and counted, not corrected as if version-0.
+                tis_w, _ratio, tis_stats = \
+                    core_algos.mixed_version_importance_weights(
                         ibatch["old_log_probs"], ibatch["rollout_log_probs"],
-                        ibatch["response_mask"], cap=cfg.rollout_is_cap)
-                tis_w = np.asarray(w)
+                        ibatch["response_mask"],
+                        ibatch.tensors.get("rollout_weight_versions"),
+                        current_version=int(getattr(self.rollout,
+                                                    "weight_version", 0)),
+                        cap=cfg.rollout_is_cap)
                 ibatch.tensors["advantages"] = (
                     ibatch.tensors["advantages"] * tis_w)
-                metrics.update({"actor/tis_weight_mean": float(mean_w),
-                                "actor/tis_clip_frac": float(clip_frac)})
+                metrics.update({
+                    "actor/tis_weight_mean": tis_stats["mean_weight"],
+                    "actor/tis_clip_frac": tis_stats["clip_frac"]})
         if self._health is not None:
             # RL-dynamics ledger feed (obs/rlhealth.py): everything is a
             # host array this pass already produced; the per-token
@@ -672,6 +741,7 @@ class StreamRLTrainer:
                 old_log_probs=np.asarray(ibatch["old_log_probs"]),
                 rollout_log_probs=np.asarray(ibatch["rollout_log_probs"]),
                 tis_weights=tis_w,
+                tis_stats=tis_stats,
                 weight_versions=ibatch.tensors.get("rollout_weight_versions"),
                 current_version=int(getattr(self.rollout,
                                             "weight_version", 0)),
@@ -1061,9 +1131,11 @@ class StreamRLTrainer:
             histograms=statusz.nest_histograms(rec),
             counters=counters, gauges=gauges,
             queues={"pipeline_depth": float(self.cfg.pipeline_depth),
+                    "staleness_limit": float(self.cfg.staleness_limit),
                     "pipeline_queue": float(rec.get(
                         "perf/pipeline_queue_depth", 0.0))},
             weights={"push_count": float(self._push_count),
+                     "push_lag": float(self._push_lag()),
                      "version": float(getattr(self.rollout,
                                               "weight_version", 0)),
                      "staleness": float(rec.get(

@@ -70,10 +70,20 @@ class TransferInterface:
                                       cfg=self.cfg, fault=fault)
             endpoints = [self.sender.endpoint]
         self.manager = manager_client
-        # async push state: at most ONE background round in flight; a new
-        # async push (or close) first fences on the previous one
+        # async push state: pending pack/wire rounds CHAIN on a FIFO of
+        # "weight-push" threads — each joins its predecessor before arming
+        # the sender, so rounds serialize on the one buffer while the
+        # foreground never blocks. _push_issued/_push_landed back the
+        # pipelined trainer's bounded-staleness admission gate
+        # (push_lag()/wait_push_lag(); ARCHITECTURE.md "Bounded-staleness
+        # async training"): up to staleness_limit-1 rounds may be in
+        # flight while generation streams against the last landed version.
+        self._push_cv = threading.Condition()
         self._push_thread: threading.Thread | None = None
         self._push_err: BaseException | None = None
+        self._push_issued = 0
+        self._push_landed = 0
+        self._last_async_version = -1
         self.sender.start()
         if manager_client is not None:
             manager_client.update_weight_senders(
@@ -149,24 +159,39 @@ class TransferInterface:
         before any instance could observe mixed versions, exactly like the
         sync path — and the pack/wire round (signal + streaming pack behind
         the watermark) completes on a background ``weight-push`` thread.
-        ``wait_pushed()`` is the fence; callers MUST pass host-resident
-        arrays (the trainer snapshots via ``np.asarray`` first) so the
-        background pack never touches a donated device buffer.
+        Rounds QUEUE: a push issued while a previous round is still in
+        flight chains behind it (the new thread joins its predecessor, and
+        ``signal_update_streaming`` itself waits out the predecessor's wire
+        before re-arming the buffer) — the foreground never blocks, which
+        is what lets ``staleness_limit > 1`` overlap pushes with
+        generation mid-stream. ``wait_pushed()`` drains the whole chain;
+        ``wait_push_lag()`` is the bounded admission gate. Callers MUST
+        pass host-resident arrays (the trainer snapshots via
+        ``np.asarray`` first) so the background pack never touches a
+        donated device buffer — with queued rounds each pending push holds
+        its own host snapshot until it packs.
 
         Multi-NIC ``SenderGroup`` keeps its serial double-buffer round and
         degrades to the synchronous call (its pack already overlaps any
         in-flight previous round via the back buffer)."""
-        self.wait_pushed()
         if not isinstance(self.sender, SenderAgent):
             return self.update_weights_with_agent(params)
         if self.manager is not None:
             version = self.manager.update_weight_version()
         else:
-            version = self.sender.version + 1
+            # managerless version issue must count QUEUED rounds too —
+            # sender.version only advances when a round arms
+            version = max(self.sender.version, self._last_async_version) + 1
+        self._last_async_version = version
         ctx = obs.get_tracer().capture()
         t0 = time.monotonic()
+        with self._push_cv:
+            prev = self._push_thread
+            self._push_issued += 1
 
         def _bg() -> None:
+            if prev is not None:
+                prev.join()
             try:
                 with obs.get_tracer().adopt(ctx), \
                         obs.span("transfer/update_weights",
@@ -190,27 +215,69 @@ class TransferInterface:
                          version, self.layout.total_bytes / 1e6,
                          time.monotonic() - t0)
             except BaseException as exc:  # noqa: BLE001 — re-raised by fence
-                self._push_err = exc
+                with self._push_cv:
+                    if self._push_err is None:
+                        self._push_err = exc
+            finally:
+                # a failed round still LANDS (it is over): the lag gate
+                # must unblock — the failure surfaces on the next fence
+                with self._push_cv:
+                    self._push_landed += 1
+                    self._push_cv.notify_all()
 
-        self._push_thread = threading.Thread(target=_bg, name="weight-push",
-                                             daemon=True)
-        self._push_thread.start()
+        t = threading.Thread(target=_bg, name="weight-push", daemon=True)
+        with self._push_cv:
+            self._push_thread = t
+        t.start()
         return version
 
+    def push_lag(self) -> int:
+        """Async push rounds issued but not yet landed (pack complete or
+        failed). The pipelined trainer's bounded-staleness gauge feed."""
+        with self._push_cv:
+            return self._push_issued - self._push_landed
+
+    def wait_push_lag(self, max_lag: int, timeout: float = 600.0) -> None:
+        """Bounded-staleness admission gate: block until at most
+        ``max_lag`` async push rounds are still in flight (``max_lag=0``
+        ≡ the full ``wait_pushed`` fence), re-raising any background push
+        failure. The pipeline calls this with ``staleness_limit - 1``
+        before each prefetched stream's first request."""
+        deadline = time.monotonic() + timeout
+        with self._push_cv:
+            while (self._push_issued - self._push_landed > max_lag
+                   and self._push_err is None):
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise TimeoutError(
+                        f"weight-push lag still > {max_lag} after "
+                        f"{timeout:.0f}s")
+                self._push_cv.wait(remaining)
+            err, self._push_err = self._push_err, None
+        if err is not None:
+            raise RuntimeError("async weight push failed") from err
+
     def wait_pushed(self, timeout: float = 600.0) -> None:
-        """Fence on the last async push: returns once its pack round has
-        fully landed (the point the SYNC path returns at — receivers
-        version-gate behind the manager, so instance re-activation needs
-        no trainer-side wait), re-raising any background failure."""
-        t = self._push_thread
+        """Fence on the async push chain: returns once every queued round's
+        pack has fully landed (the point the SYNC path returns at —
+        receivers version-gate behind the manager, so instance
+        re-activation needs no trainer-side wait), re-raising any
+        background failure."""
+        with self._push_cv:
+            t = self._push_thread
         if t is not None:
+            # the newest thread joins its whole predecessor chain first,
+            # so joining it alone drains every queued round
             t.join(timeout)
             if t.is_alive():
                 raise TimeoutError(
                     f"async weight push still running after {timeout:.0f}s")
-            self._push_thread = None
-        if self._push_err is not None:
+            with self._push_cv:
+                if self._push_thread is t:
+                    self._push_thread = None
+        with self._push_cv:
             err, self._push_err = self._push_err, None
+        if err is not None:
             raise RuntimeError("async weight push failed") from err
 
     def set_laggard_callback(self, cb) -> None:

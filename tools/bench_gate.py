@@ -104,6 +104,22 @@ WATCHED_EXTRA = (
     ("training_approx_kl", "high"),
     ("training_tis_clip_frac", "high"),
     ("training_degenerate_group_frac", "high"),
+    # bounded-staleness async pipeline (bench.py --async-sweep): the
+    # async-vs-fenced step speedup and the async run's tok/s must hold,
+    # the training/staleness p95 must stay bounded by staleness_limit
+    # (a rise means the admission gate stopped gating), and the async
+    # run's RL dynamics must keep their PR 9 directions
+    ("async_step_speedup", "low"),
+    ("async_tok_s", "low"),
+    ("async_staleness_p95", "high"),
+    ("async_training_entropy", "low"),
+    ("async_training_approx_kl", "high"),
+    ("async_training_tis_clip_frac", "high"),
+    # cb phase RL-shaped drill (group-share + async-cadence installs
+    # overlapping decode): the post-PR-3/8 rollout decode headline the
+    # ROADMAP bench debt names, and its per-token staleness spread
+    ("rollout_decode_tok_s_per_chip", "low"),
+    ("rl_staleness_p95", "high"),
 )
 
 

@@ -20,9 +20,17 @@ Static coverage (AST, literals only — dynamic keys can't be checked):
 - the literal head of f-string keys in the above positions (prefix check).
 
 Covered key families include the pipelined trainer's ``perf/pipeline_*``
-(``perf/pipeline_overlap_s``, ``perf/pipeline_queue_depth``) and
-``perf/weight_staleness`` gauges plus the ``actor/tis_*`` correction
-metrics (trainer/pipeline.py, stream_trainer.py), the token-level
+(``perf/pipeline_overlap_s``, ``perf/pipeline_queue_depth``),
+``perf/weight_staleness`` and the bounded-staleness admission-gate
+``perf/staleness_*`` gauges (``perf/staleness_lag`` — in-flight pushes at
+stream start, ``perf/staleness_limit`` — the configured bound echo,
+``perf/staleness_gate_wait_s`` — time blocked on the gate) plus the
+``actor/tis_*`` correction metrics (trainer/pipeline.py,
+stream_trainer.py); the mixed-version TIS breakdown
+``training/tis_unknown_version_tokens`` (masked tokens excluded from
+correction because their sampling version is unknown) and the
+per-version-lag ``training/tis_weight_mean/lag<k>`` /
+``training/tis_clip_frac/lag<k>`` gauges (obs/rlhealth.py); the token-level
 salvage counters — ``fault/tokens_salvaged``, ``fault/suffix_resumes``,
 ``fault/resume_prefill_tokens`` (rollout/remote.py ``fault_counters``)
 and the injector's ``fault/injected_*`` (rollout/faults.py ``counters``)
