@@ -347,6 +347,16 @@ def _cb_async_rl_drill(engine, params, cfg, rng, prompt_len, new_tokens,
         "decode_tok_s": round(total / wall, 1) if wall > 0 else 0.0,
         "wall_s": round(wall, 2),
         "groups": groups, "g": g, "new_tokens": new_tokens,
+        # shared-prefix decode attention on the grouped traffic: pages the
+        # decode kernels streamed per token and the dedup fraction (the
+        # deck is cumulative over the cb phase; this drill is its only
+        # grouped segment, so a nonzero frac means sharing engaged)
+        "kv_read_pages_per_token": round(
+            engine.deck.kv_read_pages_per_token(), 3),
+        "shared_prefix_read_frac": round(
+            engine.deck.shared_prefix_read_frac(), 4),
+        "grouped_decode_dispatches": int(
+            getattr(engine, "grouped_decode_dispatches", 0)),
         "weight_installs": installs[0],
         "mixed_version_seq_frac": round(mixed / max(len(outs), 1), 4),
         "staleness_p95": round(float(np.percentile(lag, 95)), 2)
@@ -525,6 +535,12 @@ def bench_cb(cfg, params, batch, prompt_len, new_tokens, max_slots=64,
             "prefill_dispatches", 0)),
         "engine_sibling_attach_dispatches": int(srv_info.get(
             "sibling_attach_dispatches", 0)),
+        # shared-prefix decode attention (the rl drill is the phase's
+        # grouped segment — the read-frac the gate holds across rounds)
+        "engine_shared_prefix_read_frac": float(rl.get(
+            "shared_prefix_read_frac", 0.0)),
+        "engine_kv_read_pages_per_token": float(rl.get(
+            "kv_read_pages_per_token", 0.0)),
     }
 
 
@@ -1601,6 +1617,116 @@ def group_share_bench(preset: str = "tiny", g: int = 8, groups: int = 4,
     }
 
 
+def decode_attn_bench(preset: str = "tiny", gs: tuple = (1, 8),
+                      prefixes: tuple = (512, 2048), slots: int = 16,
+                      suffix: int = 64, page_size: int = 64,
+                      iters: int = 10) -> dict:
+    """Shared-prefix decode attention A/B (``python bench.py
+    --decode-attn``): the grouped two-phase kernel vs the production
+    ungrouped paged-attention path at the OPS level — the same pools,
+    page tables and queries, with ``slots`` decode rows arranged as
+    groups of G siblings sharing a ``prefix``-token prompt KV plus a
+    private ``suffix``. G=1 measures the grouped kernel's overhead floor
+    (no sharing to exploit); G=8 × prefix=2048 is the GRPO shape where
+    the prompt KV dominates and the per-slot kernel re-streams it G
+    times. Reports wall per call, speedup, the analytic
+    ``kv_read_pages_per_token`` both paths pay, and the max output error
+    vs the ungrouped oracle (a broken merge must be loud in the field).
+    CPU-sized by default (jnp reference impls — the read-page accounting
+    is exact either way); on a real chip run with JAX_PLATFORMS unset to
+    A/B the Pallas kernels."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from polyrl_tpu.models import decoder
+    from polyrl_tpu.ops.paged_attention import (
+        grouped_paged_attention,
+        paged_attention,
+    )
+
+    cfg = decoder.get_config(preset)
+    hkv, hd = cfg.num_kv_heads, cfg.head_dim_
+    hq = cfg.num_heads
+    rng = np.random.default_rng(0)
+    cases: dict = {}
+    headline: dict = {}
+    for prefix in prefixes:
+        n_pre = -(-prefix // page_size)
+        for g in gs:
+            n_groups = max(1, slots // g)
+            s = n_groups * g
+            sfx_pages = -(-(suffix + 1) // page_size)
+            n_pool = 1 + n_groups * n_pre + s * sfx_pages
+            k_pool = jnp.asarray(rng.standard_normal(
+                (hkv, n_pool, page_size, hd)), jnp.bfloat16)
+            v_pool = jnp.asarray(rng.standard_normal(
+                (hkv, n_pool, page_size, hd)), jnp.bfloat16)
+            q = jnp.asarray(rng.standard_normal((s, hq, hd)), jnp.bfloat16)
+            free = list(range(1, n_pool))
+            table = np.zeros((s, n_pre + sfx_pages), np.int32)
+            lens = np.full((s,), prefix + suffix + 1, np.int32)
+            g_slots = np.full((n_groups, g), -1, np.int32)
+            g_pages = np.zeros((n_groups, n_pre), np.int32)
+            g_lens = np.full((n_groups,), prefix, np.int32)
+            for gi in range(n_groups):
+                pre = [free.pop() for _ in range(n_pre)]
+                g_pages[gi] = pre
+                for si in range(g):
+                    row = gi * g + si
+                    g_slots[gi, si] = row
+                    table[row, :n_pre] = pre
+                    table[row, n_pre:] = [free.pop()
+                                          for _ in range(sfx_pages)]
+            args = (q, k_pool, v_pool, jnp.asarray(table), jnp.asarray(lens))
+            gargs = args + (jnp.asarray(g_slots), jnp.asarray(g_pages),
+                            jnp.asarray(g_lens))
+
+            def timed(fn, fargs):
+                fn_j = jax.jit(fn)  # one traced graph per path (the CPU
+                # ref impls are otherwise eager op-by-op — unfair timing)
+                out = jax.block_until_ready(fn_j(*fargs))  # compile/warm
+                t0 = time.monotonic()
+                for _ in range(iters):
+                    out = jax.block_until_ready(fn_j(*fargs))
+                return (time.monotonic() - t0) / iters, out
+
+            t_ung, out_u = timed(paged_attention, args)
+            t_grp, out_g = timed(grouped_paged_attention, gargs)
+            err = float(jnp.max(jnp.abs(
+                out_g.astype(jnp.float32) - out_u.astype(jnp.float32))))
+            # analytic read accounting: every slot logically attends
+            # n_pre + sfx_pages pages; grouped streams each group's
+            # prefix ONCE
+            logical = s * (n_pre + sfx_pages)
+            grouped_pages = n_groups * n_pre + s * sfx_pages
+            case = {
+                "ungrouped_ms": round(t_ung * 1e3, 3),
+                "grouped_ms": round(t_grp * 1e3, 3),
+                "speedup": round(t_ung / max(t_grp, 1e-9), 3),
+                "kv_read_pages_per_token_ungrouped": round(logical / s, 2),
+                "kv_read_pages_per_token": round(grouped_pages / s, 2),
+                "read_reduction": round(logical / grouped_pages, 2),
+                "max_abs_err": round(err, 5),
+                "slots": s, "groups": n_groups,
+            }
+            cases[f"g{g}_p{prefix}"] = case
+            if g == max(gs) and prefix == max(prefixes):
+                headline = case
+    return {
+        "preset": preset, "page_size": page_size, "suffix": suffix,
+        "iters": iters, "backend": jax.default_backend(),
+        "cases": cases,
+        # bench_gate watches: the G-max/prefix-max A/B speedup must not
+        # regress and the grouped read cost must hold (~G× below the
+        # ungrouped pages/token on the prefix segment)
+        "speedup": headline.get("speedup", 0.0),
+        "kv_read_pages_per_token": headline.get(
+            "kv_read_pages_per_token", 0.0),
+        "read_reduction": headline.get("read_reduction", 0.0),
+    }
+
+
 def _chip_peaks(device_kind: str) -> tuple[float, float]:
     for prefix, peaks in _CHIP_PEAKS.items():
         if device_kind.lower().startswith(prefix.lower()):
@@ -1651,7 +1777,8 @@ def assemble_result(state: dict) -> dict:
     for k in ("engine_occupancy", "engine_page_util_peak",
               "engine_cache_hit_rate", "engine_ttft_p95_ms",
               "engine_tpot_p95_ms", "engine_attributed_frac",
-              "engine_prefill_reuse_frac"):
+              "engine_prefill_reuse_frac", "engine_shared_prefix_read_frac",
+              "engine_kv_read_pages_per_token"):
         v = cb.get(k)
         if isinstance(v, (int, float)) and not isinstance(v, bool):
             extra[k] = v
@@ -2121,6 +2248,21 @@ if __name__ == "__main__":
         print(json.dumps({"metric": "group_share_dispatch_reduction",
                           "value": res["dispatch_reduction"], "unit": "x",
                           "extra": {"group_share": res}}))
+    elif "--decode-attn" in sys.argv:
+        # shared-prefix decode attention A/B: grouped two-phase kernel vs
+        # the per-slot kernel at the GRPO traffic shape — its own entry,
+        # CPU-sized by default (set JAX_PLATFORMS/preset env for a real
+        # chip, where the Pallas kernels are what gets timed)
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        res = decode_attn_bench(
+            preset=os.environ.get("POLYRL_BENCH_PRESET", "tiny"),
+            slots=int(_cli_float("--slots", 16)),
+            suffix=int(_cli_float("--suffix", 64)),
+            page_size=int(_cli_float("--page-size", 64)),
+            iters=int(_cli_float("--iters", 10)))
+        print(json.dumps({"metric": "decode_attn_speedup",
+                          "value": res["speedup"], "unit": "x",
+                          "extra": {"decode_attn": res}}))
     elif "--async-sweep" in sys.argv:
         # bounded-staleness async A/B over pipeline depth {0,1,2,4} with
         # staleness_limit=depth — CPU-only, its own entry (never touches

@@ -83,6 +83,18 @@ class RolloutSection:
     admit_wave: int = 8
     admit_reorder_window: int = 8
     group_share: bool = True
+    # shared-prefix decode attention (cb backend; ARCHITECTURE.md
+    # "Shared-prefix decode attention"): decode dispatches with live GRPO
+    # groups route through the two-phase grouped paged-attention kernel —
+    # ONE HBM stream of the group's shared prompt KV serves all siblings
+    # (phase 1), each slot's own suffix pages merge in via the flash LSE
+    # (phase 2). False restores the per-slot kernel for every dispatch
+    # (the --decode-attn A/B baseline; singletons always take that path).
+    decode_group_share: bool = True
+    # sibling-wait pre-ref expiry: how long a leader's pre-taken prefix
+    # refs survive waiting for siblings that never arrive (dropped
+    # groups, mis-sized hints) before the TTL sweep releases them
+    group_preref_ttl_s: float = 30.0
     # disaggregated plumbing (reference rollout_manager.{port,endpoint},
     # workers/config/rollout.py:95-101)
     manager_endpoint: str = ""            # "" → spawn the C++ manager locally

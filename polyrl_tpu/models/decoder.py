@@ -685,7 +685,15 @@ def forward_paged_decode(
     ``active`` routes INACTIVE slots' writes to the null page 0: a finished
     slot's pages return to the allocator while its device page_table row is
     still stale, so an unmasked write would corrupt whichever request
-    reuses those pages (one garbage KV token per later dispatch)."""
+    reuses those pages (one garbage KV token per later dispatch).
+
+    ``attn_fn(q, k_pool, v_pool, page_table, lens)`` is the decode
+    attention seam: the TP engine shard_maps the Pallas kernel through it,
+    and the shared-prefix grouped decode path (CBEngine with live GRPO
+    groups) passes a closure over the dispatch's group tables that routes
+    into ``ops.paged_attention.grouped_paged_attention`` — this forward
+    stays group-agnostic; the per-slot ``page_table`` contract is
+    unchanged (grouping only changes the kernel's HBM read pattern)."""
     from polyrl_tpu.ops.paged_attention import paged_attention, paged_kv_write
 
     attn_fn = attn_fn or paged_attention

@@ -20,6 +20,30 @@ Two implementations with identical semantics:
   skipped with ``pl.when`` (their index_map points at the reserved null
   page 0, whose DMA cost is the price of a uniform grid).
 
+Shared-prefix GROUPED decode (``grouped_paged_attention*``): GRPO's
+G-samples-per-prompt traffic means G slots share one physical prompt-KV
+prefix (page-table indirection since the group-shared prefill layer).
+The per-slot kernel above still streams those prefix pages from HBM once
+PER SLOT — a G× redundant read of the dominant KV segment of a decode
+step that is bandwidth-bound. The grouped variant is two-phase:
+
+- **Phase 1 (prefix)**: grid (group, prefix_page) — each shared prefix
+  page is DMA'd ONCE per group and attends against the group's G·rep
+  stacked decode queries (a [G·rep, page] MXU matmul instead of G rep-row
+  gemvs — arithmetic intensity ×G). Emits per-slot partial flash stats
+  (m, l, unnormalized acc).
+- **Phase 2 (suffix)**: the per-slot kernel shape, over each slot's OWN
+  pages past the prefix (prompt tail + generated KV), with the online
+  softmax INITIALIZED from phase 1's stats — the standard flash (m, l,
+  acc) log-sum-exp merge falls out of the rescale the kernel already
+  does per page. Ungrouped slots init with (m=-inf, l=0, acc=0) and
+  phase 2 degenerates to exactly the ungrouped kernel's math.
+
+``grouped_paged_attention_ref`` is the jnp oracle for the same two-phase
+split (used by CPU tests and as the engine's CPU path); the result is
+mathematically the plain full-table attention, so it is pinned against
+``paged_attention_ref`` on the reconstructed per-slot tables.
+
 Layout notes (why these shapes):
 - pools are [num_pages, page_size, Hkv, D]: page_size×D are the tiled
   (sublane×lane) dims of each DMA; Hkv is a grid axis so one kernel
@@ -201,6 +225,422 @@ def paged_attention_lib(q, k_pool, v_pool, page_table, seq_lens, scale=None):
         jnp.maximum(seq_lens.astype(jnp.int32), 1),
         page_table.astype(jnp.int32),
         pages_per_compute_block=ppcb)
+
+
+# -- shared-prefix grouped decode attention ---------------------------------
+
+
+def _group_slot_maps(group_slots, group_prefix_lens, s: int, page_size: int):
+    """Invert the group table into per-slot maps (jit-safe, static shapes).
+
+    group_slots [NG, G] int32 (-1 = empty seat) → for each of the ``s``
+    attention rows: the group row it sits in (-1 = ungrouped), its seat
+    column, and the number of leading page-table columns phase 1 already
+    covered (0 for ungrouped rows). Scatter uses mode="drop" so the -1
+    seats (routed out of bounds) cannot clamp-corrupt the last slot.
+    """
+    ng, gmax = group_slots.shape
+    flat = group_slots.reshape(-1)
+    gidx = jnp.repeat(jnp.arange(ng, dtype=jnp.int32), gmax)
+    gcol = jnp.tile(jnp.arange(gmax, dtype=jnp.int32), ng)
+    tgt = jnp.where(flat >= 0, flat, s)  # s = out of bounds → dropped
+    slot_grp = jnp.full((s,), -1, jnp.int32).at[tgt].set(gidx, mode="drop")
+    slot_col = jnp.zeros((s,), jnp.int32).at[tgt].set(gcol, mode="drop")
+    pre_tok = group_prefix_lens[jnp.clip(slot_grp, 0, ng - 1)]
+    slot_npre = jnp.where(slot_grp >= 0, pre_tok // page_size, 0)
+    return slot_grp, slot_col, slot_npre.astype(jnp.int32)
+
+
+def grouped_paged_attention_ref(
+    q: jnp.ndarray,               # [S, Hq, D]
+    k_pool: jnp.ndarray,          # [Hkv, N_pages, page_size, D]
+    v_pool: jnp.ndarray,
+    page_table: jnp.ndarray,      # [S, P] int32 — FULL per-slot page rows
+    seq_lens: jnp.ndarray,        # [S] int32 — attended tokens per slot
+    group_slots: jnp.ndarray,     # [NG, G] int32 slot ids, -1 = empty seat
+    group_prefix_pages: jnp.ndarray,  # [NG, P_pre] int32 shared prefix pages
+    group_prefix_lens: jnp.ndarray,   # [NG] int32 prefix TOKENS (page-mult.)
+    scale: float | None = None,
+) -> jnp.ndarray:
+    """Two-phase oracle: explicit prefix/suffix split + LSE merge in jnp.
+
+    Contract (what the engine guarantees): for every seated slot ``s`` of
+    group ``g``, ``page_table[s, :n_pre] == group_prefix_pages[g, :n_pre]``
+    (the PR-8 page-table indirection) and ``seq_lens[s] > prefix_len`` —
+    so the merged result equals plain full-table attention up to float
+    reduction order. Unseated slots take the phase-2-only path and match
+    ``paged_attention_ref`` exactly.
+    """
+    s, hq, d = q.shape
+    hkv, _n, ps, _ = k_pool.shape
+    p = page_table.shape[1]
+    ng, _g = group_slots.shape
+    p_pre = group_prefix_pages.shape[1]
+    rep = hq // hkv
+    scale = scale if scale is not None else d ** -0.5
+
+    slot_grp, _slot_col, slot_npre = _group_slot_maps(
+        group_slots, group_prefix_lens, s, ps)
+    pre_tok = (slot_npre * ps)[:, None]                   # [S, 1]
+    qr = q.reshape(s, hkv, rep, d).astype(jnp.float32)
+
+    # phase 1: every slot against ITS group's shared prefix (ungrouped
+    # slots fully masked → explicit zero/neg-inf stats below)
+    gi = jnp.clip(slot_grp, 0, ng - 1)
+    kp = k_pool[:, group_prefix_pages].reshape(hkv, ng, p_pre * ps, d)
+    vp = v_pool[:, group_prefix_pages].reshape(hkv, ng, p_pre * ps, d)
+    kp_s, vp_s = kp[:, gi], vp[:, gi]                     # [Hkv, S, T1, D]
+    logits1 = jnp.einsum("shrd,hstd->shrt", qr,
+                         kp_s.astype(jnp.float32)) * scale
+    pos1 = jnp.arange(p_pre * ps)[None, :]
+    valid1 = pos1 < pre_tok                               # [S, T1]
+    logits1 = jnp.where(valid1[:, None, None, :], logits1, NEG_INF)
+    m1 = jnp.max(logits1, axis=-1)                        # [S, Hkv, rep]
+    e1 = jnp.exp(logits1 - m1[..., None])
+    e1 = jnp.where(valid1[:, None, None, :], e1, 0.0)
+    l1 = jnp.sum(e1, axis=-1)
+    acc1 = jnp.einsum("shrt,hstd->shrd", e1, vp_s.astype(jnp.float32))
+    grouped = (slot_grp >= 0)[:, None, None]
+    m1 = jnp.where(grouped, m1, NEG_INF)
+    l1 = jnp.where(grouped, l1, 0.0)
+    acc1 = jnp.where(grouped[..., None], acc1, 0.0)
+
+    # phase 2: each slot's own pages PAST the prefix
+    k2 = k_pool[:, page_table].reshape(hkv, s, p * ps, d)
+    v2 = v_pool[:, page_table].reshape(hkv, s, p * ps, d)
+    logits2 = jnp.einsum("shrd,hstd->shrt", qr,
+                         k2.astype(jnp.float32)) * scale
+    pos2 = jnp.arange(p * ps)[None, :]
+    valid2 = ((pos2 >= pre_tok)
+              & (pos2 < jnp.maximum(seq_lens, 1)[:, None]))
+    logits2 = jnp.where(valid2[:, None, None, :], logits2, NEG_INF)
+    m2 = jnp.max(logits2, axis=-1)
+    e2 = jnp.exp(logits2 - m2[..., None])
+    e2 = jnp.where(valid2[:, None, None, :], e2, 0.0)
+    l2 = jnp.sum(e2, axis=-1)
+    acc2 = jnp.einsum("shrt,hstd->shrd", e2, v2.astype(jnp.float32))
+
+    # LSE merge (NEG_INF is finite, so the alphas stay NaN-free: an empty
+    # side contributes l=0 and its alpha multiplies nothing)
+    m = jnp.maximum(m1, m2)
+    a1 = jnp.exp(m1 - m)
+    a2 = jnp.exp(m2 - m)
+    l = a1 * l1 + a2 * l2
+    acc = a1[..., None] * acc1 + a2[..., None] * acc2
+    out = acc / jnp.maximum(l, 1e-30)[..., None]
+    return out.reshape(s, hq, d).astype(q.dtype)
+
+
+def _grouped_prefix_kernel(pre_pages_ref, pre_lens_ref,  # scalar prefetch
+                           q_ref,      # [1, Hkv, GR, D] (group's stacked q)
+                           k_ref,      # [Hkv, 1, page_size, D]
+                           v_ref,
+                           acc_out_ref,  # [1, Hkv, GR, D] f32 unnormalized
+                           m_out_ref,    # [1, Hkv, GR, 128] f32 (col 0)
+                           l_out_ref,
+                           m_ref, l_ref, acc_ref,  # VMEM scratch
+                           *, page_size: int, scale: float):
+    """Phase 1: one (group, prefix_page) program. The page block is DMA'd
+    once and attends against ALL G·rep stacked queries of the group — the
+    HBM stream the per-slot kernel pays G times happens once, and the
+    q·kᵀ contraction is a [GR, page] MXU matmul. Outputs are the group's
+    flash stats; normalization happens in phase 2's merge. Empty seats /
+    GR padding compute garbage rows that no slot ever gathers."""
+    import jax.experimental.pallas as pl
+
+    g = pl.program_id(0)
+    p = pl.program_id(1)
+    pre_len = pre_lens_ref[g]
+    n_pages = (pre_len + page_size - 1) // page_size  # 0 for pad group rows
+
+    @pl.when(p == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    @pl.when(p < n_pages)
+    def _work():
+        q = q_ref[0].astype(jnp.float32)     # [Hkv, GR, D]
+        k = k_ref[:, 0].astype(jnp.float32)  # [Hkv, page_size, D]
+        v = v_ref[:, 0].astype(jnp.float32)
+        logits = jax.lax.dot_general(
+            q, k, (((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32) * scale  # [Hkv, GR, page]
+        pos = p * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, logits.shape, 2)
+        logits = jnp.where(pos < pre_len, logits, NEG_INF)
+
+        m_prev = m_ref[:, :, :1]
+        l_prev = l_ref[:, :, :1]
+        m_cur = jnp.max(logits, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        probs = jnp.exp(logits - m_new)
+        l_new = alpha * l_prev + jnp.sum(probs, axis=-1, keepdims=True)
+        pv = jax.lax.dot_general(
+            probs, v, (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)          # [Hkv, GR, D]
+        acc_ref[:] = acc_ref[:] * alpha + pv
+        m_ref[:, :, :1] = m_new
+        l_ref[:, :, :1] = l_new
+
+    @pl.when((p == n_pages - 1) & (n_pages > 0))
+    def _finish():
+        acc_out_ref[0] = acc_ref[:]
+        m_out_ref[0] = m_ref[:]
+        l_out_ref[0] = l_ref[:]
+
+
+def _grouped_suffix_kernel(pt_ref, lens_ref, npre_ref,  # scalar prefetch
+                           q_ref,     # [1, Hkv, rep, D]
+                           m1_ref,    # [1, Hkv, rep_pad, 128] phase-1 m
+                           l1_ref,
+                           acc1_ref,  # [1, Hkv, rep_pad, D]
+                           k_ref, v_ref,
+                           out_ref,
+                           m_ref, l_ref, acc_ref,  # VMEM scratch
+                           *, page_size: int, scale: float):
+    """Phase 2: the per-slot kernel over the slot's pages PAST its phase-1
+    prefix (page column ``npre + p``), with the online-softmax state
+    INITIALIZED from phase 1's (m, l, acc) — the rescale every page
+    iteration already performs IS the flash log-sum-exp merge. Ungrouped
+    slots arrive with (NEG_INF, 0, 0) and reduce to the plain kernel."""
+    import jax.experimental.pallas as pl
+
+    s = pl.program_id(0)
+    p = pl.program_id(1)
+    seq_len = lens_ref[s]
+    npre = npre_ref[s]
+    n_tot = (jnp.maximum(seq_len, 1) + page_size - 1) // page_size
+    n_sfx = jnp.maximum(n_tot - npre, 1)  # active slots always own >= 1
+
+    @pl.when(p == 0)
+    def _init():
+        m_ref[:] = m1_ref[0]
+        l_ref[:] = l1_ref[0]
+        acc_ref[:] = acc1_ref[0]
+
+    @pl.when(p < n_sfx)
+    def _work():
+        q = q_ref[0].astype(jnp.float32)     # [Hkv, rep, D]
+        k = k_ref[:, 0].astype(jnp.float32)  # [Hkv, page_size, D]
+        v = v_ref[:, 0].astype(jnp.float32)
+        rep = q.shape[1]
+        logits = jax.lax.dot_general(
+            q, k, (((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32) * scale
+        pos = (npre + p) * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, logits.shape, 2)
+        logits = jnp.where(pos < jnp.maximum(seq_len, 1), logits, NEG_INF)
+
+        m_prev = m_ref[:, :rep, :1]
+        l_prev = l_ref[:, :rep, :1]
+        m_cur = jnp.max(logits, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        probs = jnp.exp(logits - m_new)
+        l_new = alpha * l_prev + jnp.sum(probs, axis=-1, keepdims=True)
+        pv = jax.lax.dot_general(
+            probs, v, (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)
+        acc_ref[:, :rep, :] = acc_ref[:, :rep, :] * alpha + pv
+        m_ref[:, :rep, :1] = m_new
+        l_ref[:, :rep, :1] = l_new
+
+    @pl.when(p == n_sfx - 1)
+    def _finish():
+        rep = out_ref.shape[2]
+        out_ref[0] = (
+            acc_ref[:, :rep, :] / jnp.maximum(l_ref[:, :rep, :1], 1e-30)
+        ).astype(out_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("scale", "interpret"))
+def grouped_paged_attention_pallas(
+    q: jnp.ndarray,
+    k_pool: jnp.ndarray,
+    v_pool: jnp.ndarray,
+    page_table: jnp.ndarray,
+    seq_lens: jnp.ndarray,
+    group_slots: jnp.ndarray,
+    group_prefix_pages: jnp.ndarray,
+    group_prefix_lens: jnp.ndarray,
+    scale: float | None = None,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Two pallas_calls + a small XLA gather between them.
+
+    Phase 1 produces per-GROUP stats [NG, Hkv, G·rep, D]; the inter-phase
+    gather re-keys them per SLOT ([S, Hkv, rep, D] — a few MB) so phase
+    2's BlockSpec stays a plain per-slot index map and no in-kernel
+    dynamic slicing (Mosaic sublane-offset restrictions) is needed.
+    Ungrouped slots substitute (NEG_INF, 0, 0) in that gather.
+    """
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    s, hq, d = q.shape
+    hkv, _n_pool, page_size, _ = k_pool.shape
+    p = page_table.shape[1]
+    ng, gmax = group_slots.shape
+    p_pre = group_prefix_pages.shape[1]
+    rep = hq // hkv
+    scale = scale if scale is not None else d ** -0.5
+    rep_pad = max(rep, 8)
+    gr = gmax * rep
+    gr_pad = max(8, -(-gr // 8) * 8)
+
+    qr = q.reshape(s, hkv, rep, d)
+    slot_grp, slot_col, slot_npre = _group_slot_maps(
+        group_slots, group_prefix_lens, s, page_size)
+
+    # ---- phase 1: one stream of the shared prefix per group ----
+    flat = jnp.clip(group_slots.reshape(-1), 0, s - 1)
+    qg = qr[flat].reshape(ng, gmax, hkv, rep, d)
+    qg = qg.transpose(0, 2, 1, 3, 4).reshape(ng, hkv, gr, d)
+    if gr_pad != gr:
+        qg = jnp.pad(qg, ((0, 0), (0, 0), (0, gr_pad - gr), (0, 0)))
+    grid1 = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(ng, p_pre),
+        in_specs=[
+            pl.BlockSpec((1, hkv, gr_pad, d),
+                         lambda gi, pi, pp, plen: (gi, 0, 0, 0)),
+            pl.BlockSpec((hkv, 1, page_size, d),
+                         lambda gi, pi, pp, plen: (0, pp[gi, pi], 0, 0)),
+            pl.BlockSpec((hkv, 1, page_size, d),
+                         lambda gi, pi, pp, plen: (0, pp[gi, pi], 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, hkv, gr_pad, d),
+                         lambda gi, pi, pp, plen: (gi, 0, 0, 0)),
+            pl.BlockSpec((1, hkv, gr_pad, 128),
+                         lambda gi, pi, pp, plen: (gi, 0, 0, 0)),
+            pl.BlockSpec((1, hkv, gr_pad, 128),
+                         lambda gi, pi, pp, plen: (gi, 0, 0, 0)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((hkv, gr_pad, 128), jnp.float32),  # m (col 0)
+            pltpu.VMEM((hkv, gr_pad, 128), jnp.float32),  # l
+            pltpu.VMEM((hkv, gr_pad, d), jnp.float32),    # acc
+        ],
+    )
+    acc1, m1, l1 = pl.pallas_call(
+        functools.partial(_grouped_prefix_kernel, page_size=page_size,
+                          scale=scale),
+        out_shape=[jax.ShapeDtypeStruct((ng, hkv, gr_pad, d), jnp.float32),
+                   jax.ShapeDtypeStruct((ng, hkv, gr_pad, 128), jnp.float32),
+                   jax.ShapeDtypeStruct((ng, hkv, gr_pad, 128), jnp.float32)],
+        grid_spec=grid1,
+        interpret=interpret,
+    )(group_prefix_pages.astype(jnp.int32),
+      group_prefix_lens.astype(jnp.int32), qg, k_pool, v_pool)
+
+    # ---- inter-phase gather: group stats → per-slot init blocks ----
+    gi = jnp.clip(slot_grp, 0, ng - 1)
+    rows = (slot_col * rep)[:, None] + jnp.arange(rep)[None]   # [S, rep]
+    ridx = rows[:, None, :, None]                              # [S,1,rep,1]
+
+    def per_slot(a, fill, width):
+        g = jnp.take_along_axis(
+            a[gi], jnp.broadcast_to(ridx, (s, hkv, rep, width)), axis=2)
+        g = jnp.where((slot_grp >= 0)[:, None, None, None], g, fill)
+        if rep_pad != rep:
+            g = jnp.pad(g, ((0, 0), (0, 0), (0, rep_pad - rep), (0, 0)),
+                        constant_values=fill)
+        return g
+
+    m1s = per_slot(m1, NEG_INF, 128)
+    l1s = per_slot(l1, 0.0, 128)
+    acc1s = per_slot(acc1, 0.0, d)
+
+    # ---- phase 2: per-slot suffix pages, merged via the init state ----
+    grid2 = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(s, p),
+        in_specs=[
+            pl.BlockSpec((1, hkv, rep, d),
+                         lambda si, pi, pt, sl, npre: (si, 0, 0, 0)),
+            pl.BlockSpec((1, hkv, rep_pad, 128),
+                         lambda si, pi, pt, sl, npre: (si, 0, 0, 0)),
+            pl.BlockSpec((1, hkv, rep_pad, 128),
+                         lambda si, pi, pt, sl, npre: (si, 0, 0, 0)),
+            pl.BlockSpec((1, hkv, rep_pad, d),
+                         lambda si, pi, pt, sl, npre: (si, 0, 0, 0)),
+            pl.BlockSpec(
+                (hkv, 1, page_size, d),
+                lambda si, pi, pt, sl, npre:
+                (0, pt[si, jnp.minimum(npre[si] + pi, pt.shape[1] - 1)],
+                 0, 0)),
+            pl.BlockSpec(
+                (hkv, 1, page_size, d),
+                lambda si, pi, pt, sl, npre:
+                (0, pt[si, jnp.minimum(npre[si] + pi, pt.shape[1] - 1)],
+                 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, hkv, rep, d),
+                               lambda si, pi, pt, sl, npre: (si, 0, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((hkv, rep_pad, 128), jnp.float32),
+            pltpu.VMEM((hkv, rep_pad, 128), jnp.float32),
+            pltpu.VMEM((hkv, rep_pad, d), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(_grouped_suffix_kernel, page_size=page_size,
+                          scale=scale),
+        out_shape=jax.ShapeDtypeStruct((s, hkv, rep, d), q.dtype),
+        grid_spec=grid2,
+        interpret=interpret,
+    )(page_table.astype(jnp.int32), seq_lens.astype(jnp.int32), slot_npre,
+      qr, m1s, l1s, acc1s, k_pool, v_pool)
+    return out.reshape(s, hq, d)
+
+
+def grouped_paged_attention(q, k_pool, v_pool, page_table, seq_lens,
+                            group_slots, group_prefix_pages,
+                            group_prefix_lens, scale=None):
+    """Dispatch: two-phase Pallas kernels on TPU, two-phase jnp oracle
+    elsewhere. Override with POLYRL_GROUPED_ATTN=ref|pallas (the ``ref``
+    escape hatch also lets a TPU deployment fall back if the grouped
+    lowering regresses on a new Mosaic — the ungrouped ``lib`` kernel
+    remains the non-grouped dispatches' path either way)."""
+    impl = os.environ.get("POLYRL_GROUPED_ATTN", "")
+    if impl == "ref":
+        return grouped_paged_attention_ref(
+            q, k_pool, v_pool, page_table, seq_lens, group_slots,
+            group_prefix_pages, group_prefix_lens, scale)
+    if impl == "pallas" or jax.default_backend() == "tpu":
+        return grouped_paged_attention_pallas(
+            q, k_pool, v_pool, page_table, seq_lens, group_slots,
+            group_prefix_pages, group_prefix_lens, scale,
+            interpret=jax.default_backend() != "tpu")
+    return grouped_paged_attention_ref(
+        q, k_pool, v_pool, page_table, seq_lens, group_slots,
+        group_prefix_pages, group_prefix_lens, scale)
+
+
+def make_tp_grouped_paged_attention(mesh):
+    """Tensor-parallel wrapper for the grouped kernel: q and both pools
+    shard over tp on the head dim exactly like ``make_tp_paged_attention``
+    (the grouped pallas calls are custom calls GSPMD cannot partition);
+    the group tables are control metadata and stay replicated."""
+    from jax.sharding import PartitionSpec as P
+
+    from polyrl_tpu.parallel.mesh import TP
+
+    def inner(q, k_pool, v_pool, page_table, seq_lens, group_slots,
+              group_prefix_pages, group_prefix_lens):
+        return grouped_paged_attention(
+            q, k_pool, v_pool, page_table, seq_lens, group_slots,
+            group_prefix_pages, group_prefix_lens)
+
+    return jax.shard_map(
+        inner, mesh=mesh,
+        in_specs=(P(None, TP, None), P(TP, None, None, None),
+                  P(TP, None, None, None), P(), P(), P(), P(), P()),
+        out_specs=P(None, TP, None), check_vma=False)
 
 
 def _kv_write_kernel(page_ref, off_ref,  # scalar prefetch

@@ -12,6 +12,11 @@ sglang_http_async_engine.py:286-298). Design:
 - Paged KV: slots own page lists from a shared pool
   (``decoder.make_paged_pools``); attention is
   ``ops.paged_attention`` (Pallas on TPU). No shape buckets in decode.
+  Dispatches with live GRPO groups route through the two-phase GROUPED
+  kernel (``grouped_paged_attention``): one HBM stream of the group's
+  shared prompt KV serves every sibling per decode step, suffixes merge
+  via the flash LSE — the group tables ride each dispatch as traced data
+  (ARCHITECTURE.md "Shared-prefix decode attention").
 - Admission: FUSED async prefill (compiled per prompt bucket) — one packed
   int32 control upload per request; the prefill inserts the slot into the
   device-resident control state and the first token joins the deferred
@@ -203,6 +208,8 @@ class CBEngine:
         admit_wave: int | None = None,
         admit_reorder_window: int = 8,
         group_share: bool = True,
+        decode_group_share: bool = True,
+        group_preref_ttl_s: float | None = None,
     ):
         if any(b % page_size for b in prompt_buckets):
             raise ValueError("prompt buckets must be page-aligned")
@@ -377,6 +384,25 @@ class CBEngine:
         # whose siblings never arrive, disbanded on any cache flush.
         # Guarded by _pool_lock (same discipline as the prefix cache).
         self._group_prerefs: dict[str, dict] = {}
+        # sibling-wait pre-ref expiry (config rollout.group_preref_ttl_s;
+        # the class attr stays as the compatibility default)
+        self.group_preref_ttl_s = float(
+            group_preref_ttl_s if group_preref_ttl_s is not None
+            else self.GROUP_PREREF_TTL_S)
+
+        # shared-prefix decode attention (ARCHITECTURE.md "Shared-prefix
+        # decode attention"): decode group table — group_id → the group's
+        # shared prefix page chain + the live member slots. Decode
+        # dispatches with >=2 live members per group route through the
+        # two-phase grouped paged-attention kernel (ONE HBM stream of the
+        # prompt KV per group instead of one per sibling); singleton
+        # leftovers and decode_group_share=False degrade to the ungrouped
+        # kernel (bitwise the pre-PR decode path). Loop-thread only.
+        self.decode_group_share = bool(decode_group_share)
+        self._decode_groups: dict[str, dict] = {}
+        self._slot_decode_gid: dict[int, str] = {}
+        self._grouped_attn = None  # built lazily (TP wrapper under a mesh)
+        self.grouped_decode_dispatches = 0  # dispatches that ran grouped
 
         # token-level continuous generation (partial-rollout salvage): on
         # abort/preempt/shutdown the run-ahead pipeline is DRAINED into the
@@ -472,7 +498,7 @@ class CBEngine:
 
     # -- compiled pieces ----------------------------------------------------
 
-    def _get_step(self, use_filters: bool, k: int = 1):
+    def _get_step(self, use_filters: bool, k: int = 1, gshape=None):
         """``k`` fused decode steps per dispatch, state advanced on device.
 
         The host loop keeps np mirrors for admission decisions but never
@@ -486,22 +512,46 @@ class CBEngine:
         tokens for the remaining iterations (filtered host-side); inactive
         slots' KV writes are routed to the null page (their freed pages may
         already belong to another request — see forward_paged_decode's
-        ``active`` mask). Outputs are [k, slots]."""
-        key = (use_filters, k)
+        ``active`` mask). Outputs are [k, slots].
+
+        ``gshape=(ng, gmax, p_pre)`` compiles the shared-prefix GROUPED
+        variant: the step takes one extra packed int32 vector carrying the
+        dispatch's decode-group tables (seat matrix, shared prefix pages,
+        prefix lengths — traced data, so membership churn never retraces)
+        and the decode attention routes through the two-phase grouped
+        kernel. The shape triple is bucketed by ``_decode_group_pack`` so
+        the jit cache stays bounded; ``gshape=None`` is the unchanged
+        ungrouped step (bitwise the pre-grouping compiled fn — the
+        ``decode_group_share=false`` / singleton degrade path)."""
+        key = (use_filters, k, gshape)
         if key not in self._step_fns:
             cfg, pad = self.cfg, self.pad_token_id
             paged_attn = self._tp_paged_attn()
             kv_write = self._tp_kv_write()
+            grouped_attn = self._grouped_attn_fn() if gshape else None
+            ng, gmax, p_pre = gshape or (0, 0, 0)
 
             def step(params, kp, vp, rng, page_table, seq_lens, last_tokens,
                      n_generated, budgets, active, temps, top_ps, top_ks,
-                     stop_table):
+                     stop_table, group_pack=None):
+                if gshape is not None:
+                    o = ng * gmax
+                    g_slots = group_pack[:o].reshape(ng, gmax)
+                    g_pages = group_pack[o:o + ng * p_pre].reshape(ng, p_pre)
+                    g_lens = group_pack[o + ng * p_pre:o + ng * p_pre + ng]
+
+                    def attn(q, kp_, vp_, pt, lens):
+                        return grouped_attn(q, kp_, vp_, pt, lens, g_slots,
+                                            g_pages, g_lens)
+                else:
+                    attn = paged_attn
+
                 def body(carry, _):
                     kp, vp, rng, seq_lens, last_tokens, n_generated, active = carry
                     logits, (kp, vp) = decoder.forward_paged_decode(
                         params, cfg, last_tokens, seq_lens, (kp, vp),
                         page_table, seq_lens, active=active,
-                        attn_fn=paged_attn, kv_write_fn=kv_write)
+                        attn_fn=attn, kv_write_fn=kv_write)
                     rng, sub = jax.random.split(rng)
                     token, logp = sample_token_vec(
                         logits, sub, temps, top_ps, top_ks,
@@ -646,6 +696,24 @@ class CBEngine:
         from polyrl_tpu.ops.paged_attention import make_tp_paged_attention
 
         return make_tp_paged_attention(self.mesh)
+
+    def _grouped_attn_fn(self):
+        """The grouped two-phase decode attention callable (built once):
+        shard_mapped over the head dim under a tp>1 mesh (same custom-call
+        constraint as ``_tp_paged_attn``), the plain dispatcher (Pallas on
+        TPU, jnp oracle elsewhere) otherwise. The group tables ride as
+        replicated operands either way."""
+        if self._grouped_attn is None:
+            from polyrl_tpu.ops.paged_attention import (
+                grouped_paged_attention,
+                make_tp_grouped_paged_attention,
+            )
+
+            if self.mesh is not None and self.mesh.shape.get("tp", 1) > 1:
+                self._grouped_attn = make_tp_grouped_paged_attention(self.mesh)
+            else:
+                self._grouped_attn = grouped_paged_attention
+        return self._grouped_attn
 
     def _tp_kv_write(self):
         """Same constraint as _tp_paged_attn for the Pallas K/V write
@@ -1153,6 +1221,8 @@ class CBEngine:
         self._fail_all("engine shutdown",
                        finish_reason="abort" if self.salvage_partials
                        else "error")
+        self._decode_groups.clear()
+        self._slot_decode_gid.clear()
         if self.prefix_cache is not None:
             # a stopped engine's cached KV (including salvage-published
             # pages) is dead weight: hand every unreferenced page back so
@@ -1304,6 +1374,8 @@ class CBEngine:
         self._inflight_tok[:] = 0
         self._invalidate_dev_state()
         self._fail_all("engine error")
+        self._decode_groups.clear()
+        self._slot_decode_gid.clear()
         with self._pool_lock:
             self._abort_chunk_jobs()
             if self.prefix_cache is not None:
@@ -1320,7 +1392,9 @@ class CBEngine:
         self.num_queued = len(self._pending)
 
     ADMIT_WAVE = 8  # max admissions fused into one batched prefill dispatch
-    GROUP_PREREF_TTL_S = 30.0  # sibling-wait pre-ref expiry (dropped groups)
+    # sibling-wait pre-ref expiry default; the LIVE value is the
+    # ``group_preref_ttl_s`` ctor arg / rollout.group_preref_ttl_s knob
+    GROUP_PREREF_TTL_S = 30.0
 
     def _admit(self) -> None:
         self._sweep_group_prerefs()
@@ -1583,6 +1657,10 @@ class CBEngine:
             self.deck.on_admit(slot, req.rid, req.t_submit, n_prompt)
             self._consume_group_preref(req)
             self._register_group_prerefs(req, entries)
+            # leader seat: its first full prompt pages ARE the chain the
+            # siblings will attach to (publish keeps the ids)
+            self._register_decode_group(
+                req, slot, max(0, (n_prompt - 1) // self.page_size), row)
             idxs.append((slot, int(self._slot_gen[slot])))
         self._enqueue_output(("prefillb", (token, logp, done), idxs,
                               self.weight_version))
@@ -1662,6 +1740,9 @@ class CBEngine:
             self.deck.on_admit(slot, req.rid, req.t_submit, n_prompt,
                                cached_tokens=prefix_len)
             self._consume_group_preref(req)
+            # sibling seat: the attach wave's matched pages are exactly the
+            # leader's published chain (row's leading columns)
+            self._register_decode_group(req, slot, attach_pages, row)
             idxs.append((slot, int(self._slot_gen[slot])))
         self.sibling_attach_dispatches += 1
         self.group_forked_requests += len(wave)
@@ -1710,7 +1791,7 @@ class CBEngine:
             return
         now = time.monotonic()
         for gid in [g for g, v in self._group_prerefs.items()
-                    if now - v["t"] > self.GROUP_PREREF_TTL_S]:
+                    if now - v["t"] > self.group_preref_ttl_s]:
             g = self._group_prerefs.pop(gid)
             if self.prefix_cache is not None:
                 for _ in range(max(0, g["remaining"])):
@@ -1726,6 +1807,103 @@ class CBEngine:
                 for _ in range(max(0, g["remaining"])):
                     self.prefix_cache.release(g["entries"])
         self._group_prerefs.clear()
+
+    # -- shared-prefix decode groups -----------------------------------------
+
+    def _register_decode_group(self, req: _Request, slot: int,
+                               n_pre_pages: int, prefix_pages) -> None:
+        """Seat ``slot`` in its GRPO group's decode-sharing table. The seat
+        is only taken when the member's leading page-table columns are the
+        group's EXACT physical prefix chain (the PR-8 indirection is what
+        makes one HBM stream serve everyone) — a member admitted after a
+        cache flush re-prefilled onto fresh pages and must not join the
+        old cohort (it keeps decoding correctly via the ungrouped path).
+        Loop-thread only; membership leaves through ``_finalize``."""
+        if (not self.decode_group_share or not self.group_share
+                or self.prefix_cache is None or not req.group_id
+                or req.group_size <= 1 or n_pre_pages <= 0):
+            return
+        pages_t = tuple(int(p) for p in list(prefix_pages)[:n_pre_pages])
+        if len(pages_t) < n_pre_pages:
+            return
+        g = self._decode_groups.get(req.group_id)
+        if g is None or not g["slots"]:
+            g = {"n_pre": int(n_pre_pages), "pages": pages_t, "slots": set()}
+            self._decode_groups[req.group_id] = g
+        if g["n_pre"] != n_pre_pages or g["pages"] != pages_t:
+            return  # different physical prefix (flush mid-group): stay solo
+        g["slots"].add(slot)
+        self._slot_decode_gid[slot] = req.group_id
+
+    def _drop_decode_seat(self, slot: int) -> None:
+        gid = self._slot_decode_gid.pop(slot, None)
+        if gid is None:
+            return
+        g = self._decode_groups.get(gid)
+        if g is not None:
+            g["slots"].discard(slot)
+            if not g["slots"]:
+                del self._decode_groups[gid]
+
+    @staticmethod
+    def _pow2(n: int) -> int:
+        b = 1
+        while b < n:
+            b *= 2
+        return b
+
+    def _decode_group_pack(self):
+        """Build this dispatch's decode-group tables from the host registry:
+        (packed int32 vector, bucketed (ng, gmax, p_pre) jit key, the
+        group rows used — for the KV-read ledger), or (None, None, ())
+        when nothing shares. Only groups with >=2 mirror-ACTIVE members
+        pack (a lone survivor degrades to the ungrouped kernel — its page
+        row still holds the whole sequence); every dimension buckets to a
+        power of two so the compiled-step cache stays bounded."""
+        if not self.decode_group_share or self.spec_tokens > 0:
+            return None, None, ()
+        rows = []
+        for g in self._decode_groups.values():
+            live = sorted(s for s in g["slots"] if self._active[s])
+            if len(live) >= 2:
+                rows.append((live, g["n_pre"], g["pages"]))
+        if not rows:
+            return None, None, ()
+        ng = self._pow2(len(rows))
+        gmax = self._pow2(max(len(r[0]) for r in rows))
+        p_pre = self._pow2(max(r[1] for r in rows))
+        g_slots = np.full((ng, gmax), -1, np.int32)
+        g_pages = np.zeros((ng, p_pre), np.int32)
+        g_lens = np.zeros((ng,), np.int32)
+        for i, (live, n_pre, pages) in enumerate(rows):
+            g_slots[i, :len(live)] = live
+            g_pages[i, :n_pre] = pages[:n_pre]
+            g_lens[i] = n_pre * self.page_size
+        pack = np.concatenate([g_slots.ravel(), g_pages.ravel(), g_lens])
+        return pack, (ng, gmax, p_pre), rows
+
+    def _account_kv_reads(self, group_rows, k: int,
+                          k_tokens: int | None = None) -> None:
+        """Dispatch-time KV-read ledger (host mirrors, no device work):
+        LOGICAL pages = what every active slot attends; STREAMED = what the
+        kernels actually pull from HBM — each packed group's prefix chain
+        counts ONCE instead of once per member. Page counts are sampled at
+        dispatch time (the k fused steps may each cross at most one page
+        boundary — a <1-page-per-slot estimate error, documented in the
+        flight deck). ``k_tokens`` decouples the emission floor from the
+        attention-row count for spec dispatches (m verify rows per round
+        but >=1 emitted token per round)."""
+        active_idx = np.flatnonzero(self._active)
+        if active_idx.size == 0:
+            return
+        pages_tot = self._seq_lens[active_idx] // self.page_size + 1
+        logical = int(pages_tot.sum())
+        streamed = logical
+        for live, n_pre, _pages in group_rows:
+            streamed -= (len(live) - 1) * n_pre
+        self.deck.on_kv_read(
+            streamed * k, logical * k,
+            int(active_idx.size) * (k if k_tokens is None else k_tokens))
 
     def _prefill_request(self, slot: int, req: _Request, pages: list[int],
                          budget: int, matched_pages: list[int] | None = None,
@@ -1794,6 +1972,11 @@ class CBEngine:
             matched_entries += [e for _, e in published]
         self._consume_group_preref(req)
         self._register_group_prerefs(req, matched_entries)
+        # singleton admission (leader, full/partial hit, chunk final): the
+        # full prompt chain is cached after this dispatch's publish, so the
+        # seat key is the first n_full page ids — identical across members
+        self._register_decode_group(
+            req, slot, max(0, (n_prompt - 1) // self.page_size), row)
 
         # host mirrors: everything except the (device-side) first token;
         # _emit_prefill fills last_tokens when the output is drained, and
@@ -2199,16 +2382,24 @@ class CBEngine:
         self._ensure_dev_state()
         self._tmark("upload", t0)
         st = self._dev_state
-        fn = self._get_step(use_filters, self.steps_per_dispatch)
+        # shared-prefix grouped decode: pack the live group tables (one
+        # small int32 upload riding the dispatch — membership churn changes
+        # DATA, not the compiled step, as long as the bucketed shape holds)
+        gpack, gshape, group_rows = self._decode_group_pack()
+        fn = self._get_step(use_filters, self.steps_per_dispatch, gshape)
         t0 = time.monotonic()
+        args = (self.params, self._pools[0], self._pools[1], self._rng,
+                st["page_table"], st["seq_lens"], st["last_tokens"],
+                st["n_generated"], st["budgets"], st["active"], st["temps"],
+                st["top_ps"], st["top_ks"], st["stop_table"])
+        if gshape is not None:
+            args = args + (jnp.asarray(gpack),)
+            self.grouped_decode_dispatches += 1
         (kp, vp, self._rng, token, logp, done, st["seq_lens"],
-         st["last_tokens"], st["n_generated"], st["active"]) = fn(
-            self.params, self._pools[0], self._pools[1], self._rng,
-            st["page_table"], st["seq_lens"], st["last_tokens"],
-            st["n_generated"], st["budgets"], st["active"], st["temps"],
-            st["top_ps"], st["top_ks"], st["stop_table"])
+         st["last_tokens"], st["n_generated"], st["active"]) = fn(*args)
         self._tmark("step_dispatch", t0)
         self._pools = (kp, vp)
+        self._account_kv_reads(group_rows, self.steps_per_dispatch)
         self._inflight_tok[self._active] += self.steps_per_dispatch
         self._enqueue_output(("step", (token, logp, done),
                              [(int(i), int(self._slot_gen[i]))
@@ -2336,6 +2527,11 @@ class CBEngine:
             st["stop_table"])
         self._tmark("spec_dispatch", t0)
         self._pools = (kp, vp)
+        # spec verify attends m virtual rows per slot per round, all over
+        # the slot's own pages (grouped decode is decode-path only);
+        # tokens normalized by the >=1-per-round emission floor
+        self._account_kv_reads((), self.spec_rounds * m,
+                               k_tokens=self.spec_rounds)
         self.spec_dispatches += 1
         # acceptance ceiling: every active slot could emit up to
         # rounds * (spec_tokens+1) tokens from this dispatch
@@ -2372,6 +2568,11 @@ class CBEngine:
 
     def _finalize(self, slot: int) -> None:
         self.deck.on_finalize(slot)
+        # leave the decode group FIRST: the next dispatch must not seat a
+        # finalized slot (its freed pages may be reallocated; in-flight
+        # dispatches that still carry the old seat only produce garbage for
+        # this now-inactive slot, which emission filters)
+        self._drop_decode_seat(slot)
         info = self._slots[slot]
         if info is not None:
             self.allocator.free(info.pages)

@@ -131,6 +131,18 @@ class EngineFlightDeck:
         # like sched_prefill_tokens — reuse is a scheduler-side property.
         self.cached_prompt_tokens = 0
 
+        # KV-read ledger (shared-prefix decode attention): pages the decode
+        # kernels actually STREAM from HBM vs pages LOGICALLY attended —
+        # each decode group's shared prefix streams once per group instead
+        # of once per sibling, and this ledger is what quantifies the
+        # bandwidth actually deduplicated (engine/kv_read_pages_per_token,
+        # engine/shared_prefix_read_frac). Counts are dispatch-time
+        # estimates from the host mirrors (each fused step can cross at
+        # most one page boundary past the sample).
+        self.kv_pages_streamed = 0
+        self.kv_pages_logical = 0
+        self.kv_read_tokens = 0
+
         # scheduler step ledger (updated per decode dispatch / admission)
         self.decode_dispatches = 0
         self.idle_iters = 0
@@ -261,6 +273,14 @@ class EngineFlightDeck:
             self.hists["occupancy"].observe(occ)
             self.hists["page_util"].observe(util)
 
+    def on_kv_read(self, streamed_pages: int, logical_pages: int,
+                   tokens: int) -> None:
+        """One decode dispatch's KV-read sample (``_account_kv_reads``)."""
+        with self._lock:
+            self.kv_pages_streamed += int(streamed_pages)
+            self.kv_pages_logical += int(logical_pages)
+            self.kv_read_tokens += int(tokens)
+
     def on_idle(self) -> None:
         with self._lock:
             self.idle_iters += 1
@@ -284,6 +304,23 @@ class EngineFlightDeck:
             return 0.0
         return self.cached_prompt_tokens / self.sched_prefill_tokens
 
+    def kv_read_pages_per_token(self) -> float:
+        """KV pages streamed from HBM per decoded token — the bandwidth
+        cost the shared-prefix decode kernel attacks. 0.0 before any
+        decode dispatch."""
+        if self.kv_read_tokens == 0:
+            return 0.0
+        return self.kv_pages_streamed / self.kv_read_tokens
+
+    def shared_prefix_read_frac(self) -> float:
+        """Fraction of logically-attended KV pages the decode kernels did
+        NOT re-stream (deduplicated by the grouped prefix phase). 0.0 with
+        sharing off or no group traffic; → (G−1)/G · prefix share of the
+        sequence on a pure G-sibling workload."""
+        if self.kv_pages_logical == 0:
+            return 0.0
+        return 1.0 - self.kv_pages_streamed / self.kv_pages_logical
+
     def server_info_fields(self) -> dict:
         """Flat keys merged into ``server_info`` — what the C++ manager's
         stats poller forwards and bench reads. Names stay flat (no ``/``)
@@ -306,6 +343,10 @@ class EngineFlightDeck:
                 "queue_wait_p95_s": round(q.percentile(95.0), 6),
                 "attributed_frac": round(self.attributed_frac(), 6),
                 "prefill_reuse_frac": round(self.prefill_reuse_frac(), 6),
+                "kv_read_pages_per_token": round(
+                    self.kv_read_pages_per_token(), 4),
+                "shared_prefix_read_frac": round(
+                    self.shared_prefix_read_frac(), 6),
             }
         return out
 
@@ -346,6 +387,13 @@ class EngineFlightDeck:
                     "peak_util": round(self.page_util_peak, 4),
                     "cache_pages": self.cache_pages_last,
                     "total": self.num_alloc_pages,
+                    # shared-prefix decode attention: HBM reads vs logical
+                    "kv_streamed": self.kv_pages_streamed,
+                    "kv_logical": self.kv_pages_logical,
+                    "kv_read_pages_per_token": round(
+                        self.kv_read_pages_per_token(), 4),
+                    "shared_prefix_read_frac": round(
+                        self.shared_prefix_read_frac(), 6),
                 },
                 "dispatch": {
                     "decode_dispatches": self.decode_dispatches,

@@ -316,6 +316,15 @@ class PoolManager:
             "engine/prefix_hit_frac": (
                 sum(float(i.get("prefix_hit_frac", 0.0)) for i in rep)
                 / len(rep)),
+            # shared-prefix decode attention: fleet-mean HBM pages streamed
+            # per decoded token and the fraction of logical KV reads the
+            # grouped kernel deduplicated (the decode-bandwidth A/B signal)
+            "engine/kv_read_pages_per_token": (
+                sum(float(i.get("kv_read_pages_per_token", 0.0))
+                    for i in rep) / len(rep)),
+            "engine/shared_prefix_read_frac": (
+                sum(float(i.get("shared_prefix_read_frac", 0.0))
+                    for i in rep) / len(rep)),
         }
 
     def engine_section(self) -> dict:
@@ -339,6 +348,10 @@ class PoolManager:
                 "attributed_frac": float(i.get("attributed_frac", 1.0)),
                 "prefill_reuse_frac": float(
                     i.get("prefill_reuse_frac", 0.0)),
+                "kv_read_pages_per_token": float(
+                    i.get("kv_read_pages_per_token", 0.0)),
+                "shared_prefix_read_frac": float(
+                    i.get("shared_prefix_read_frac", 0.0)),
                 "throughput_tok_s": float(i.get("last_gen_throughput", 0.0)),
                 "running": int(i.get("num_running_reqs", 0)),
             } for i in insts if "occupancy" in i],

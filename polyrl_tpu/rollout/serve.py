@@ -50,6 +50,8 @@ def create_server(model: str, manager_endpoint: str | None = None,
                   admit_wave: int | None = None,
                   admit_reorder_window: int = 8,
                   group_share: bool = True,
+                  decode_group_share: bool = True,
+                  group_preref_ttl_s: float | None = None,
                   fault_injector=None):
     """Build engine + server, register with the manager, attach receiver.
 
@@ -157,7 +159,8 @@ def create_server(model: str, manager_endpoint: str | None = None,
             spec_rounds=spec_rounds, pipeline_depth=pipeline_depth,
             salvage_partials=salvage_partials, admit_wave=admit_wave,
             admit_reorder_window=admit_reorder_window,
-            group_share=group_share)
+            group_share=group_share, decode_group_share=decode_group_share,
+            group_preref_ttl_s=group_preref_ttl_s)
     else:
         kwargs = {}
         if batch_buckets:
@@ -277,6 +280,13 @@ def main() -> None:
     p.add_argument("--no-group-share", action="store_true",
                    help="disable group-shared prefill (siblings admit as "
                         "singleton suffix dispatches — the A/B baseline)")
+    p.add_argument("--no-decode-group-share", action="store_true",
+                   help="disable shared-prefix decode attention (every "
+                        "sibling re-streams the group's prompt KV per "
+                        "decode step — the --decode-attn A/B baseline)")
+    p.add_argument("--group-preref-ttl-s", type=float, default=None,
+                   help="sibling-wait pre-ref expiry for groups whose "
+                        "members never arrive (default 30)")
     p.add_argument("--lora-rank", type=int, default=0,
                    help="LoRA delta sync: serve base + adapters; pushes "
                         "carry only adapters (match the trainer's rank)")
@@ -308,6 +318,8 @@ def main() -> None:
                            admit_wave=args.admit_wave,
                            admit_reorder_window=args.admit_reorder_window,
                            group_share=not args.no_group_share,
+                           decode_group_share=not args.no_decode_group_share,
+                           group_preref_ttl_s=args.group_preref_ttl_s,
                            lora_rank=args.lora_rank,
                            lora_alpha=args.lora_alpha)
     log.info("rollout server on %s", server.endpoint)

@@ -565,6 +565,16 @@ class RolloutServer:
             info["admit_wave"] = self.engine.admit_wave
             info["admit_reorder_window"] = self.engine.admit_reorder_window
             info["group_share"] = bool(self.engine.group_share)
+            # shared-prefix decode attention: the kernel-side group-share
+            # switch + the pre-ref TTL knob echo (both config-driven, so
+            # bench/statusz record what the engine actually ran with), and
+            # the grouped-dispatch counter the --decode-attn A/B reads
+            info["decode_group_share"] = bool(
+                getattr(self.engine, "decode_group_share", False))
+            info["group_preref_ttl_s"] = float(
+                getattr(self.engine, "group_preref_ttl_s", 0.0))
+            info["grouped_decode_dispatches"] = int(
+                getattr(self.engine, "grouped_decode_dispatches", 0))
             info["prefill_dispatches"] = self.engine.prefill_dispatches
             info["sibling_attach_dispatches"] = (
                 self.engine.sibling_attach_dispatches)
@@ -613,7 +623,8 @@ class RolloutServer:
                              "drained_requests", "spec_emitted",
                              "spec_dispatches", "prefill_dispatches",
                              "sibling_attach_dispatches",
-                             "group_forked_requests")}
+                             "group_forked_requests",
+                             "grouped_decode_dispatches")}
         counters["total_tokens_served"] = float(
             getattr(self.engine, "total_tokens_served", 0))
         if self.fault is not None:
@@ -643,15 +654,27 @@ class RolloutServer:
                     "admit_reorder_window": int(
                         self.engine.admit_reorder_window),
                     "group_share": bool(self.engine.group_share),
+                    "decode_group_share": bool(
+                        getattr(self.engine, "decode_group_share", False)),
+                    "group_preref_ttl_s": float(
+                        getattr(self.engine, "group_preref_ttl_s", 0.0)),
                     "prefill_dispatches": int(self.engine.prefill_dispatches),
                     "sibling_attach_dispatches": int(
                         self.engine.sibling_attach_dispatches),
                     "group_forked_requests": int(
                         self.engine.group_forked_requests),
+                    "grouped_decode_dispatches": int(getattr(
+                        self.engine, "grouped_decode_dispatches", 0)),
                     "prefill_reuse_frac": float(
                         info.get("prefill_reuse_frac", 0.0)),
                     "prefix_hit_frac": float(
                         info.get("prefix_hit_frac", 0.0)),
+                    # shared-prefix decode attention: streamed-vs-logical
+                    # KV page dedup (the bandwidth actually saved)
+                    "kv_read_pages_per_token": float(
+                        info.get("kv_read_pages_per_token", 0.0)),
+                    "shared_prefix_read_frac": float(
+                        info.get("shared_prefix_read_frac", 0.0)),
                 }
         return statusz.build_snapshot(
             "rollout",

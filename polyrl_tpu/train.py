@@ -118,7 +118,9 @@ def _build_rollout(cfg: RunConfig, mcfg, params, tokenizer, cleanup: list):
                 salvage_partials=cfg.rollout.salvage_partials,
                 admit_wave=cfg.rollout.admit_wave,
                 admit_reorder_window=cfg.rollout.admit_reorder_window,
-                group_share=cfg.rollout.group_share, **kwargs)
+                group_share=cfg.rollout.group_share,
+                decode_group_share=cfg.rollout.decode_group_share,
+                group_preref_ttl_s=cfg.rollout.group_preref_ttl_s, **kwargs)
         from polyrl_tpu.rollout.engine import RolloutEngine
 
         kwargs = {}
@@ -212,6 +214,8 @@ def _build_rollout(cfg: RunConfig, mcfg, params, tokenizer, cleanup: list):
             admit_wave=cfg.rollout.admit_wave,
             admit_reorder_window=cfg.rollout.admit_reorder_window,
             group_share=cfg.rollout.group_share,
+            decode_group_share=cfg.rollout.decode_group_share,
+            group_preref_ttl_s=cfg.rollout.group_preref_ttl_s,
             **({"prompt_buckets": tuple(cfg.rollout.prompt_buckets)}
                if cfg.rollout.prompt_buckets else {}))
         local_server = RolloutServer(eng, host="127.0.0.1", port=0)

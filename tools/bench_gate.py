@@ -87,6 +87,14 @@ WATCHED_EXTRA = (
     ("engine_prefill_reuse_frac", "low"),
     ("group_share.engine_prefill_reuse_frac", "low"),
     ("group_share.dispatch_reduction", "low"),
+    # shared-prefix decode attention (bench.py --decode-attn A/B + the cb
+    # phase's rl drill): the fraction of logical KV page reads the grouped
+    # kernel deduplicates must hold, the grouped-vs-ungrouped speedup must
+    # not regress, and the grouped path's HBM pages per decoded token must
+    # not creep back up toward the ungrouped cost
+    ("engine_shared_prefix_read_frac", "low"),
+    ("decode_attn.speedup", "low"),
+    ("decode_attn.kv_read_pages_per_token", "high"),
     # weight-fabric fault drill (bench.py --push-chaos): the recovery wall
     # after injected corruption + a stalled stream must not blow up, the
     # resume must stay PARTIAL (resumed bytes climbing toward the full

@@ -41,7 +41,14 @@ and the injector's ``fault/injected_*`` (rollout/faults.py ``counters``)
 distributions (``engine/ttft_s``, ``engine/tpot_s``,
 ``engine/queue_wait_s``, ``engine/prefill_s``) into the global histogram
 registry and fleet aggregates (``engine/occupancy``, ``engine/page_util``,
-``engine/ttft_p95_s``, ...) via PoolManager.counters. The training health
+``engine/ttft_p95_s``, ...) via PoolManager.counters — including the
+shared-prefix decode-attention KV-read ledger:
+``engine/kv_read_pages_per_token`` (HBM pages the decode kernels actually
+stream per decoded token) and ``engine/shared_prefix_read_frac`` (the
+fraction of logically-attended pages the grouped prefix phase
+deduplicated), fed per engine from ``EngineFlightDeck.on_kv_read`` via
+``server_info`` and aggregated fleet-wide in ``rollout/pool.py``.
+The training health
 plane (obs/rlhealth.py) emits ``training/*`` — distribution summaries
 (``training/adv_abs``, ``training/tis_weight``, ``training/staleness``,
 ...), GRPO group diagnostics (``training/degenerate_group_frac``,
