@@ -985,13 +985,17 @@ class FakeAsyncRollout:
 
 def _microbench_fit(rollout, steps: int, depth: int,
                     staleness_limit: int = 1,
-                    correction: bool | None = None) -> tuple[float, list]:
+                    correction: bool | None = None,
+                    traced: bool = False) -> tuple[float, list]:
     """One tiny CPU fit for the pipeline/async microbenches: the shared
     trainer geometry behind ``--pipeline-microbench`` and
-    ``--async-sweep`` (and their tests)."""
+    ``--async-sweep`` (and their tests). ``traced=True`` runs the fit
+    under the span tracer so the step records carry the ``critpath/*``
+    critical-path gauges (obs/critical_path.py)."""
     import jax
     import jax.numpy as jnp
 
+    from polyrl_tpu import obs
     from polyrl_tpu.data.dataset import PromptDataLoader, make_arithmetic_dataset
     from polyrl_tpu.models import decoder
     from polyrl_tpu.rewards.manager import load_reward_manager
@@ -1016,9 +1020,15 @@ def _microbench_fit(rollout, steps: int, depth: int,
         tcfg, actor, rollout, tok,
         load_reward_manager("naive", tok, num_workers=1),
         PromptDataLoader(make_arithmetic_dataset(64), 4))
-    t0 = time.monotonic()
-    hist = trainer.fit()
-    return time.monotonic() - t0, hist
+    if traced:
+        obs.configure(trace=True, max_spans=4096, reset=True)
+    try:
+        t0 = time.monotonic()
+        hist = trainer.fit()
+        return time.monotonic() - t0, hist
+    finally:
+        if traced:
+            obs.configure(trace=False, reset=True)
 
 
 def _hist_tail_mean(hist: list, key: str, tail: slice = slice(1, None)):
@@ -1143,8 +1153,11 @@ def pipeline_microbench(steps: int = 4, gen_delay_s: float = 0.4,
                 t.join(timeout)
 
     def run(depth: int) -> tuple[float, list]:
+        # the pipelined leg runs traced so its records carry the
+        # critical-path attribution promoted below (the serial leg stays
+        # untraced: its wall is the A/B baseline, keep it untouched)
         return _microbench_fit(FakeSlowRollout(gen_delay_s, push_delay_s),
-                               steps, depth)
+                               steps, depth, traced=depth > 0)
 
     wall_sync, hist_sync = run(0)
     wall_pipe, hist_pipe = run(1)
@@ -1169,8 +1182,16 @@ def pipeline_microbench(steps: int = 4, gen_delay_s: float = 0.4,
         f"training_{k}": _tail_mean(hist_pipe[tail], f"training/{k}")
         for k in ("entropy", "approx_kl", "tis_clip_frac",
                   "degenerate_group_frac")}
+    # critical-path plane extras (obs/critical_path.py, traced pipelined
+    # leg): bottleneck concentration rising, or the wall a 10% bottleneck
+    # speedup would buy growing, flags an overlap regression bench_gate
+    # watches across rounds even when tok/s held
+    critpath = {
+        f"critpath_{k}": _tail_mean(hist_pipe[tail], f"critpath/{k}")
+        for k in ("bottleneck_frac", "headroom_s")}
     return {
         **{k: v for k, v in training.items() if v is not None},
+        **{k: v for k, v in critpath.items() if v is not None},
         "steps": steps, "gen_delay_s": gen_delay_s,
         "push_delay_s": push_delay_s,
         "sync_wall_s": round(wall_sync, 2),

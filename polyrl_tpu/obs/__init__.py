@@ -23,6 +23,12 @@ The pieces (ARCHITECTURE.md "Observability"):
   RL-dynamics ledger (advantage/TIS/staleness distributions, GRPO group
   diagnostics) behind the ``training/*`` namespace, the /statusz
   ``training`` section, and ``training.json`` post-mortem bundles.
+- :mod:`polyrl_tpu.obs.critical_path` — per-step critical-path
+  extraction over the span ring: which chain of spans actually bounded
+  the step (``critpath/*`` gauges, ``critical_path.json`` bundles).
+- :mod:`polyrl_tpu.obs.timeseries` — fleet time-series rail: bounded
+  per-key rings of step snapshots with windowed aggregates + slopes (the
+  /statusz ``timeseries`` section, the autoscaling trend input).
 
 Everything here is import-light (no jax at module load) and no-op-cheap
 when tracing is disabled, so hot paths can call into it unconditionally.
@@ -32,6 +38,9 @@ from __future__ import annotations
 
 import contextlib
 
+from polyrl_tpu.obs.critical_path import (SEGMENTS,  # noqa: F401
+                                          CriticalPath,
+                                          extract_critical_path)
 from polyrl_tpu.obs.goodput import GoodputLedger  # noqa: F401
 from polyrl_tpu.obs.histogram import (Histogram, drain_histograms,  # noqa: F401
                                       observe)
@@ -39,8 +48,12 @@ from polyrl_tpu.obs.recorder import (AnomalyDetector,  # noqa: F401
                                      FlightRecorder, direction_violates)
 from polyrl_tpu.obs.rlhealth import TrainingHealthLedger  # noqa: F401
 from polyrl_tpu.obs.scrape import (manager_gauges,  # noqa: F401
-                                   parse_prometheus_text)
+                                   manager_gauges_partial,
+                                   parse_prometheus_text,
+                                   parse_prometheus_text_partial)
 from polyrl_tpu.obs.statusz import StatuszServer, build_snapshot  # noqa: F401
+from polyrl_tpu.obs.timeseries import (TimeSeriesStore,  # noqa: F401
+                                       least_squares_slope)
 from polyrl_tpu.obs.trace import Tracer, get_tracer  # noqa: F401
 
 _jax_annotations = False

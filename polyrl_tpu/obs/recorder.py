@@ -16,6 +16,10 @@ bundle into the run directory:
     ``engine.json``    — fleet flight-deck view (``engine_fn``; when wired)
     ``training.json``  — training health ledger tail + last batch's GRPO
                          group table (``training_fn``; when wired)
+    ``critical_path.json`` — the last N per-step critical paths
+                         (obs/critical_path.py via ``critical_path_fn``;
+                         when wired) — the bundle answers "what chain
+                         bounded the steps before this died"
 
 Detector design: EWMA mean + EW variance with a **median-initialized
 warmup** (the first step carries jit compiles — seeding the mean from the
@@ -210,6 +214,11 @@ class FlightRecorder:
         # an entropy-collapse bundle carries the RL-dynamics tail and the
         # last batch's GRPO group table
         self.training_fn = None
+        # optional zero-arg callable returning the recent per-step
+        # critical paths (the trainer's CriticalPath.to_dict deque) —
+        # written as critical_path.json so a stall bundle shows which
+        # chain bounded the steps leading into the anomaly
+        self.critical_path_fn = None
 
     # -- step stream ---------------------------------------------------------
 
@@ -256,9 +265,13 @@ class FlightRecorder:
         try:
             os.makedirs(path, exist_ok=True)
             from polyrl_tpu.obs import get_tracer
+            from polyrl_tpu.obs.trace import clock_anchor
 
             tracer = get_tracer()
             with open(os.path.join(path, "spans.jsonl"), "w") as f:
+                # leading monotonic↔wall anchor: the bundle's spans merge
+                # skew-free with other processes' dumps (trace2perfetto)
+                f.write(json.dumps(clock_anchor()) + "\n")
                 for rec in tracer.records():
                     f.write(json.dumps(rec) + "\n")
             with open(os.path.join(path, "steps.jsonl"), "w") as f:
@@ -290,6 +303,16 @@ class FlightRecorder:
                 if training_view:
                     with open(os.path.join(path, "training.json"), "w") as f:
                         json.dump(training_view, f, indent=2)
+            if self.critical_path_fn is not None:
+                try:
+                    cp_view = dict(self.critical_path_fn())
+                except Exception:  # noqa: BLE001 — best-effort like counters
+                    log.exception("flight recorder critical_path_fn failed")
+                    cp_view = {}
+                if cp_view:
+                    with open(os.path.join(path, "critical_path.json"),
+                              "w") as f:
+                        json.dump(cp_view, f, indent=2)
             with open(os.path.join(path, "counters.json"), "w") as f:
                 json.dump({
                     "reason": reason,

@@ -8,8 +8,9 @@ trainer mounts a standalone :class:`StatuszServer` (it has no HTTP surface
 of its own); the rollout server mounts ``/statusz`` as a route on its
 existing listener (rollout/server.py).
 
-Schema (``polyrl/statusz/v3`` — additive evolution only; v2 added the
-``engine`` section, v3 the ``training`` section):
+Schema (``polyrl/statusz/v4`` — additive evolution only; v2 added the
+``engine`` section, v3 the ``training`` section, v4 the ``timeseries``
+section; version-history table in ARCHITECTURE.md "Observability"):
 
 - ``role``      — ``trainer`` | ``rollout``
 - ``pid`` / ``time_unix_s`` / ``uptime_s``
@@ -33,8 +34,14 @@ Schema (``polyrl/statusz/v3`` — additive evolution only; v2 added the
   fraction, per-token weight-version staleness) plus a short per-step
   trend tail. Trainer role with a TrainingHealthLedger attached (the
   default); empty on the rollout plane.
+- ``timeseries`` — the fleet time-series rail (obs/timeseries.py):
+  windowed per-key aggregates (last/mean/p95/min/max + least-squares
+  slope) over the recent step snapshots — goodput phase walls, pool and
+  fleet ``engine/*`` gauges, ``training/*`` and ``critpath/*`` scalars.
+  The trainer windows its step records; the rollout server windows its
+  ``server_info`` samples (one per manager stats poll / statusz hit).
 
-Every v3 section is ALWAYS present on both planes (conformance-tested) so
+Every v4 section is ALWAYS present on both planes (conformance-tested) so
 consumers never need existence checks.
 
 ``GET /metrics`` on the same listener renders the snapshot's numeric
@@ -54,7 +61,7 @@ from typing import Callable
 
 log = logging.getLogger(__name__)
 
-SCHEMA = "polyrl/statusz/v3"
+SCHEMA = "polyrl/statusz/v4"
 _PROC_T0 = time.monotonic()
 _HIST_SUFFIXES = ("p50", "p95", "p99", "max", "mean", "count")
 
@@ -62,7 +69,8 @@ _HIST_SUFFIXES = ("p50", "p95", "p99", "max", "mean", "count")
 # conformance contract consumers (and the conformance test) rely on
 REQUIRED_SECTIONS = ("schema", "role", "pid", "time_unix_s", "uptime_s",
                      "step", "goodput", "histograms", "counters", "gauges",
-                     "queues", "weights", "pool", "engine", "training")
+                     "queues", "weights", "pool", "engine", "training",
+                     "timeseries")
 
 
 def build_snapshot(role: str, *, step: int | None = None,
@@ -74,7 +82,8 @@ def build_snapshot(role: str, *, step: int | None = None,
                    weights: dict | None = None,
                    pool: dict | None = None,
                    engine: dict | None = None,
-                   training: dict | None = None) -> dict:
+                   training: dict | None = None,
+                   timeseries: dict | None = None) -> dict:
     """The shared statusz schema; every section present (empty when the
     plane has nothing for it) so consumers never need existence checks."""
     return {
@@ -93,6 +102,7 @@ def build_snapshot(role: str, *, step: int | None = None,
         "pool": pool or {},
         "engine": engine or {},
         "training": training or {},
+        "timeseries": timeseries or {},
     }
 
 

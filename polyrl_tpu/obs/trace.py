@@ -20,6 +20,17 @@ Export is Chrome trace-event JSON (the format Perfetto/chrome://tracing
 load directly): ``export_run()`` writes ``spans.jsonl`` (raw records, one
 per line — the cross-process merge input for tools/trace2perfetto.py) and
 ``trace.json`` next to the run's JSONL metrics.
+
+Clock model: spans stamp ``ts_us`` from the wall clock (cross-process
+alignment) but ``dur_us`` AND ``ts_mono_us`` from the monotonic clock
+(an NTP step mid-span must not corrupt durations or same-process
+ordering). Each ``spans.jsonl`` leads with one ``clock_anchor`` record —
+``{"type": "clock_anchor", "pid", "wall_us", "mono_us"}``, both clocks
+sampled at the same instant — so a merger (:func:`chrome_trace`,
+tools/trace2perfetto.py) can place every span at
+``wall_us - (mono_us - span.ts_mono_us)``: monotonic spacing within a
+process, wall alignment across processes, immune to clock steps between
+the stamps.
 """
 
 from __future__ import annotations
@@ -120,6 +131,7 @@ class Tracer:
                 "span_id": span_id,
                 "parent_id": parent[1] if parent else "",
                 "ts_us": int(t0_wall * 1e6),
+                "ts_mono_us": int(t0 * 1e6),
                 "dur_us": int((time.monotonic() - t0) * 1e6),
                 "pid": os.getpid(),
                 "tid": threading.get_ident() & 0xFFFFFFFF,
@@ -163,13 +175,17 @@ class Tracer:
             return None
         os.makedirs(out_dir, exist_ok=True)
         records = self.records()
+        # ONE anchor for both artifacts: the jsonl leads with it and the
+        # inline chrome trace is placed on it, so the two dumps agree
+        anchor = clock_anchor()
         jsonl = os.path.join(out_dir, "spans.jsonl")
         with open(jsonl, "w") as f:
+            f.write(json.dumps(anchor) + "\n")
             for rec in records:
                 f.write(json.dumps(rec) + "\n")
         trace = os.path.join(out_dir, "trace.json")
         with open(trace, "w") as f:
-            json.dump(chrome_trace(records), f)
+            json.dump(chrome_trace([anchor] + records), f)
         return jsonl, trace
 
 
@@ -177,24 +193,52 @@ def _jsonable(v):
     return v if isinstance(v, (str, int, float, bool, type(None))) else repr(v)
 
 
+def clock_anchor() -> dict:
+    """One process's monotonic↔wall pairing, both clocks sampled now —
+    the per-process alignment record leading every ``spans.jsonl``."""
+    return {"type": "clock_anchor", "pid": os.getpid(),
+            "wall_us": int(time.time() * 1e6),
+            "mono_us": int(time.monotonic() * 1e6)}
+
+
+def is_clock_anchor(rec: dict) -> bool:
+    return rec.get("type") == "clock_anchor"
+
+
 def chrome_trace(records: list[dict]) -> dict:
     """Span records → Chrome trace-event JSON (Perfetto/chrome://tracing).
     Spans become ``ph:"X"`` complete events; trace/span/parent ids ride in
-    ``args`` so Perfetto's query view can join across processes."""
+    ``args`` so Perfetto's query view can join across processes.
+
+    ``clock_anchor`` records are consumed, not emitted: a span carrying
+    ``ts_mono_us`` whose process has an anchor is placed at
+    ``anchor.wall_us - (anchor.mono_us - ts_mono_us)`` — monotonic
+    spacing within the process, anchored to the wall for cross-process
+    alignment, so merged timelines survive a wall-clock step between the
+    span stamp and the export. Spans without an anchor (or predating
+    ``ts_mono_us``) keep their raw wall ``ts_us``."""
+    anchors = {rec["pid"]: rec for rec in records if is_clock_anchor(rec)}
     events = []
     pids = {}
     for rec in records:
+        if is_clock_anchor(rec):
+            continue
         pids.setdefault(rec["pid"], None)
         args = {"trace_id": rec["trace_id"], "span_id": rec["span_id"],
                 "parent_id": rec.get("parent_id", "")}
         args.update(rec.get("attrs", {}))
         if rec.get("error"):
             args["error"] = rec["error"]
+        anchor = anchors.get(rec["pid"])
+        if anchor is not None and "ts_mono_us" in rec:
+            ts = anchor["wall_us"] - (anchor["mono_us"] - rec["ts_mono_us"])
+        else:
+            ts = rec["ts_us"]
         events.append({
             "name": rec["name"],
             "cat": rec["name"].split("/", 1)[0],
             "ph": "X",
-            "ts": rec["ts_us"],
+            "ts": ts,
             "dur": rec["dur_us"],
             "pid": rec["pid"],
             "tid": rec["tid"],

@@ -36,6 +36,8 @@ import urllib.request
 from collections import deque
 from statistics import median
 
+from polyrl_tpu.obs.timeseries import least_squares_slope
+
 log = logging.getLogger(__name__)
 
 
@@ -419,11 +421,13 @@ class BalanceEstimator:
     def observe(self, *, step_time_s: float = 0.0,
                 trainer_bubble_s: float = 0.0, throughput: float = 0.0,
                 generate_s: float = 0.0, update_s: float = 0.0,
-                **_ignored) -> None:
+                occupancy: float = 0.0, **_ignored) -> None:
         """Fold one finished step in. ``generate_s``/``update_s`` are the
         goodput ledger's phase walls (timing_s/gen and the actor+critic
-        update phases); extra keys are accepted and ignored so callers can
-        pass a whole stats dict through."""
+        update phases); ``occupancy`` the fleet-mean ``engine/occupancy``
+        gauge (one step of lag — the sweep that produced it preceded this
+        record). Extra keys are accepted and ignored so callers can pass
+        a whole stats dict through."""
         with self._lock:
             self._steps.append({
                 "step_time_s": float(step_time_s),
@@ -431,10 +435,36 @@ class BalanceEstimator:
                 "throughput": float(throughput),
                 "generate_s": float(generate_s),
                 "update_s": float(update_s),
+                "occupancy": float(occupancy),
             })
 
     def _window_median(self, key: str) -> float:
         return median(s[key] for s in self._steps) if self._steps else 0.0
+
+    def trends(self) -> dict[str, float]:
+        """Per-step least-squares slopes over the window — the
+        balance-driven autoscaling input (ROADMAP: act on PoolManager
+        add/drain). A rising occupancy slope with a rising bubble slope
+        reads "the fleet is saturating and the trainer is starting to
+        starve: add an engine"; both falling reads "drain one". Keys:
+        ``{occupancy,bubble,step_time,throughput}_slope`` +
+        ``window_steps``; {} before the first observe."""
+        with self._lock:
+            if not self._steps:
+                return {}
+            steps = list(self._steps)
+        xs = list(range(len(steps)))
+
+        def slope(key: str) -> float:
+            return least_squares_slope(xs, [s[key] for s in steps])
+
+        return {
+            "occupancy_slope": slope("occupancy"),
+            "bubble_slope": slope("trainer_bubble_s"),
+            "step_time_slope": slope("step_time_s"),
+            "throughput_slope": slope("throughput"),
+            "window_steps": float(len(steps)),
+        }
 
     def stats(self) -> dict[str, float]:
         """Smoothed balancer feed (the update_metrics payload). Falls back
@@ -463,6 +493,7 @@ class BalanceEstimator:
             step = self._window_median("step_time_s")
         gen_total = gen + bubble  # colocated gen + blocked-on-remote time
         offload = gen_total / (gen_total + upd) if gen_total + upd > 0 else 0.0
+        trends = self.trends()
         return {
             "pool/balance_window_steps": float(len(self._steps)),
             "pool/balance_step_time_s": step,
@@ -470,4 +501,9 @@ class BalanceEstimator:
             "pool/balance_generate_s": gen,
             "pool/balance_update_s": upd,
             "pool/balance_offload_frac": offload,
+            # trend gauges (the autoscaling inputs): windowed per-step
+            # slopes of fleet occupancy and the trainer bubble
+            "pool/balance_occupancy_slope": trends.get(
+                "occupancy_slope", 0.0),
+            "pool/balance_bubble_slope": trends.get("bubble_slope", 0.0),
         }

@@ -134,6 +134,10 @@ class RemoteRollout:
         # graceful: the merge is skipped, the step never fails — this
         # counter is the only trace a flaky scrape leaves)
         self.scrape_failures = 0
+        # sample-looking /metrics lines that failed to parse (torn writes,
+        # truncated responses): counted per scrape instead of silently
+        # dropped (obs/scrape_partial)
+        self.scrape_partials = 0
         # pool re-admissions of the colocated engine that stayed failed
         # past the retry budget: the pool silently lost its local engine
         # (it idles with restored KV HBM while the manager never routes to
@@ -172,6 +176,7 @@ class RemoteRollout:
             "fault/resume_instances_failed": float(
                 self.resume_instances_failures),
             "obs/scrape_failed": float(self.scrape_failures),
+            "obs/scrape_partial": float(self.scrape_partials),
         }
         if self.fault_injector is not None:
             # chaos-mode visibility: the injected-fault counters ride the
@@ -639,12 +644,19 @@ class RemoteRollout:
     def scrape_manager_metrics(self) -> dict[str, float]:
         """One scrape of the manager's GET /metrics, as ``manager/*`` gauge
         keys for the step record. Best-effort: a scrape miss (manager
-        respawning, stub manager in tests) returns {}."""
+        respawning, stub manager in tests) returns {}. Each scrape's wall
+        latency lands in the ``manager/scrape_s`` histogram (a slow scrape
+        on the pipeline lane delays the next stream's admission) and
+        partially-parseable lines count into ``obs/scrape_partial``."""
         metrics_text = getattr(self.manager, "metrics_text", None)
         if metrics_text is None:
             return {}
         try:
-            return obs.manager_gauges(metrics_text())
+            t0 = time.monotonic()
+            gauges, partials = obs.manager_gauges_partial(metrics_text())
+            obs.observe("manager/scrape_s", time.monotonic() - t0)
+            self.scrape_partials += partials
+            return gauges
         except Exception:  # noqa: BLE001 — telemetry must not fail a step
             # skip the merge, count the miss (obs/scrape_failed gauge via
             # fault_counters) — a respawning/flaky manager degrades the
@@ -669,6 +681,7 @@ class RemoteRollout:
         # estimator-only inputs never reach the wire
         smoothed.pop("generate_s", None)
         smoothed.pop("update_s", None)
+        smoothed.pop("occupancy", None)
         smoothed.update(self.balance.stats())
         try:
             return self.manager.update_metrics(**smoothed)

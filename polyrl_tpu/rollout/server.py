@@ -118,6 +118,12 @@ class RolloutServer:
         self.weight_sync_timeout_s = 3600.0
         self._weight_lock = threading.Lock()
         self._loop_thread: threading.Thread | None = None
+        # fleet time-series rail (obs/timeseries.py): every server_info()
+        # sample lands in the per-key ring under engine/* — the manager's
+        # stats poller sets the cadence — and /statusz serves the windowed
+        # aggregates + slopes as the "timeseries" section
+        self._timeseries = obs.TimeSeriesStore()
+        self._ts_samples = 0
 
         outer = self
 
@@ -608,6 +614,13 @@ class RolloutServer:
             health = getattr(self.receiver, "health", None)
             if health is not None:
                 info.update(health())
+        # time-series sample: the numeric fields land in the engine/* ring
+        # (sample index as x — occupancy/queue-depth slopes over the
+        # poller's cadence, not the trainer's step clock)
+        self._ts_samples += 1
+        self._timeseries.observe(self._ts_samples, {
+            "engine/" + k: v for k, v in info.items()
+            if isinstance(v, (int, float)) and not isinstance(v, bool)})
         return info
 
     def statusz_snapshot(self) -> dict:
@@ -682,7 +695,8 @@ class RolloutServer:
             queues={"running": float(info.get("num_running_reqs", 0)),
                     "queued": float(info.get("num_queued_reqs", 0))},
             weights={"version": float(self.engine.weight_version)},
-            engine=engine_section)
+            engine=engine_section,
+            timeseries=self._timeseries.section())
 
     def metrics_text(self) -> str:
         """Prometheus text format for /metrics: server_info fields as

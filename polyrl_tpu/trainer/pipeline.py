@@ -145,7 +145,8 @@ class RolloutPipeline:
                         "perf/staleness_limit": float(limit)})
                     version = trainer._push_count
                     gen_t0 = time.monotonic()
-                    with obs.span("trainer/prefetch", step=step + 1):
+                    with obs.span("trainer/prefetch", step=step + 1,
+                                  version=version):
                         records = next(trainer.dataloader)
                         rng = jax.random.fold_in(self.base_rng, step)
                         for ib in trainer._ibatch_iter_local(

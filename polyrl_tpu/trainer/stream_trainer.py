@@ -22,6 +22,7 @@ Two rollout modes behind one loop:
 
 from __future__ import annotations
 
+import collections
 import dataclasses
 import logging
 import time
@@ -268,6 +269,14 @@ class StreamRLTrainer:
         self._goodput = obs.GoodputLedger(flops=self._flops)
         self._last_record: dict = {}
         self._statusz = None
+        # critical-path plane (obs/critical_path.py): per-step extraction
+        # over the span ring when tracing is on — critpath/* gauges, the
+        # last N paths for critical_path.json bundles / fleet_report
+        self._critpaths: collections.deque = collections.deque(maxlen=32)
+        # fleet time-series rail (obs/timeseries.py): every finished step
+        # record folds in; /statusz serves the windowed aggregates and
+        # BalanceEstimator.trends() the autoscaling slopes
+        self._timeseries = obs.TimeSeriesStore()
         # training health plane (obs/rlhealth.py): per-step RL-dynamics
         # ledger behind training/* step metrics and the /statusz training
         # section. Default-on (pass health=False to disable, or a
@@ -282,6 +291,11 @@ class StreamRLTrainer:
             # entropy-collapse/KL-blowup bundles carry the RL-dynamics
             # tail + the last batch's GRPO group table as training.json
             recorder.training_fn = self._health.bundle_view
+        if recorder is not None:
+            # stall/anomaly bundles carry the last N per-step critical
+            # paths as critical_path.json (empty until tracing produces
+            # one — the recorder then skips the file)
+            recorder.critical_path_fn = self._critical_path_view
         if recorder is not None and isinstance(rollout, RemoteRollout):
             recorder.counters_fn = rollout.fault_counters
             # post-mortem bundles carry the fleet flight-deck tail (per-
@@ -1030,7 +1044,12 @@ class StreamRLTrainer:
             while True:
                 wait_t0 = time.monotonic()
                 try:
-                    ibatch = next(it)
+                    # the wait span is what the critical-path extractor
+                    # attributes: covered by nested generation (serial) or
+                    # the producer lane's prefetch span → generate;
+                    # covered by nothing → a true bubble
+                    with obs.span("trainer/ibatch_wait"):
+                        ibatch = next(it)
                 except StopIteration:
                     return
                 # time blocked on rollout = the trainer bubble the
@@ -1123,7 +1142,7 @@ class StreamRLTrainer:
             counters.update(self._recorder.counters())
         gauges = {k: float(v) for k, v in rec.items()
                   if k.startswith(("perf/", "training/", "manager/",
-                                   "pool/", "engine/"))}
+                                   "pool/", "engine/", "critpath/"))}
         pool = getattr(self.rollout, "pool", None)
         return statusz.build_snapshot(
             "trainer", step=self.global_step,
@@ -1147,7 +1166,19 @@ class StreamRLTrainer:
             # training health plane (always present on the trainer role
             # unless explicitly disabled with health=False)
             training=(self._health.snapshot()
-                      if self._health is not None else None))
+                      if self._health is not None else None),
+            # fleet time-series rail: windowed aggregates + slopes over
+            # the step-record stream (obs/timeseries.py)
+            timeseries=self._timeseries.section())
+
+    def _critical_path_view(self) -> dict:
+        """Recorder hook: the last N per-step critical paths, dumped into
+        anomaly/stall bundles as ``critical_path.json`` (empty dict until
+        tracing has produced one — the recorder then skips the file)."""
+        if not self._critpaths:
+            return {}
+        return {"count": len(self._critpaths),
+                "paths": list(self._critpaths)}
 
     # -- fit --------------------------------------------------------------
 
@@ -1210,7 +1241,8 @@ class StreamRLTrainer:
                 # and fabric push within the step shares this trace_id —
                 # one step, one Perfetto timeline row group
                 # (ARCHITECTURE.md "Observability")
-                with obs.span("trainer/step", step=self.global_step + 1):
+                with obs.span("trainer/step", step=self.global_step + 1,
+                              depth=cfg.pipeline_depth):
                     state = self._train_one_batch(source, metrics)
                     with marked_timer("update_weight", metrics):
                         # pipelined: version bump + host gather inline, the
@@ -1255,7 +1287,12 @@ class StreamRLTrainer:
                         throughput=throughput,
                         generate_s=float(timings.get("gen", 0.0)),
                         update_s=float(timings.get("update_actor", 0.0))
-                        + float(timings.get("update_critic", 0.0)))
+                        + float(timings.get("update_critic", 0.0)),
+                        # fleet occupancy from the previous step's pool
+                        # aggregation: the balance estimator's trend input
+                        # (pool/balance_occupancy_slope)
+                        occupancy=float(self._last_record.get(
+                            "engine/occupancy", 0.0)))
                     if pipeline is not None:
                         # scrape + balancer round-trip ride the pipeline
                         # thread (off the hot path); their gauges land in
@@ -1305,7 +1342,7 @@ class StreamRLTrainer:
                 # goodput attribution (obs/goodput.py): the FULL step wall
                 # (incl. validation + checkpoint IO, which perf/step_time_s
                 # predates) decomposed into non-overlapping goodput/* phases
-                metrics.update(self._goodput.account(
+                gp = self._goodput.account(
                     step_time_s=time.monotonic() - step_t0,
                     timings=metrics.timings(),
                     bubble_s=state["bubble"],
@@ -1313,8 +1350,22 @@ class StreamRLTrainer:
                     histograms=hists,
                     n_tokens=state["n_tokens"],
                     mean_context_len=state["n_tokens"] / n_traj,
-                    n_chips=jax.device_count()))
+                    n_chips=jax.device_count())
+                metrics.update(gp)
                 metrics.merge_histograms(hists)
+                tracer = obs.get_tracer()
+                if tracer.enabled:
+                    # critical-path attribution over the step's span tree:
+                    # which segment actually bounded the wall, and how much
+                    # a 10% speedup there would buy (critpath/* gauges;
+                    # obs/critical_path.py). Windowed to the goodput wall so
+                    # validation/checkpoint time attributes as housekeeping.
+                    cp = obs.extract_critical_path(
+                        tracer.records(), step=self.global_step,
+                        wall_s=gp["goodput/step_wall_s"])
+                    if cp is not None:
+                        metrics.update_gauge(cp.metrics())
+                        self._critpaths.append(cp.to_dict())
                 if self._health is not None:
                     # training health plane: close the step's RL-dynamics
                     # window — training/* gauges (group diagnostics,
@@ -1335,6 +1386,10 @@ class StreamRLTrainer:
                 record = metrics.as_dict()
                 history.append(record)
                 self._last_record = record
+                # time-series rail: the bounded per-key ring behind the
+                # /statusz "timeseries" section (windowed aggregates +
+                # slopes — the fleet trend surface autoscaling reads)
+                self._timeseries.observe(self.global_step, record)
                 if self._recorder is not None:
                     # anomaly watch over the live step stream; a spike in
                     # step time (or a throughput collapse) dumps a

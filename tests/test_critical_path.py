@@ -1,0 +1,392 @@
+"""Critical-path plane (ISSUE 14): per-step bottleneck attribution over
+the span ring, the fleet time-series rail, BalanceEstimator trend
+signals, the multi-process trace merge, the fleet_report CLI, and the
+statusz-docs lint — plus the traced A/B fit pinning the bottleneck flip
+(generate-bound vs update-bound) and the wall reconciliation bound."""
+
+import importlib.util
+import json
+import os
+import time
+
+import pytest
+
+from polyrl_tpu import obs
+from polyrl_tpu.obs.critical_path import (SEGMENTS, classify,
+                                          extract_critical_path)
+from polyrl_tpu.obs.timeseries import (TimeSeriesStore, aggregate,
+                                       least_squares_slope)
+from polyrl_tpu.rollout.pool import BalanceEstimator
+
+from test_pipeline_overlap import FakeRollout, make_trainer
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO, "tools", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _span(name, t0_us, dur_us, *, pid=100, tid=1, trace_id="tr",
+          span_id="s0", **attrs):
+    """Synthetic tracer record (the subset the extractor reads)."""
+    return {"name": name, "pid": pid, "tid": tid, "trace_id": trace_id,
+            "span_id": span_id, "parent_id": "", "ts_us": t0_us,
+            "ts_mono_us": t0_us, "dur_us": dur_us, "attrs": attrs}
+
+
+# -- extractor unit tests (synthetic records) --------------------------------
+
+
+def test_no_root_returns_none():
+    assert extract_critical_path([]) is None
+    assert extract_critical_path([_span("trainer/gen", 0, 100)]) is None
+    # a root exists but not for the requested step
+    recs = [_span("trainer/step", 0, 100, step=3)]
+    assert extract_critical_path(recs, step=7) is None
+    assert extract_critical_path(recs, step=3) is not None
+
+
+def test_classify_taxonomy():
+    assert classify("trainer/gen") == "generate"
+    assert classify("trainer/update_actor") == "update"
+    assert classify("trainer/update_weight") == "push"
+    assert classify("trainer/prefetch") == "generate"
+    assert classify("rollout/stream") == "generate"
+    assert classify("manager/scrape") == "manager"
+    assert classify("transfer/push") == "push"
+    assert classify("trainer/ibatch_wait") is None     # covered-by decides
+    assert classify("unknown/span") is None
+
+
+def test_sequential_step_partitions_wall():
+    us = 1_000_000
+    recs = [
+        _span("trainer/step", us, 1_000_000, span_id="root", step=5),
+        _span("trainer/gen", us, 400_000, span_id="g"),
+        _span("trainer/update_actor", us + 400_000, 500_000, span_id="u"),
+    ]
+    cp = extract_critical_path(recs, step=5, wall_s=1.0)
+    assert cp.step == 5 and cp.wall_s == pytest.approx(1.0)
+    assert cp.critical_s["generate"] == pytest.approx(0.4)
+    assert cp.critical_s["update"] == pytest.approx(0.5)
+    # the uncovered tail of the window attributes to "other"
+    assert cp.critical_s["other"] == pytest.approx(0.1)
+    # segments PARTITION the wall: reconciliation is exact by construction
+    assert sum(cp.critical_s.values()) == pytest.approx(cp.wall_s)
+    assert cp.bottleneck == "update"
+    # tightest competitor: generate (1.0 - 0.4); headroom capped at 10%
+    assert cp.slack_s == pytest.approx(0.6)
+    assert cp.headroom_s == pytest.approx(0.05)
+    m = cp.metrics()
+    assert m["critpath/bottleneck"] == float(SEGMENTS.index("update"))
+    assert sum(m[f"critpath/{s}_frac"] for s in SEGMENTS) == \
+        pytest.approx(1.0)
+    assert m["critpath/update_frac"] == pytest.approx(0.5)
+    d = cp.to_dict()
+    assert d["bottleneck"] == "update" and d["path"]
+    assert "other" not in d["hidden_s"]
+
+
+def test_hidden_producer_lane_outranks_foreground():
+    """A fully-overlapped 0.78 s producer-lane generation must outrank the
+    0.5 s foreground update — phase walls alone would get this wrong."""
+    recs = [
+        _span("trainer/step", 0, 500_000, span_id="root", step=1),
+        _span("trainer/update_actor", 0, 500_000, span_id="u"),
+        _span("trainer/prefetch", 0, 780_000, tid=2, trace_id="lane",
+              span_id="p", step=2),
+    ]
+    cp = extract_critical_path(recs, step=1, wall_s=0.8)
+    assert cp.critical_s["update"] == pytest.approx(0.5)
+    assert cp.critical_s["generate"] == pytest.approx(0.0)
+    assert cp.hidden_s["generate"] == pytest.approx(0.78)
+    assert cp.total_s["generate"] == pytest.approx(0.78)
+    assert cp.bottleneck == "generate"
+
+
+def test_wait_covered_by_lane_is_generate_else_bubble():
+    def recs(with_lane):
+        out = [
+            _span("trainer/step", 0, 1_000_000, span_id="root", step=1),
+            _span("trainer/ibatch_wait", 0, 600_000, span_id="w"),
+            _span("trainer/update_actor", 600_000, 400_000, span_id="u"),
+        ]
+        if with_lane:
+            out.append(_span("trainer/prefetch", 0, 550_000, tid=2,
+                             trace_id="lane", span_id="p", step=2))
+        return out
+
+    # blocked on the producer lane: the wait IS generation
+    cp = extract_critical_path(recs(True), step=1, wall_s=1.0)
+    assert cp.critical_s["generate"] == pytest.approx(0.6)
+    assert cp.critical_s["bubble"] == pytest.approx(0.0)
+    assert cp.bottleneck == "generate"
+    assert [seg for seg, _ in cp.path] == ["generate", "update"]
+    # nothing producing anywhere: a true bubble
+    cp = extract_critical_path(recs(False), step=1, wall_s=1.0)
+    assert cp.critical_s["bubble"] == pytest.approx(0.6)
+    assert cp.critical_s["generate"] == pytest.approx(0.0)
+    assert cp.bottleneck == "bubble"
+
+
+def test_nested_generation_inside_wait_attributes_generate():
+    """Colocated generation nested INSIDE the ibatch wait: the innermost
+    covering span wins, so the interval reads generate, not bubble."""
+    recs = [
+        _span("trainer/step", 0, 1_000_000, span_id="root", step=1),
+        _span("trainer/ibatch_wait", 0, 700_000, span_id="w"),
+        _span("trainer/gen", 100_000, 500_000, span_id="g"),
+        _span("trainer/update_actor", 700_000, 300_000, span_id="u"),
+    ]
+    cp = extract_critical_path(recs, step=1, wall_s=1.0)
+    assert cp.critical_s["generate"] == pytest.approx(0.5)
+    assert cp.critical_s["bubble"] == pytest.approx(0.2)   # bare wait ends
+    assert cp.critical_s["update"] == pytest.approx(0.3)
+    assert sum(cp.critical_s.values()) == pytest.approx(1.0)
+
+
+def test_step_selection_last_root_wins():
+    recs = [
+        _span("trainer/step", 0, 1_000_000, span_id="r1", step=1),
+        _span("trainer/gen", 0, 900_000, span_id="g1"),
+        _span("trainer/step", 2_000_000, 1_000_000, span_id="r2", step=2),
+        _span("trainer/update_actor", 2_000_000, 900_000, span_id="u2"),
+    ]
+    assert extract_critical_path(recs, step=1).bottleneck == "generate"
+    assert extract_critical_path(recs, step=2).bottleneck == "update"
+    # step=None: the LATEST root (a warmup ring leftover can't shadow it)
+    assert extract_critical_path(recs).step == 2
+
+
+def test_remote_spans_join_on_trace_id():
+    recs = [
+        _span("trainer/step", 0, 1_000_000, span_id="root", step=1),
+        _span("engine/generate", 100_000, 600_000, pid=999, tid=9,
+              trace_id="tr", span_id="e1"),
+        _span("engine/generate", 100_000, 600_000, pid=999, tid=9,
+              trace_id="unrelated", span_id="e2"),
+    ]
+    cp = extract_critical_path(recs, step=1, wall_s=1.0)
+    assert [r["span_id"] for r in cp.remote] == ["e1"]
+    assert cp.remote[0]["pid"] == 999
+    assert cp.remote[0]["dur_s"] == pytest.approx(0.6)
+    # cross-process spans inform the report, not the foreground partition
+    assert sum(cp.critical_s.values()) == pytest.approx(1.0)
+
+
+# -- time-series rail --------------------------------------------------------
+
+
+def test_least_squares_slope_and_aggregate():
+    assert least_squares_slope([], []) == 0.0
+    assert least_squares_slope([1.0], [2.0]) == 0.0
+    assert least_squares_slope([0, 0, 0], [1, 2, 3]) == 0.0  # degenerate x
+    xs = list(range(10))
+    assert least_squares_slope(xs, [1.0 + 0.1 * x for x in xs]) == \
+        pytest.approx(0.1)
+    agg = aggregate([(float(i), 1.0 + 0.1 * i) for i in range(10)])
+    assert agg["count"] == 10
+    assert agg["last"] == pytest.approx(1.9)
+    assert agg["mean"] == pytest.approx(1.45)
+    assert agg["min"] == pytest.approx(1.0)
+    assert agg["max"] == pytest.approx(1.9)
+    assert agg["slope"] == pytest.approx(0.1)
+    assert agg["p95"] == pytest.approx(1.9)   # nearest rank of 10 points
+    assert aggregate([]) == {"count": 0}
+    # slope is PER STEP: a gappy step axis still reads the true rate
+    agg = aggregate([(0.0, 0.0), (10.0, 10.0), (20.0, 20.0)])
+    assert agg["slope"] == pytest.approx(1.0)
+
+
+def test_store_prefix_filter_capacity_and_key_bound():
+    store = TimeSeriesStore(capacity=4, max_keys=2,
+                            prefixes=("goodput/", "perf/"))
+    for step in range(8):
+        store.observe(step, {
+            "goodput/step_wall_s": 1.0 + step,
+            "perf/throughput_tokens_per_s": 100.0 - step,
+            "actor/pg_loss": 0.5,              # untracked prefix
+            "goodput/flag": True,              # bools never tracked
+            "goodput/name": "str",             # non-numeric skipped
+            "perf/extra": float(step),         # > max_keys: dropped
+        })
+    assert store.keys() == ["goodput/step_wall_s",
+                            "perf/throughput_tokens_per_s"]
+    assert store.dropped_keys == 8
+    # ring bound: only the last `capacity` points survive
+    pts = store.series("goodput/step_wall_s")
+    assert [s for s, _ in pts] == [4.0, 5.0, 6.0, 7.0]
+    assert store.aggregates("goodput/step_wall_s")["slope"] == \
+        pytest.approx(1.0)
+    assert store.series("actor/pg_loss") == []
+    sec = store.section(window=2)
+    assert sec["tracked_keys"] == 2 and sec["dropped_keys"] == 8
+    assert sec["capacity"] == 4 and sec["window"] == 2
+    assert sec["keys"]["goodput/step_wall_s"]["count"] == 2
+    assert sec["keys"]["goodput/step_wall_s"]["last"] == pytest.approx(8.0)
+
+
+def test_balance_estimator_trends_feed_autoscaling_gauges():
+    est = BalanceEstimator(window=8)
+    assert est.trends() == {}
+    for i in range(6):
+        est.observe(step_time_s=1.0, trainer_bubble_s=0.4 - 0.05 * i,
+                    throughput=100.0, generate_s=0.5, update_s=0.4,
+                    occupancy=0.2 + 0.1 * i)
+    tr = est.trends()
+    assert tr["window_steps"] == 6.0
+    assert tr["occupancy_slope"] == pytest.approx(0.1)
+    assert tr["bubble_slope"] == pytest.approx(-0.05)
+    assert tr["step_time_slope"] == pytest.approx(0.0)
+    m = est.metrics()
+    assert m["pool/balance_occupancy_slope"] == pytest.approx(0.1)
+    assert m["pool/balance_bubble_slope"] == pytest.approx(-0.05)
+
+
+# -- traced A/B fit: the bottleneck flip + wall reconciliation ---------------
+
+
+def _traced_fit(rollout, *, slow_update_s=0.0, total_steps=3):
+    obs.configure(trace=True, max_spans=4096, reset=True)
+    try:
+        trainer = make_trainer(rollout, total_steps=total_steps, depth=1,
+                               rollout_is_correction=True)
+        if slow_update_s:
+            orig = trainer.actor.update_stream
+
+            def slow_update(*a, **kw):
+                time.sleep(slow_update_s)
+                return orig(*a, **kw)
+
+            trainer.actor.update_stream = slow_update
+        hist = trainer.fit()
+        return trainer, hist
+    finally:
+        obs.configure(trace=False, reset=True)
+
+
+def _check_reconciliation(hist):
+    """ISSUE AC: segment sum reconciles with goodput/step_wall_s <= 5%."""
+    for rec in hist:
+        assert "critpath/wall_s" in rec, "traced step lost its critpath"
+        frac_sum = sum(rec[f"critpath/{s}_frac"] for s in SEGMENTS)
+        assert frac_sum == pytest.approx(1.0, abs=1e-6)
+        wall = rec["goodput/step_wall_s"]
+        assert abs(rec["critpath/wall_s"] - wall) <= 0.05 * wall
+
+
+def test_traced_fit_generate_bound(tmp_path):
+    """Case A: a slow fake engine (0.4 s/generate, 2 calls/step) on a fast
+    tiny model -> the settled step is generation-bound, and the lane-
+    covered ibatch wait attributes most of the wall to generate."""
+    trainer, hist = _traced_fit(FakeRollout(gen_delay_s=0.4))
+    _check_reconciliation(hist)
+    last = hist[-1]
+    assert SEGMENTS[int(last["critpath/bottleneck"])] == "generate"
+    assert last["critpath/generate_frac"] > 0.5
+    assert last["critpath/headroom_s"] >= 0.0
+    # the per-step paths rode into the recorder view + the statusz rail
+    view = trainer._critical_path_view()
+    assert view["count"] == len(hist)
+    assert view["paths"][-1]["bottleneck"] == "generate"
+    ts = trainer._timeseries.section()
+    assert ts["keys"]["critpath/bottleneck_frac"]["count"] == len(hist)
+    assert ts["keys"]["training/global_step"]["slope"] == pytest.approx(1.0)
+
+    # the same records render through the fleet_report CLI
+    steps = tmp_path / "steps.jsonl"
+    with open(steps, "w") as f:
+        for rec in hist:
+            f.write(json.dumps(rec) + "\n")
+    fr = _load_tool("fleet_report")
+    out = fr.render(*fr.load_records(str(steps)), last=32, width=16)
+    assert "generate" in out and "bottleneck_frac" in out
+    assert "|" in out and "G" in out            # the timeline bar rendered
+    assert fr.main([str(tmp_path / "missing.jsonl")]) == 2
+
+
+def test_traced_fit_flips_to_update_bound():
+    """Case B: same harness, instant generation but a 0.25 s sleep in the
+    actor update (2 update_stream calls/step) -> the bottleneck flips to
+    update. Pins that attribution follows the actual binding phase."""
+    _, hist = _traced_fit(FakeRollout(gen_delay_s=0.0), slow_update_s=0.25)
+    _check_reconciliation(hist)
+    last = hist[-1]
+    assert SEGMENTS[int(last["critpath/bottleneck"])] == "update"
+    assert last["critpath/update_frac"] > last["critpath/generate_frac"]
+
+
+# -- trace2perfetto: multi-process merge on clock anchors --------------------
+
+
+def test_trace2perfetto_merges_processes_on_anchors(tmp_path, capsys):
+    """Trainer + engine spans.jsonl dumps with SKEWED raw wall stamps:
+    the merge must place both on the anchor-aligned wall clock (the
+    engine span lands inside the trainer span), keep the shared trace_id
+    joinable, and emit process_name metadata per pid."""
+    t_dir, e_dir = tmp_path / "trainer", tmp_path / "engine"
+    t_dir.mkdir(), e_dir.mkdir()
+    # trainer (pid 111): anchor wall=10_000_000 mono=500_000
+    t_anchor = {"type": "clock_anchor", "pid": 111,
+                "wall_us": 10_000_000, "mono_us": 500_000}
+    t_span = {"name": "trainer/step", "pid": 111, "tid": 1,
+              "trace_id": "req1", "span_id": "a1", "parent_id": "",
+              "ts_us": 1_000, "ts_mono_us": 400_000, "dur_us": 100_000,
+              "attrs": {"step": 1}}
+    # engine (pid 222): a different mono base AND a bogus raw wall stamp —
+    # only the anchor can line it up (true placement 9_920_000)
+    e_anchor = {"type": "clock_anchor", "pid": 222,
+                "wall_us": 10_050_000, "mono_us": 9_000_000}
+    e_span = {"name": "engine/generate", "pid": 222, "tid": 9,
+              "trace_id": "req1", "span_id": "b1", "parent_id": "",
+              "ts_us": 77, "ts_mono_us": 8_870_000, "dur_us": 50_000,
+              "attrs": {}}
+    for d, recs in ((t_dir, [t_anchor, t_span]), (e_dir, [e_anchor, e_span])):
+        with open(d / "spans.jsonl", "w") as f:
+            for rec in recs:
+                f.write(json.dumps(rec) + "\n")
+
+    out = tmp_path / "trace.json"
+    t2p = _load_tool("trace2perfetto")
+    assert t2p.main([str(t_dir), str(e_dir), "-o", str(out)]) == 0
+    assert "2 spans, 1 traces, 2 clock anchors" in capsys.readouterr().out
+
+    events = json.load(open(out))["traceEvents"]
+    spans = {e["pid"]: e for e in events if e["ph"] == "X"}
+    assert set(spans) == {111, 222}
+    # anchor alignment: wall_us - (mono_us - ts_mono_us), NOT the raw ts_us
+    assert spans[111]["ts"] == 9_900_000
+    assert spans[222]["ts"] == 9_920_000
+    # skew corrected: the engine generate nests inside the trainer step
+    assert spans[111]["ts"] <= spans[222]["ts"]
+    assert spans[222]["ts"] + spans[222]["dur"] <= \
+        spans[111]["ts"] + spans[111]["dur"]
+    # the join key survives into args for Perfetto's query view
+    assert spans[111]["args"]["trace_id"] == "req1"
+    assert spans[222]["args"]["trace_id"] == "req1"
+    meta = {e["pid"]: e for e in events if e["ph"] == "M"}
+    assert set(meta) == {111, 222}
+    assert all(e["name"] == "process_name" for e in meta.values())
+
+
+# -- statusz docs lint -------------------------------------------------------
+
+
+def test_statusz_docs_lint_clean_and_bites(tmp_path):
+    lint = _load_tool("check_statusz_docs")
+    # the checked-in ARCHITECTURE.md documents every section + namespace
+    assert lint.check_doc(lint.default_doc()) == []
+    assert lint.main([]) == 0
+    # a doc missing the contract must fail with named violations
+    probe = tmp_path / "ARCH.md"
+    probe.write_text("# nothing documented here\n")
+    violations = lint.check_doc(str(probe))
+    assert violations and lint.main([str(probe)]) == 1
+    text = "\n".join(violations)
+    assert "timeseries" in text and "critpath" in text
+    assert "polyrl/statusz/v4" in text

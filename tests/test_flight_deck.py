@@ -233,19 +233,19 @@ def _get_json(url: str) -> dict:
         return json.loads(r.read())
 
 
-def test_statusz_v3_conformance_both_planes(tiny):
-    """Every v3 section is present on BOTH planes (schema contract), and
+def test_statusz_v4_conformance_both_planes(tiny):
+    """Every v4 section is present on BOTH planes (schema contract), and
     the rollout plane's ``engine`` section carries the live ledger."""
     from polyrl_tpu.rollout.server import RolloutServer
 
-    assert statusz.SCHEMA == "polyrl/statusz/v3"
+    assert statusz.SCHEMA == "polyrl/statusz/v4"
     # trainer plane: the standalone exporter over build_snapshot (the only
     # snapshot constructor the trainer uses)
     srv = statusz.StatuszServer(lambda: statusz.build_snapshot(
         "trainer", step=3), host="127.0.0.1").start()
     try:
         snap = _get_json(f"http://{srv.endpoint}/statusz")
-        assert snap["schema"] == "polyrl/statusz/v3"
+        assert snap["schema"] == "polyrl/statusz/v4"
         for section in statusz.REQUIRED_SECTIONS:
             assert section in snap, f"trainer plane missing {section}"
     finally:
@@ -260,7 +260,7 @@ def test_statusz_v3_conformance_both_planes(tiny):
         engine.generate([[5, 3, 9]], SamplingParams(temperature=0.0,
                                                     max_new_tokens=4))
         snap = _get_json(f"http://127.0.0.1:{server.port}/statusz")
-        assert snap["schema"] == "polyrl/statusz/v3"
+        assert snap["schema"] == "polyrl/statusz/v4"
         for section in statusz.REQUIRED_SECTIONS:
             assert section in snap, f"rollout plane missing {section}"
         eng = snap["engine"]

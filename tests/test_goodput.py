@@ -14,7 +14,9 @@ import urllib.request
 import pytest
 
 from polyrl_tpu import obs
+from polyrl_tpu.obs import critical_path
 from polyrl_tpu.obs.goodput import PHASES, GoodputLedger
+from polyrl_tpu.obs.trace import is_clock_anchor
 from polyrl_tpu.obs.histogram import Histogram
 from polyrl_tpu.obs.recorder import AnomalyDetector, FlightRecorder
 from polyrl_tpu.obs.statusz import (StatuszServer, build_snapshot,
@@ -159,7 +161,8 @@ def test_recorder_one_stall_one_bundle(tmp_path):
                          "steps.jsonl"]
         spans = [json.loads(ln) for ln in
                  open(os.path.join(bundle, "spans.jsonl"))]
-        assert any(s["name"] == "trainer/step" for s in spans)
+        assert is_clock_anchor(spans[0])     # per-process alignment record
+        assert any(s.get("name") == "trainer/step" for s in spans)
         steps = [json.loads(ln) for ln in
                  open(os.path.join(bundle, "steps.jsonl"))]
         assert len(steps) <= 8 and steps[-1]["perf/step_time_s"] == 6.0
@@ -203,11 +206,11 @@ def test_statusz_server_and_prometheus(tmp_path):
     srv = StatuszServer(lambda: snap).start()
     try:
         got = _get_json(f"http://{srv.endpoint}/statusz")
-        assert got["schema"] == "polyrl/statusz/v3"
+        assert got["schema"] == "polyrl/statusz/v4"
         assert got["role"] == "trainer" and got["step"] == 7
         # every schema section always present
         for section in ("goodput", "histograms", "counters", "gauges",
-                        "queues", "weights"):
+                        "queues", "weights", "timeseries"):
             assert section in got
         # a lone scalar (perf/step_time_s) is not mistaken for a histogram
         assert set(got["histograms"]) == {"rollout/latency_s"}
@@ -543,16 +546,25 @@ def test_e2e_goodput_statusz_and_stall_bundle(stall_stack, tmp_path):
         assert recorder.anomalies == 1, (times, det_state)
         assert len(recorder.bundle_paths) == 1
         bundle = recorder.bundle_paths[0]
-        # training.json: the health ledger rides every trainer bundle
+        # training.json + critical_path.json ride every traced trainer
+        # bundle alongside the health ledger
         assert sorted(os.listdir(bundle)) == [
-            "counters.json", "spans.jsonl", "stacks.txt", "steps.jsonl",
-            "training.json"]
+            "counters.json", "critical_path.json", "spans.jsonl",
+            "stacks.txt", "steps.jsonl", "training.json"]
         training = json.load(open(os.path.join(bundle, "training.json")))
         assert training["steps"] >= 1 and training["tail"]
+        critpaths = json.load(
+            open(os.path.join(bundle, "critical_path.json")))
+        assert critpaths["count"] >= 1 and critpaths["paths"]
+        assert all(p["wall_s"] > 0.0 and p["bottleneck"] in
+                   critical_path.SEGMENTS and p["path"]
+                   for p in critpaths["paths"])
         spans = [json.loads(ln) for ln in
                  open(os.path.join(bundle, "spans.jsonl"))]
-        assert any(s["name"] == "trainer/step" for s in spans)
-        assert any(s["name"] == "rollout/stream" for s in spans)
+        # the bundle's span dump leads with this process's clock anchor
+        assert is_clock_anchor(spans[0])
+        assert any(s.get("name") == "trainer/step" for s in spans)
+        assert any(s.get("name") == "rollout/stream" for s in spans)
         assert "File" in open(os.path.join(bundle, "stacks.txt")).read()
         counters = json.load(open(os.path.join(bundle, "counters.json")))
         assert counters["reason"] == "anomaly"
@@ -575,6 +587,20 @@ def test_e2e_goodput_statusz_and_stall_bundle(stall_stack, tmp_path):
         assert r_snap["queues"] == {"running": 0.0, "queued": 0.0}
         assert r_snap["weights"]["version"] >= 1.0
         assert r_snap["counters"]["fault/injected_stalls"] == 1.0
+        # (b') the v4 timeseries rail is live on BOTH planes
+        assert t_snap["schema"] == "polyrl/statusz/v4"
+        t_ts = t_snap["timeseries"]
+        assert t_ts["tracked_keys"] >= 1
+        # global_step climbs by exactly 1 per step -> OLS slope 1.0
+        assert t_ts["keys"]["training/global_step"]["slope"] == \
+            pytest.approx(1.0)
+        assert t_ts["keys"]["goodput/step_wall_s"]["count"] == 7
+        # the traced fit fed the critical-path gauges into the rail too
+        assert any(k.startswith("critpath/") for k in t_ts["keys"])
+        r_ts = r_snap["timeseries"]
+        assert r_ts["tracked_keys"] >= 1
+        # the rollout plane windows its own poll-driven engine gauges
+        assert any(k.startswith("engine/") for k in r_ts["keys"])
         # the prometheus rendering serves the same snapshot
         text = urllib.request.urlopen(
             f"http://{statusz_srv.endpoint}/metrics",

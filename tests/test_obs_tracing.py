@@ -174,12 +174,23 @@ def test_span_nesting_and_cross_thread_adoption(tracer):
 
 
 def test_chrome_export_roundtrip(tracer, tmp_path):
+    from polyrl_tpu.obs.trace import is_clock_anchor
+
     with obs.span("t/outer", step=3):
         with obs.span("t/inner"):
             pass
     jsonl, trace = tracer.export_run(str(tmp_path))
-    spans = [json.loads(line) for line in open(jsonl)]
+    lines = [json.loads(line) for line in open(jsonl)]
+    # first line is the per-process clock anchor (monotonic<->wall pair
+    # sampled at one instant) that multi-process merges align on
+    anchor, spans = lines[0], lines[1:]
+    assert is_clock_anchor(anchor)
+    assert anchor["pid"] == os.getpid()
+    assert anchor["wall_us"] > 0 and anchor["mono_us"] > 0
+    assert not any(is_clock_anchor(s) for s in spans)
     assert {s["name"] for s in spans} == {"t/outer", "t/inner"}
+    # spans carry both clocks: wall ts_us and monotonic ts_mono_us
+    assert all(s["ts_mono_us"] > 0 for s in spans)
     data = json.loads(open(trace).read())
     evs = [e for e in data["traceEvents"] if e.get("ph") == "X"]
     by_name = {e["name"]: e for e in evs}
@@ -187,6 +198,12 @@ def test_chrome_export_roundtrip(tracer, tmp_path):
         by_name["t/outer"]["args"]["span_id"]
     assert by_name["t/outer"]["args"]["step"] == 3
     assert by_name["t/outer"]["dur"] >= by_name["t/inner"]["dur"]
+    # chrome placement is anchor-aligned: outer's wall position differs
+    # from the raw ts_us only by the (tiny, same-process) anchor skew
+    outer = next(s for s in spans if s["name"] == "t/outer")
+    placed = by_name["t/outer"]["ts"]
+    expect = anchor["wall_us"] - (anchor["mono_us"] - outer["ts_mono_us"])
+    assert placed == expect
 
 
 # -- header round-trip through a stub manager --------------------------------
@@ -323,6 +340,41 @@ def test_scrape_manager_metrics_best_effort():
     assert RemoteRollout(_Broken()).scrape_manager_metrics() == {}
 
 
+def test_scrape_partials_counted_and_latency_observed():
+    """Sample-looking lines that fail to parse are COUNTED (not silently
+    dropped): the partial count rides the obs/scrape_partial fault
+    counter, and each scrape's wall latency lands in the manager/scrape_s
+    histogram."""
+    from polyrl_tpu.rollout.remote import RemoteRollout
+
+    torn = _PROM_TEXT + "polyrl_mgr_torn_value 1.2.3\npolyrl_mgr_nan_ish x\n"
+    parsed, partials = obs.parse_prometheus_text_partial(torn)
+    assert parsed["polyrl_mgr_instances"] == 3.0
+    assert "polyrl_mgr_torn_value" not in parsed
+    # the two torn lines + the _PROM_TEXT garbage line
+    assert partials == 3
+    gauges, partials2 = obs.manager_gauges_partial(torn)
+    assert gauges["manager/instances"] == 3.0
+    assert partials2 == partials
+
+    class _Torn:
+        def metrics_text(self):
+            return torn
+
+    obs.drain_histograms()
+    remote = RemoteRollout(_Torn())
+    g = remote.scrape_manager_metrics()
+    assert g["manager/running_reqs"] == 7.0
+    assert remote.scrape_partials == 3
+    assert remote.fault_counters()["obs/scrape_partial"] == 3.0
+    # second scrape accumulates
+    remote.scrape_manager_metrics()
+    assert remote.fault_counters()["obs/scrape_partial"] == 6.0
+    hists = obs.drain_histograms()
+    assert hists["manager/scrape_s"].count == 2
+    assert hists["manager/scrape_s"].vmax >= 0.0
+
+
 # -- metric-name lint (CI wiring) --------------------------------------------
 
 
@@ -448,7 +500,12 @@ def test_e2e_traced_fit(stack, tmp_path):
         trace_id = step["args"]["trace_id"]
         stream = by_name["rollout/stream"][0]
         assert stream["args"]["trace_id"] == trace_id
-        assert stream["args"]["parent_id"] == step["args"]["span_id"]
+        # the stream opens while the foreground blocks on the ibatch: its
+        # parent is the step's trainer/ibatch_wait span, which chains to
+        # the step root (the critical-path extractor leans on this shape)
+        wait = next(w for w in by_name["trainer/ibatch_wait"]
+                    if w["args"]["span_id"] == stream["args"]["parent_id"])
+        assert wait["args"]["parent_id"] == step["args"]["span_id"]
         # engine spans adopted the trainer's trace THROUGH the C++ manager
         # (client header → manager request injection → server adoption)
         engines = by_name["engine/generate"]

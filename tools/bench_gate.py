@@ -112,6 +112,13 @@ WATCHED_EXTRA = (
     ("training_approx_kl", "high"),
     ("training_tis_clip_frac", "high"),
     ("training_degenerate_group_frac", "high"),
+    # critical-path plane (bench.py --pipeline-microbench traced leg,
+    # obs/critical_path.py): the bottleneck segment's share of the step
+    # wall concentrating upward, or the wall a 10% bottleneck speedup
+    # would buy growing, means the pipeline is hiding less work —
+    # an overlap regression even when tok/s held
+    ("critpath_bottleneck_frac", "high"),
+    ("critpath_headroom_s", "high"),
     # bounded-staleness async pipeline (bench.py --async-sweep): the
     # async-vs-fenced step speedup and the async run's tok/s must hold,
     # the training/staleness p95 must stay bounded by staleness_limit

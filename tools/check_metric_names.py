@@ -35,8 +35,14 @@ salvage counters — ``fault/tokens_salvaged``, ``fault/suffix_resumes``,
 ``fault/resume_prefill_tokens`` (rollout/remote.py ``fault_counters``)
 and the injector's ``fault/injected_*`` (rollout/faults.py ``counters``)
 — and the goodput/health plane's ``goodput/*`` phase attribution plus the
-``obs/*`` self-telemetry (``obs/scrape_failed``, ``obs/anomalies``,
-``obs/bundles``, ``obs/log_errors``). The engine flight deck
+``obs/*`` self-telemetry (``obs/scrape_failed``, ``obs/scrape_partial`` —
+sample-looking /metrics lines that failed to parse — ``obs/anomalies``,
+``obs/bundles``, ``obs/log_errors``) and the scrape-latency histogram
+``manager/scrape_s``. The critical-path plane (obs/critical_path.py)
+emits ``critpath/*`` — ``critpath/bottleneck`` (segment index),
+``critpath/bottleneck_frac``, per-segment ``critpath/<seg>_frac``
+critical-time fractions, ``critpath/slack_s`` and the 10%-speedup
+``critpath/headroom_s``. The engine flight deck
 (rollout/flightdeck.py) emits ``engine/*`` — per-request lifecycle
 distributions (``engine/ttft_s``, ``engine/tpot_s``,
 ``engine/queue_wait_s``, ``engine/prefill_s``) into the global histogram
@@ -105,7 +111,11 @@ NAMESPACES = frozenset({
                      # fault tolerance")
     "prefix_cache",  # engine prefix-cache hit telemetry
     "timing_s",      # marked_timer phase timings
-    "obs",           # observability self-telemetry (scrape/log/anomaly)
+    "obs",           # observability self-telemetry (scrape/log/anomaly/
+                     # partial-parse counters)
+    "critpath",      # per-step critical-path attribution: bottleneck
+                     # segment, per-segment critical fractions, slack and
+                     # 10%-speedup headroom (obs/critical_path.py)
 })
 
 # APIs whose first positional string argument IS a metric key

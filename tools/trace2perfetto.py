@@ -9,6 +9,13 @@ different processes line up on the shared wall clock and carry their
 ``trace_id`` in ``args`` so one rollout request can be followed
 trainer→manager→engine.
 
+Alignment: each dump leads with a per-process ``clock_anchor`` record
+(monotonic↔wall pairing); spans are placed at
+``anchor.wall_us - (anchor.mono_us - span.ts_mono_us)`` so a wall-clock
+step between a span's start and the export can't overlap two processes'
+timelines wrongly (obs/trace.py ``chrome_trace``). Dumps predating the
+anchor still merge on their raw wall stamps.
+
 Usage:
     python tools/trace2perfetto.py run_a/spans.jsonl run_b/spans.jsonl \
         -o trace.json
@@ -24,7 +31,7 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from polyrl_tpu.obs.trace import chrome_trace  # noqa: E402
+from polyrl_tpu.obs.trace import chrome_trace, is_clock_anchor  # noqa: E402
 
 
 def load_spans(paths: list[str]) -> list[dict]:
@@ -54,14 +61,16 @@ def main(argv: list[str] | None = None) -> int:
                         help="output Chrome/Perfetto trace JSON")
     args = parser.parse_args(argv)
     records = load_spans(args.inputs)
-    if not records:
+    spans = [r for r in records if not is_clock_anchor(r)]
+    if not spans:
         print("no spans found", file=sys.stderr)
         return 1
     with open(args.out, "w") as f:
         json.dump(chrome_trace(records), f)
-    traces = {r.get("trace_id") for r in records}
-    print(f"{args.out}: {len(records)} spans, {len(traces)} traces — open "
-          "in https://ui.perfetto.dev")
+    traces = {r.get("trace_id") for r in spans}
+    anchors = sum(1 for r in records if is_clock_anchor(r))
+    print(f"{args.out}: {len(spans)} spans, {len(traces)} traces, "
+          f"{anchors} clock anchors — open in https://ui.perfetto.dev")
     return 0
 
 
