@@ -365,6 +365,80 @@ def _cb_async_rl_drill(engine, params, cfg, rng, prompt_len, new_tokens,
     }
 
 
+def _cb_push_shard_drill(params, streams: int = 4,
+                         cap_mb: float | None = None) -> dict:
+    """Sharded-push wall of the cb phase's REAL weights: one warm-up round
+    plus one timed round over the production fabric (SenderAgent with the
+    resharding map engaged → ``streams`` parallel shard-to-shard TCP
+    streams into a loopback receiver). assemble_result promotes the result
+    as ``extra.transfer_push_streams`` / ``extra.push_shard_wall_s`` so
+    real-TPU rounds record the sharded-push wall alongside
+    ``rollout_decode_tok_s_per_chip``. Never fails the phase: errors and
+    over-cap sizes come back as a skip note."""
+    import numpy as np
+
+    from polyrl_tpu.transfer.agents import (ReceiverAgent, SenderAgent,
+                                            TransferConfig)
+    from polyrl_tpu.transfer.layout import (alloc_buffer, build_layout,
+                                            build_shard_spec, pack_params)
+
+    cap_mb = float(os.environ.get("POLYRL_BENCH_PUSH_SHARD_CAP_MB",
+                                  cap_mb if cap_mb is not None else 8192))
+    sender = None
+    rx = None
+    try:
+        layout = build_layout(params)
+        total_mb = layout.total_bytes / (1 << 20)
+        if total_mb > cap_mb:
+            return {"skipped": f"weights {total_mb:.0f} MB > cap {cap_mb} MB"}
+        # generous deadline floor: loopback TCP easily beats 200 Mbps, and
+        # a drill timeout must not look like a fabric regression
+        tcfg = TransferConfig(min_bandwidth_mbps=200.0,
+                              deadline_slack_s=5.0, stream_slack_s=5.0,
+                              retry_budget=2, backoff_base_s=0.05,
+                              backoff_max_s=0.2)
+        buf = alloc_buffer(layout)
+        sender = SenderAgent(buf, manager_client=None,
+                             listen_host="127.0.0.1", num_streams=streams,
+                             poll_s=0.05, advertise_host="127.0.0.1",
+                             cfg=tcfg, layout=layout,
+                             trainer_spec=build_shard_spec(params,
+                                                           axis="fsdp"))
+        sender.start()
+        rx = ReceiverAgent(layout, "cb-push-shard", sender.endpoint,
+                           num_streams=streams, listen_host="127.0.0.1",
+                           advertise_host="127.0.0.1",
+                           shard_spec=build_shard_spec(params, axis="tp"))
+        rx.start()
+        time.sleep(0.5)  # registration handshake
+        with sender.buffer_write_lock():
+            pack_params(params, layout, buf)  # D2H once; both rounds reuse
+        v = sender.signal_update()            # warm-up (first-round setup)
+        rx.wait_for_version(v, timeout=600.0)
+        t0 = time.monotonic()
+        v = sender.signal_update()
+        rx.wait_for_version(v, timeout=600.0)
+        wall = time.monotonic() - t0
+        return {
+            "push_wall_s": round(wall, 3),
+            "push_streams": int(sender.push_streams),
+            "stream_bw_mbps_min": round(sender.stream_bw_mbps_min, 1),
+            "reshard_bytes": int(sender.reshard_bytes),
+            "stream_resumes": int(sender.stream_resumes),
+            "total_bytes": int(layout.total_bytes),
+            "wire_gbps": round(layout.total_bytes * 8 / wall / 1e9, 2)
+            if wall > 0 else 0.0,
+            "bitwise_ok": bool(np.array_equal(rx.buffer, buf)),
+        }
+    except Exception as exc:  # noqa: BLE001 — advisory drill only
+        return {"skipped": f"error: {str(exc)[:200]}"}
+    finally:
+        if rx is not None:
+            rx.stop()
+        if sender is not None:
+            sender.stop()
+
+
 def bench_cb(cfg, params, batch, prompt_len, new_tokens, max_slots=64,
              page_size=64, steps_per_dispatch=8):
     """CB engine: direct in-process batch, then concurrent HTTP serving
@@ -481,11 +555,16 @@ def bench_cb(cfg, params, batch, prompt_len, new_tokens, max_slots=64,
                                                       "8")),
                             g=int(os.environ.get("POLYRL_BENCH_RL_G", "8")))
     server.stop()
+    # sharded-push wall of this phase's real weights (4 parallel
+    # shard-to-shard streams over the production fabric) — promoted by
+    # assemble_result as extra.transfer_push_streams/push_shard_wall_s
+    push_shard = _cb_push_shard_drill(params)
     trace = {k: round(v, 3) for k, v in sorted(engine.trace_report().items())}
     del engine
     gc.collect()
     return {
         "rl": rl,        # group-share + async-k rollout drill
+        "push_shard": push_shard,  # N-stream sharded push of the real bytes
         "trace": trace,  # cumulative s (and n_*) per engine phase
         "direct_tok_s": round(direct_tokens / dt_direct, 1),
         "serve_tok_s": round(serve_tokens / dt_serve, 1),
@@ -1522,6 +1601,105 @@ def push_chaos_bench(buffer_mb: float = 2.0, streams: int = 2,
         sender.stop()
 
 
+def push_shard_bench(buffer_mb: float = 8.0, streams: int = 4,
+                     rounds: int = 3, tp: int = 2) -> dict:
+    """Sharded weight-fabric A/B (``python bench.py --push-shard``): the
+    SAME fixed byte total pushed twice over real localhost TCP — once with
+    a single stream, once with ``streams`` parallel shard-to-shard streams
+    driven by the resharding map against a tp=``tp`` receiver. Each config
+    gets a registration warm-up round, then ``rounds`` timed rounds (min
+    wall — robust on a noisy shared box). Reports
+    ``push_shard.{speedup,bytes_per_stream,stream_resumes}`` — watched by
+    tools/bench_gate.py (speedup low-direction) — plus the per-config
+    walls, the map's resharded bytes, and a bitwise integrity check."""
+    import numpy as np
+
+    from polyrl_tpu.transfer.agents import (ReceiverAgent, SenderAgent,
+                                            TransferConfig)
+    from polyrl_tpu.transfer.layout import (ShardSpec, alloc_buffer,
+                                            build_layout,
+                                            build_resharding_map,
+                                            pack_params)
+
+    rng = np.random.default_rng(0)
+    # fixed total bytes across both configs: four tp-shardable matrices
+    # (alternating shard axes, 256 columns — divisible by any sane tp)
+    # plus a deliberately misaligned tail vector exercising the POOL path
+    rows = max(2 * tp, int(buffer_mb * (1 << 20)) // 4 // 4 // 256)
+    rows -= rows % (2 * tp)
+    params = {f"w{i}": rng.standard_normal((rows, 256)).astype(np.float32)
+              for i in range(4)}
+    params["tail"] = rng.standard_normal(257).astype(np.float32)
+    engine_spec = ShardSpec(tp, {"w0": 1, "w1": 0, "w2": 1, "w3": 0})
+    trainer_spec = ShardSpec(1, {})
+    layout = build_layout(params)
+    total = layout.total_bytes
+    rmap = build_resharding_map(layout, trainer_spec, engine_spec)
+    per_stream = [sum(ln for _, ln in ranges)
+                  for ranges in rmap.stream_assignments(streams)]
+    tcfg = TransferConfig(min_bandwidth_mbps=max(buffer_mb, 1.0),
+                          deadline_slack_s=2.0, stream_slack_s=2.0,
+                          retry_budget=2, backoff_base_s=0.05,
+                          backoff_max_s=0.2)
+
+    def one_config(n_streams: int) -> dict:
+        buf = alloc_buffer(layout)
+        sender = SenderAgent(buf, manager_client=None,
+                             listen_host="127.0.0.1",
+                             num_streams=n_streams, poll_s=0.05,
+                             advertise_host="127.0.0.1", cfg=tcfg,
+                             layout=layout, trainer_spec=trainer_spec)
+        rx = None
+        try:
+            sender.start()
+            rx = ReceiverAgent(layout, f"push-shard-s{n_streams}",
+                               sender.endpoint, num_streams=n_streams,
+                               listen_host="127.0.0.1",
+                               advertise_host="127.0.0.1",
+                               shard_spec=engine_spec)
+            rx.start()
+            time.sleep(0.3)  # registration handshake
+            with sender.buffer_write_lock():
+                pack_params(params, layout, buf)
+            v = sender.signal_update()  # warm-up: first-round setup costs
+            rx.wait_for_version(v, timeout=120.0)
+            walls = []
+            for _ in range(rounds):
+                t0 = time.monotonic()
+                v = sender.signal_update()
+                rx.wait_for_version(v, timeout=120.0)
+                walls.append(time.monotonic() - t0)
+            return {
+                "wall_s": round(min(walls), 4),
+                "walls_s": [round(w, 4) for w in walls],
+                "push_streams": int(sender.push_streams),
+                "stream_bw_mbps_min": round(sender.stream_bw_mbps_min, 1),
+                "reshard_bytes": int(sender.reshard_bytes),
+                "stream_resumes": int(sender.stream_resumes),
+                "verify_failures": int(sender.verify_failures),
+                "bitwise_ok": bool(np.array_equal(rx.buffer, buf)),
+            }
+        finally:
+            if rx is not None:
+                rx.stop()
+            sender.stop()
+
+    # sequential pairs — never two fabrics (or jax procs) at once
+    single = one_config(1)
+    multi = one_config(streams)
+    return {
+        "speedup": round(single["wall_s"] / max(multi["wall_s"], 1e-9), 3),
+        "bytes_per_stream": int(max(per_stream)),
+        "stream_resumes": int(multi["stream_resumes"]),
+        "total_bytes": int(total),
+        "streams": int(streams), "tp": int(tp), "rounds": int(rounds),
+        "reshard_bytes_per_round": int(rmap.reshard_bytes()),
+        "single": single,
+        "multi": multi,
+        "bitwise_ok": bool(single["bitwise_ok"] and multi["bitwise_ok"]),
+    }
+
+
 # TPU peak specs by device_kind prefix for the MFU/bandwidth-utilization
 # fields (VERDICT r3 item 2). Conservative public numbers; fallback = v5e.
 _CHIP_PEAKS = {
@@ -1812,6 +1990,13 @@ def assemble_result(state: dict) -> dict:
         extra["rollout_decode_tok_s_per_chip"] = round(
             rl["decode_tok_s"] / max(meta.get("n_chips", 1), 1), 1)
         extra["rl_staleness_p95"] = rl.get("staleness_p95", 0.0)
+    # promote the cb phase's sharded-push drill: the N-stream push wall of
+    # the REAL weights lands next to the decode headline, so real-TPU
+    # rounds track the sharded fabric across the trajectory
+    ps = cb.get("push_shard") or {}
+    if ps.get("push_wall_s"):
+        extra["transfer_push_streams"] = ps.get("push_streams", 0)
+        extra["push_shard_wall_s"] = ps["push_wall_s"]
     preset = meta.get("preset", "qwen3-1.7b")
     batch = meta.get("batch", 256)
     prompt_len = meta.get("prompt_len", 128)
@@ -2256,6 +2441,21 @@ if __name__ == "__main__":
         print(json.dumps({"metric": "push_chaos_recovery_s",
                           "value": res["transfer_recovery_s"], "unit": "s",
                           "extra": {"push_chaos": res}}))
+    elif "--push-shard" in sys.argv:
+        # sharded weight-fabric A/B: 1 vs N parallel shard-to-shard push
+        # streams at fixed total bytes against a tp-sharded receiver; the
+        # headline is the wall-clock speedup, extras carry the per-stream
+        # byte cap and resume counters watched by bench_gate. CPU-only,
+        # never touches the TPU phase machine.
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        res = push_shard_bench(
+            buffer_mb=_cli_float("--buffer-mb", 8.0),
+            streams=int(_cli_float("--streams", 4)),
+            rounds=int(_cli_float("--rounds", 3)),
+            tp=int(_cli_float("--tp", 2)))
+        print(json.dumps({"metric": "push_shard_speedup",
+                          "value": res["speedup"], "unit": "x",
+                          "extra": {"push_shard": res}}))
     elif "--group-share" in sys.argv:
         # group-shared prefill A/B: shared vs forced-independent admission
         # on the GRPO traffic shape — its own entry, CPU-sized by default

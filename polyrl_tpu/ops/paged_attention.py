@@ -62,6 +62,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from polyrl_tpu.parallel.compat import shard_map
+
 NEG_INF = float(np.finfo(np.float32).min)
 
 
@@ -636,7 +638,7 @@ def make_tp_grouped_paged_attention(mesh):
             q, k_pool, v_pool, page_table, seq_lens, group_slots,
             group_prefix_pages, group_prefix_lens)
 
-    return jax.shard_map(
+    return shard_map(
         inner, mesh=mesh,
         in_specs=(P(None, TP, None), P(TP, None, None, None),
                   P(TP, None, None, None), P(), P(), P(), P(), P()),
@@ -687,7 +689,13 @@ def paged_kv_write_pallas(k_pool, v_pool, write_page, write_off, k_upd,
     from jax.experimental.pallas import tpu as pltpu
 
     s = write_page.shape[0]
-    hbm = pl.BlockSpec(memory_space=pltpu.MemorySpace.HBM)
+    # jax-version portability: new pallas spells HBM residency
+    # pltpu.MemorySpace.HBM; the legacy enum (TPUMemorySpace) has no HBM
+    # member — ANY is its idiom for "stays in HBM, kernel DMAs manually"
+    _ms = getattr(pltpu, "MemorySpace", None)
+    hbm = pl.BlockSpec(
+        memory_space=_ms.HBM if _ms is not None
+        else pltpu.TPUMemorySpace.ANY)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(s,),
@@ -706,7 +714,9 @@ def paged_kv_write_pallas(k_pool, v_pool, write_page, write_off, k_upd,
         interpret=interpret,
         # DMA targets depend on scalar-prefetched indices, never on other
         # grid steps' work; "arbitrary" keeps Mosaic from reordering
-        compiler_params=pltpu.CompilerParams(
+        # (CompilerParams is TPUCompilerParams on legacy pallas)
+        compiler_params=getattr(pltpu, "CompilerParams",
+                                getattr(pltpu, "TPUCompilerParams", None))(
             dimension_semantics=("arbitrary",)),
     )(write_page.astype(jnp.int32), write_off.astype(jnp.int32),
       k_pool, v_pool, k_upd.astype(k_pool.dtype), v_upd.astype(v_pool.dtype))
@@ -780,7 +790,7 @@ def make_tp_paged_kv_write(mesh):
     def inner(k_pool, v_pool, page, off, k_upd, v_upd):
         return paged_kv_write(k_pool, v_pool, page, off, k_upd, v_upd)
 
-    return jax.shard_map(
+    return shard_map(
         inner, mesh=mesh,
         in_specs=(P(TP, None, None, None), P(TP, None, None, None),
                   P(), P(), P(None, TP, None), P(None, TP, None)),
@@ -802,7 +812,7 @@ def make_tp_paged_attention(mesh):
     def inner(q, k_pool, v_pool, page_table, seq_lens):
         return paged_attention(q, k_pool, v_pool, page_table, seq_lens)
 
-    return jax.shard_map(
+    return shard_map(
         inner, mesh=mesh,
         in_specs=(P(None, TP, None), P(TP, None, None, None),
                   P(TP, None, None, None), P(), P()),

@@ -1,0 +1,57 @@
+"""Version-portable ``shard_map`` for the jax span this repo supports.
+
+``jax.shard_map`` (top-level, keyword ``check_vma``/``axis_names``) only
+exists on newer jax; this image's 0.4.37 ships the experimental API
+(``jax.experimental.shard_map.shard_map``) whose equivalent keywords are
+``check_rep`` and ``auto``. The two differ in more than spelling:
+
+- ``check_vma=False``  ==  ``check_rep=False`` (skip the replication /
+  varying-manual-axes check; our kernels wrap custom calls the checker
+  can't see through).
+- ``axis_names={...}`` names the axes the body IS manual over, while the
+  old ``auto={...}`` names the mesh axes the body is NOT manual over —
+  so ``auto = mesh.axis_names - axis_names``.
+
+Every in-repo shard_map goes through this shim; call sites use the NEW
+spelling and the shim down-translates when running on the legacy API.
+"""
+
+from __future__ import annotations
+
+import jax
+
+_NEW = hasattr(jax, "shard_map")
+if not _NEW:  # legacy experimental API (jax <= 0.4.x)
+    from jax.experimental.shard_map import shard_map as _legacy_shard_map
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma=None,
+              axis_names=None):
+    """``jax.shard_map`` with new-style kwargs on any supported jax.
+
+    ``axis_names``: the mesh axes the body is manual over (None = all).
+    ``check_vma``: the new-API replication/VMA check toggle (None = API
+    default).
+    """
+    if _NEW:
+        kwargs = {}
+        if check_vma is not None:
+            kwargs["check_vma"] = check_vma
+        if axis_names is not None:
+            kwargs["axis_names"] = set(axis_names)
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, **kwargs)
+    kwargs = {}
+    if check_vma is not None:
+        kwargs["check_rep"] = check_vma
+    # ``axis_names`` maps to legacy ``auto = mesh_axes - axis_names`` — but
+    # legacy partial-auto lowering is broken on this jaxlib (axis_index
+    # emits a PartitionId op the SPMD partitioner rejects; threading the
+    # index as an input instead trips a manual-subgroup CHECK crash). All
+    # in-repo partial-manual regions take inputs replicated over their auto
+    # axes (pipeline.py param/activation specs name only pp/sp), so the
+    # correct legacy fallback is FULLY manual: the body replicates over the
+    # would-be-auto axes — identical math, only losing intra-region
+    # GSPMD sharding over those axes on old jax.
+    return _legacy_shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, **kwargs)

@@ -48,6 +48,7 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from polyrl_tpu.ops.attention import repeat_kv
+from polyrl_tpu.parallel.compat import shard_map
 from polyrl_tpu.parallel.mesh import DP, FSDP, SP, TP
 
 _NEG = -0.7 * float(jnp.finfo(jnp.float32).max)  # finite -inf (no exp NaNs)
@@ -113,11 +114,11 @@ def make_ulysses_attention(mesh: Mesh, axis: str = SP,
     qkv_spec = P(batch_axes, axis, TP, None)  # heads stay tp-sharded
     mask_spec = P(batch_axes, axis)
     if packed:
-        return jax.shard_map(
+        return shard_map(
             inner, mesh=mesh,
             in_specs=(qkv_spec, qkv_spec, qkv_spec, mask_spec, mask_spec),
             out_specs=qkv_spec, check_vma=False)
-    return jax.shard_map(
+    return shard_map(
         lambda q, k, v, tm: inner(q, k, v, tm), mesh=mesh,
         in_specs=(qkv_spec, qkv_spec, qkv_spec, mask_spec),
         out_specs=qkv_spec, check_vma=False)
@@ -203,11 +204,11 @@ def make_ring_attention(mesh: Mesh, axis: str = SP, batch_axes=(DP, FSDP),
     qkv_spec = P(batch_axes, axis, TP, None)  # heads stay tp-sharded
     mask_spec = P(batch_axes, axis)
     if packed:
-        return jax.shard_map(
+        return shard_map(
             inner, mesh=mesh,
             in_specs=(qkv_spec, qkv_spec, qkv_spec, mask_spec, mask_spec),
             out_specs=qkv_spec, check_vma=False)
-    return jax.shard_map(
+    return shard_map(
         lambda q, k, v, tm: inner(q, k, v, tm), mesh=mesh,
         in_specs=(qkv_spec, qkv_spec, qkv_spec, mask_spec),
         out_specs=qkv_spec, check_vma=False)

@@ -394,6 +394,11 @@ class PoolManager:
                 # flight-deck load view (0.0 for engines predating it)
                 "occupancy": float(i.get("occupancy", 0.0)),
                 "page_util": float(i.get("page_util", 0.0)),
+                # sharded-push receive plane (receiver health): how many
+                # parallel push streams this engine accepts per round and
+                # its advertised tp shard count (1 = unsharded install)
+                "push_streams": int(i.get("transfer_push_streams", 0)),
+                "shard_tp": int(i.get("transfer_shard_tp", 1)),
             } for i in st.get("instances", [])],
             "snapshot_age_s": round(age, 3),
         }

@@ -187,8 +187,10 @@ class RemoteRollout:
         if transfer_counters is not None:
             # weight-fabric supervision (transfer/* gauges: push failures/
             # retries, verify rejections, resumed bytes, laggard
-            # escalations + knob echo) — rides every step record, which is
-            # what the FlightRecorder's transfer/push_failures watch reads
+            # escalations, the sharded-push plane — push_streams,
+            # stream_bw_mbps_min, reshard_bytes, stream_resumes — + knob
+            # echo) — rides every step record, which is what the
+            # FlightRecorder's transfer/push_failures watch reads
             out.update(transfer_counters())
         retries = getattr(self.manager, "retry_count", None)
         if retries is not None:

@@ -198,7 +198,7 @@ def register_with_manager(server, manager_endpoint: str = "",
     so a bound supervisor records the membership for /reconcile replay."""
     from polyrl_tpu.manager.client import ManagerClient
     from polyrl_tpu.transfer.agents import ReceiverAgent
-    from polyrl_tpu.transfer.layout import build_layout
+    from polyrl_tpu.transfer.layout import build_layout, build_shard_spec
 
     if client is None:
         if not manager_endpoint:
@@ -218,10 +218,18 @@ def register_with_manager(server, manager_endpoint: str = "",
         layout = build_layout(server.weight_template
                               if server.weight_template is not None
                               else server.engine.params)
+        # advertise THIS engine's tp sharding so the sender builds the
+        # (trainer shard → engine shard) resharding map per receiver.
+        # Quantized/LoRA wire templates are host trees — they come back
+        # replicated, which correctly disables the sharded plan for them.
+        shard_spec = build_shard_spec(server.weight_template
+                                      if server.weight_template is not None
+                                      else server.engine.params, axis="tp")
         advertise = server.endpoint.rsplit(":", 1)[0]
         server.receiver = ReceiverAgent(
             layout, server.endpoint, sender_ep,
-            num_streams=transfer_streams, advertise_host=advertise)
+            num_streams=transfer_streams, advertise_host=advertise,
+            shard_spec=shard_spec)
         server.receiver.start()
         log.info("receiver agent attached to sender %s", sender_ep)
 

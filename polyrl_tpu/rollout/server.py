@@ -748,7 +748,8 @@ class RolloutServer:
             return True, ""
         try:
             from polyrl_tpu.transfer.layout import (
-                make_incremental_installer, unflatten_like, unpack_params,
+                make_incremental_installer, make_sharded_installer,
+                unflatten_like, unpack_params,
             )
 
             template = (self.weight_template if self.weight_template
@@ -760,9 +761,16 @@ class RolloutServer:
                 # the assembled tree, so they keep the post-wire path.
                 # dtype/sharding come from the LIVE tree (template may be
                 # abstract ShapeDtypeStructs), matching the serial path's
-                # tree_map over engine.params
-                install, device_named = make_incremental_installer(
-                    self.engine.params)
+                # tree_map over engine.params. tp>1 engines take the
+                # SHARDED installer: each leaf lands shard-by-shard via
+                # per-device device_put + assembly, so the full-size
+                # device array never materializes on one chip.
+                if getattr(self.engine, "mesh", None) is not None:
+                    install, device_named = make_sharded_installer(
+                        self.engine.params)
+                else:
+                    install, device_named = make_incremental_installer(
+                        self.engine.params)
                 # record the version actually INSTALLED: when a
                 # superseding round's bytes landed instead, reporting the
                 # older requested version would under-report until the

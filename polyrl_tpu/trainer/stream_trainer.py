@@ -1158,7 +1158,18 @@ class StreamRLTrainer:
                      "version": float(getattr(self.rollout,
                                               "weight_version", 0)),
                      "staleness": float(rec.get(
-                         "perf/weight_staleness", 0.0))},
+                         "perf/weight_staleness", 0.0)),
+                     # sharded-push plane (PR 15): stream fan-out width,
+                     # slowest-stream bandwidth, resharded bytes, and
+                     # per-stream resume count for the last rounds
+                     "push_streams": counters.get(
+                         "transfer/push_streams", 0.0),
+                     "stream_bw_mbps_min": counters.get(
+                         "transfer/stream_bw_mbps_min", 0.0),
+                     "reshard_bytes": counters.get(
+                         "transfer/reshard_bytes", 0.0),
+                     "stream_resumes": counters.get(
+                         "transfer/stream_resumes", 0.0)},
             pool=pool.statusz_section() if pool is not None else None,
             # fleet flight-deck aggregate (the rollout plane serves its own
             # per-engine ledger; the trainer serves the pool-wide view)

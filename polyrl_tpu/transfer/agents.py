@@ -50,7 +50,7 @@ import numpy as np
 from polyrl_tpu import obs
 from polyrl_tpu.rollout.faults import TransferFaultConfig
 
-from .layout import ParamLayout, alloc_buffer
+from .layout import ParamLayout, ShardSpec, alloc_buffer, build_resharding_map
 from .tcp_engine import ReceiverSockets, TcpTransferEngine
 
 log = logging.getLogger(__name__)
@@ -94,9 +94,31 @@ class TransferConfig:
         bw = max(self.min_bandwidth_mbps, 1e-6) * 1e6
         return min(cap, nbytes / bw + slack)
 
+    def stream_deadline_s(self, nbytes: int, streamed: bool) -> float:
+        """Per-STREAM deadline of the sharded push: keyed to the bytes that
+        one stream carries, so a stalled stream is detected after its own
+        share's wire time — not after the whole round's — while the other
+        streams keep landing."""
+        return self.push_deadline_s(nbytes, streamed)
+
 
 def _send_json(sock: socket.socket, obj: dict) -> None:
     sock.sendall((json.dumps(obj) + "\n").encode())
+
+
+def _merge_ranges(rs: list[tuple[int, int]]) -> list[tuple[int, int]]:
+    """Sorted union of (offset, length) ranges, merging overlaps/adjacency
+    — a resume list must be disjoint (overlapping clears are idempotent on
+    the receiver but would double-send bytes on the wire)."""
+    rs = sorted((int(o), int(ln)) for o, ln in rs if int(ln) > 0)
+    out: list[tuple[int, int]] = []
+    for o, ln in rs:
+        if out and o <= out[-1][0] + out[-1][1]:
+            end = max(out[-1][0] + out[-1][1], o + ln)
+            out[-1] = (out[-1][0], end - out[-1][0])
+        else:
+            out.append((o, ln))
+    return out
 
 
 class _LineReader:
@@ -137,9 +159,15 @@ class ReceiverAgent:
                  sender_endpoint: str, num_streams: int = 4,
                  listen_host: str = "0.0.0.0", advertise_host: str | None = None,
                  reconnect_backoff_s: float = 0.2,
-                 reconnect_backoff_max_s: float = 10.0):
+                 reconnect_backoff_max_s: float = 10.0,
+                 shard_spec=None):
         self.layout = layout
         self.buffer = alloc_buffer(layout)
+        # the engine's shard spec (transfer/layout.py ShardSpec), advertised
+        # in the register message so the sender can build the trainer→engine
+        # ReshardingMap for this receiver and fan the round over shard-owned
+        # streams; None = replicated engine (tp=1)
+        self.shard_spec = shard_spec
         self.instance_endpoint = instance_endpoint
         self.sender_host, self.sender_port = _split(sender_endpoint)
         self.sockets = ReceiverSockets(self.buffer, num_streams, listen_host)
@@ -182,6 +210,9 @@ class ReceiverAgent:
                         "buffer_len": int(self.buffer.nbytes),
                         "host": self.advertise_host,
                         "ports": self.sockets.ports,
+                        "shard_spec": (self.shard_spec.to_jsonable()
+                                       if self.shard_spec is not None
+                                       else None),
                     })
                     reader = _LineReader(s)
                     while not self._stop.is_set():
@@ -298,6 +329,9 @@ class ReceiverAgent:
             "transfer_rounds_verified": int(self.rounds_verified),
             "transfer_resumed_bytes": int(self.resumed_bytes),
             "transfer_weight_version": int(self.version),
+            "transfer_push_streams": len(self.sockets.ports),
+            "transfer_shard_tp": int(self.shard_spec.num_shards
+                                     if self.shard_spec else 1),
         }
 
     def wait_for_version(self, version: int, timeout: float = 600.0,
@@ -468,6 +502,13 @@ class _Registration:
     verify_evt: threading.Event = field(default_factory=threading.Event)
     verify_msg: dict | None = None
     pushed_version: int = -1
+    # the engine's advertised ShardSpec (None = replicated) and the cached
+    # per-stream assignment plan built from it on first push — invalidated
+    # only by re-registration, since layout and spec are both immutable for
+    # a registration's lifetime
+    shard_spec: object | None = None
+    stream_plan: list | None = None
+    reshard_total: int = 0
 
 
 class SenderAgent:
@@ -479,10 +520,18 @@ class SenderAgent:
                  listen_host: str = "0.0.0.0", num_streams: int = 4,
                  poll_s: float = 1.0, advertise_host: str | None = None,
                  bind_host: str | None = None,
-                 cfg: TransferConfig | None = None, fault=None):
+                 cfg: TransferConfig | None = None, fault=None,
+                 layout: ParamLayout | None = None,
+                 trainer_spec=None):
         self.buffer = buffer
         self.manager = manager_client
         self.cfg = cfg or TransferConfig()
+        # sharded-push inputs: with a layout, each receiver's advertised
+        # ShardSpec yields a ReshardingMap whose stream_assignments fan the
+        # round over num_streams shard-owned range lists (layout=None keeps
+        # the legacy contiguous split)
+        self.layout = layout
+        self.trainer_spec = trainer_spec
         # transfer-plane chaos injector (rollout/faults.py); interruptible
         # on stop() so a sleeping stall never pins teardown
         self.fault = fault
@@ -540,6 +589,15 @@ class SenderAgent:
         # registers, the idle poll finds it stale, it gets the CURRENT
         # version in one round, then rides the normal push fan-out)
         self.catchup_pushes = 0
+        # sharded-push telemetry: streams the last round fanned over, the
+        # slowest stream's bandwidth that round (the round's critical path),
+        # cumulative bytes carried on shard-pair-owned ranges, and how many
+        # individual stream failures were converted into partial resumes
+        # instead of full re-pushes
+        self.push_streams = 0
+        self.stream_bw_mbps_min = 0.0
+        self.reshard_bytes = 0
+        self.stream_resumes = 0
         self._server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._server.bind((listen_host, 0))
@@ -679,7 +737,9 @@ class SenderAgent:
                         return
                     reg = _Registration(instance=msg["instance"],
                                         host=msg["host"],
-                                        ports=list(msg["ports"]), sock=conn)
+                                        ports=list(msg["ports"]), sock=conn,
+                                        shard_spec=ShardSpec.from_jsonable(
+                                            msg.get("shard_spec")))
                     with self._regs_lock:
                         self._regs[reg.instance] = reg
                         # a fresh registration clears any standing laggard
@@ -797,6 +857,7 @@ class SenderAgent:
             h = self._health.setdefault(instance, {
                 "pushed_version": -1, "push_failures": 0,
                 "verify_failures": 0, "resumed_bytes": 0,
+                "stream_resumes": 0,
                 "last_push_s": None, "escalated": False, "last_error": ""})
             for k, v in (inc or {}).items():
                 h[k] = h.get(k, 0) + v
@@ -828,6 +889,10 @@ class SenderAgent:
             "transfer/rounds_verified": float(self.rounds_verified),
             "transfer/laggard_escalations": float(self.laggard_escalations),
             "transfer/catchup_pushes": float(self.catchup_pushes),
+            "transfer/push_streams": float(self.push_streams),
+            "transfer/stream_bw_mbps_min": float(self.stream_bw_mbps_min),
+            "transfer/reshard_bytes": float(self.reshard_bytes),
+            "transfer/stream_resumes": float(self.stream_resumes),
         }
 
     def _escalate(self, instance: str, version: int, err: str) -> None:
@@ -883,14 +948,23 @@ class SenderAgent:
             else:
                 registered_once = True
                 try:
-                    missing = self._push_one(reg, version, buffer,
-                                             watermark, ranges=missing)
+                    missing, rejected = self._push_one(reg, version, buffer,
+                                                       watermark,
+                                                       ranges=missing)
                     if not missing:
                         return  # verified + installed
-                    self.verify_failures += 1
-                    self._note_health(instance, inc={"verify_failures": 1})
-                    last_err = f"verify_failed ({len(missing)} ranges)"
-                    log.warning("push v%d to %s rejected by verify: %s",
+                    if rejected:
+                        # the RECEIVER rejected landed bytes (digest/gap
+                        # check) — distinct from a sender-side stream
+                        # failure, which resumes without being a verify
+                        # failure (the fabric didn't reject clean bytes)
+                        self.verify_failures += 1
+                        self._note_health(instance,
+                                          inc={"verify_failures": 1})
+                        last_err = f"verify_failed ({len(missing)} ranges)"
+                    else:
+                        last_err = f"stream_failed ({len(missing)} ranges)"
+                    log.warning("push v%d to %s incomplete: %s",
                                 version, instance, last_err)
                 except Exception as exc:  # noqa: BLE001 — retried below
                     last_err = f"{type(exc).__name__}: {exc}"
@@ -922,22 +996,84 @@ class SenderAgent:
         except OSError:
             pass
 
+    def _stream_plan(self, reg: _Registration):
+        """Lazily build (and cache on the registration) the sharded
+        per-stream assignment plan for this receiver: the trainer→engine
+        :class:`~polyrl_tpu.transfer.layout.ReshardingMap` packed into
+        min(num_streams, receiver ports) balanced range lists. None when
+        the sender has no layout (legacy contiguous split)."""
+        if self.layout is None or self.layout.total_bytes != self.buffer.nbytes:
+            return None
+        if reg.stream_plan is None:
+            rmap = build_resharding_map(self.layout, self.trainer_spec,
+                                        reg.shard_spec)
+            n = min(self.engine.num_streams, len(reg.ports)) or 1
+            reg.stream_plan = rmap.stream_assignments(n)
+            reg.reshard_total = rmap.reshard_bytes()
+        return reg.stream_plan
+
+    def _collect_streams(self, batch, t0: float, streamed: bool):
+        """Per-stream supervision of one wire round: each stream is waited
+        under its OWN bandwidth-keyed deadline (anchored at ``t0`` — the
+        streams run concurrently). Returns (manifest, missing_pre, errors):
+        the concatenated frame manifests of the streams that landed, the
+        full assigned ranges of those that didn't (re-pushed on resume —
+        a dead stream's partially-landed tail is not trusted), and one
+        error string per failed stream."""
+        cfg = self.cfg
+        manifest: list[tuple[int, int, int]] = []
+        missing_pre: list[tuple[int, int]] = []
+        errors: list[str] = []
+        bw_min = None
+        for i, fut in enumerate(batch.futures):
+            assigned = (batch.assignments[i]
+                        if i < len(batch.assignments) else [])
+            sbytes = sum(ln for _, ln in assigned)
+            dl = cfg.stream_deadline_s(sbytes, streamed)
+            remaining = (t0 + dl) - time.monotonic()
+            try:
+                manifest.extend(fut.result(timeout=max(0.05, remaining))
+                                or [])
+                dt = time.monotonic() - t0
+                if sbytes and dt > 0:
+                    bw = sbytes / dt / 1e6
+                    bw_min = bw if bw_min is None else min(bw_min, bw)
+            except Exception as exc:  # noqa: BLE001 — per-stream resume
+                errors.append(f"stream {i}: {type(exc).__name__}: {exc}")
+                missing_pre.extend(assigned)
+        self.push_streams = len(batch.futures)
+        if bw_min is not None:
+            self.stream_bw_mbps_min = round(bw_min, 3)
+        return manifest, missing_pre, errors
+
     def _push_one(self, reg: _Registration, version: int,
                   buffer: np.ndarray, watermark=None,
                   ranges: list[tuple[int, int]] | None = None,
-                  ) -> list[tuple[int, int]]:
-        """One push attempt: prepare/arm, wire under the bandwidth-keyed
-        deadline, then the verify handshake. Returns [] on a verified
-        install, or the ranges the receiver reported failed (the caller
-        resumes with exactly those); raises on transport failure."""
+                  ) -> tuple[list[tuple[int, int]], bool]:
+        """One push attempt: prepare/arm, fan the wire over N streams each
+        under its own bandwidth-keyed deadline, then the verify handshake.
+        Returns ``(missing, rejected)``: ``([], _)`` on a verified install;
+        otherwise the merged ranges to resume — the failed streams' full
+        assignments plus whatever the receiver's digest/gap check rejected
+        — with ``rejected`` True only when the RECEIVER rejected bytes the
+        sender believed landed. Raises on transport failure (every stream
+        failed, control channel dead, ...)."""
         cfg = self.cfg
         with self._cv:
             self._round_counter += 1
             round_id = self._round_counter
+        streamed = watermark is not None
+        # sharded fan-out applies to full packed rounds; resumes carry the
+        # failed ranges round-robin, and watermark rounds keep the STRIPE
+        # interleave (a shard-grouped slab would idle every stream whose
+        # slab the packer hadn't reached — the exact serialization the
+        # stripe assignment exists to avoid)
+        plan = None
+        if ranges is None and not streamed:
+            plan = self._stream_plan(reg)
         push_bytes = (sum(ln for _, ln in ranges) if ranges
                       else buffer.nbytes)
-        deadline = cfg.push_deadline_s(push_bytes,
-                                       streamed=watermark is not None)
+        deadline = cfg.push_deadline_s(push_bytes, streamed=streamed)
         with reg.lock:
             reg.ready.clear()
             reg.verify_evt.clear()
@@ -958,8 +1094,13 @@ class SenderAgent:
                 reg.host, reg.ports, buffer, round_id=round_id,
                 watermark=watermark, ranges=ranges,
                 gate_timeout_s=deadline + 1.0,
-                fault=self.fault, instance=reg.instance)
-            manifest = batch.result(timeout=deadline)
+                fault=self.fault, instance=reg.instance,
+                assignments=plan)
+            manifest, missing_pre, errors = self._collect_streams(
+                batch, t0, streamed)
+            if errors and len(errors) == len(batch.futures):
+                raise ConnectionError(
+                    f"all {len(batch.futures)} streams failed: {errors[0]}")
             if (self.fault is not None
                     and self.fault.take_control_kill(reg.instance)):
                 # chaos: control-plane death right before the verify
@@ -989,27 +1130,46 @@ class SenderAgent:
                 if int(vr.get("round", -1)) != round_id:
                     raise ConnectionError("verify result round mismatch")
                 if vr.get("ok"):
+                    # full coverage verified — even a timed-out stream's
+                    # bytes landed and digest-checked (the receiver has
+                    # already installed the version; treat as success)
                     missing = []
+                    missing_pre = []
+                    errors = []
                 else:
                     missing = [(int(o), int(ln))
                                for o, ln in vr.get("missing") or []]
-                    if not missing:
+                    if not missing and not missing_pre:
                         raise ConnectionError(
                             "verify failed without resumable ranges: "
                             f"{vr.get('error')}")
             else:
+                if errors:
+                    # the trusting path has no verify round to scope a
+                    # partial resume — a lost stream fails the attempt
+                    raise ConnectionError(
+                        f"{len(errors)} streams failed: {errors[0]}")
                 # trusting path: bare completion installs the version
                 _send_json(reg.sock, {"event": "transfer_done",
                                       "status": "success",
                                       "version": version})
                 missing = []
             dt = time.monotonic() - t0
-        if missing:
-            return missing
+        if errors:
+            # individual stream failures become a partial resume instead
+            # of a full re-push: only those streams' ranges return
+            self.stream_resumes += len(errors)
+            self._note_health(reg.instance,
+                              inc={"stream_resumes": len(errors)})
+        if missing or missing_pre:
+            rejected = bool(missing) and not errors
+            return _merge_ranges(missing + missing_pre), rejected
         if ranges:
             resumed = sum(ln for _, ln in ranges)
             self.resumed_bytes += resumed
             self._note_health(reg.instance, inc={"resumed_bytes": resumed})
+        if plan is not None:
+            self.reshard_bytes += reg.reshard_total
         self.rounds_verified += 1
         if reg.pushed_version < 0:
             self.catchup_pushes += 1
@@ -1023,15 +1183,16 @@ class SenderAgent:
         # (bad NIC, busy engine) shows up as a p99/max outlier that the
         # fleet-wide MB/s mean would average away
         obs.observe("transfer/push_s", dt)
-        log.info("pushed v%d to %s: %.0f MB/s%s", version, reg.instance,
-                 mbps, " (resume)" if ranges else "")
+        log.info("pushed v%d to %s: %.0f MB/s over %d stream(s)%s", version,
+                 reg.instance, mbps, max(1, self.push_streams),
+                 " (resume)" if ranges else "")
         if self.manager is not None:
             # async notify so the instance rejoins the pool without the
             # trainer's next pack blocking on the engine's weight load
             # (sender_agent.py:617-624)
             self._notify_pool.submit(
                 self.manager.update_weights, [reg.instance], version)
-        return []
+        return [], False
 
 
 class SenderGroup:
@@ -1055,7 +1216,8 @@ class SenderGroup:
     def __init__(self, buffer: np.ndarray, sender_ips: list[str],
                  manager_client=None, num_streams: int = 4,
                  poll_s: float = 1.0, listen_host: str = "0.0.0.0",
-                 cfg: TransferConfig | None = None, fault=None):
+                 cfg: TransferConfig | None = None, fault=None,
+                 layout: ParamLayout | None = None, trainer_spec=None):
         if not sender_ips:
             raise ValueError("SenderGroup needs at least one sender IP")
         self.manager = manager_client
@@ -1063,7 +1225,8 @@ class SenderGroup:
             SenderAgent(buffer, manager_client=manager_client,
                         listen_host=listen_host, num_streams=num_streams,
                         poll_s=poll_s, advertise_host=ip, bind_host=ip,
-                        cfg=cfg, fault=fault)
+                        cfg=cfg, fault=fault, layout=layout,
+                        trainer_spec=trainer_spec)
             for ip in sender_ips
         ]
 

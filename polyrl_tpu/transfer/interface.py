@@ -27,7 +27,8 @@ import numpy as np
 from polyrl_tpu import obs
 
 from .agents import SenderAgent, SenderGroup, TransferConfig
-from .layout import ParamLayout, alloc_buffer, build_layout, pack_params
+from .layout import (ParamLayout, alloc_buffer, build_layout,
+                     build_shard_spec, pack_params, pack_params_ranges)
 from .nic import pick_sender_ips
 
 log = logging.getLogger(__name__)
@@ -41,6 +42,11 @@ class TransferInterface:
                  groups_per_sender: int = 1,
                  cfg: TransferConfig | None = None, fault=None):
         self.layout: ParamLayout = build_layout(params_template)
+        # trainer-side shard spec (fsdp-axis): feeds the sender's
+        # ReshardingMap so each push stream carries the (trainer shard →
+        # engine shard) ranges it owns. Host-array templates come back
+        # replicated (num_shards=1) — the map then has one trainer side.
+        self.trainer_spec = build_shard_spec(params_template, axis="fsdp")
         # supervision knobs (config ``transfer.*``) + optional transfer-
         # plane fault injector (rollout/faults.py TransferFaultInjector)
         self.cfg = cfg or TransferConfig()
@@ -61,13 +67,16 @@ class TransferInterface:
             self.sender: SenderAgent | SenderGroup = SenderGroup(
                 front, ips, manager_client=manager_client,
                 num_streams=num_streams, poll_s=poll_s,
-                cfg=self.cfg, fault=fault)
+                cfg=self.cfg, fault=fault, layout=self.layout,
+                trainer_spec=self.trainer_spec)
             endpoints = self.sender.endpoints
         else:
             self.sender = SenderAgent(front, manager_client=manager_client,
                                       num_streams=num_streams, poll_s=poll_s,
                                       advertise_host=advertise_host,
-                                      cfg=self.cfg, fault=fault)
+                                      cfg=self.cfg, fault=fault,
+                                      layout=self.layout,
+                                      trainer_spec=self.trainer_spec)
             endpoints = [self.sender.endpoint]
         self.manager = manager_client
         # async push state: pending pack/wire rounds CHAIN on a FIFO of
@@ -88,6 +97,18 @@ class TransferInterface:
         if manager_client is not None:
             manager_client.update_weight_senders(
                 endpoints, groups_per_sender=groups_per_sender)
+
+    def _pack_full(self, params: Any, buffer: np.ndarray) -> None:
+        """Serial-mode pack. Mesh-sharded trainers go through the range
+        path — ``pack_params_ranges`` reads each leaf's addressable shards
+        (axis-0 block copies) instead of ``device_get`` on the global
+        array, so no full-buffer gather materializes per leaf; replicated
+        templates keep the batched ``pack_params`` fast path."""
+        if self.trainer_spec is not None and self.trainer_spec.num_shards > 1:
+            pack_params_ranges(params, self.layout, buffer,
+                               [(0, self.layout.total_bytes)])
+        else:
+            pack_params(params, self.layout, buffer)
 
     def update_weights_with_agent(self, params: Any,
                                   streaming: bool = True) -> int:
@@ -143,7 +164,7 @@ class TransferInterface:
         else:
             if self._back is None:
                 self._back = alloc_buffer(self.layout)
-            pack_params(params, self.layout, self._back)
+            self._pack_full(params, self._back)
             if self.manager is not None:
                 version = self.manager.update_weight_version()
             else:

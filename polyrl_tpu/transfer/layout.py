@@ -215,6 +215,346 @@ def make_incremental_installer(template: Any):
     return install, device_named
 
 
+# --------------------------------------------------------------------------
+# Sharded weight fabric: trainer→engine resharding map
+# --------------------------------------------------------------------------
+
+# An entry sharded along a non-leading axis fragments into one byte range
+# per outer block (prod(shape[:axis]) of them). Past this many ranges the
+# per-stream manifests stop paying for shard affinity — the entry falls
+# back to the replicated round-robin pool (coarse ALIGN-granular chunks),
+# which changes stream/shard affinity but never coverage or correctness.
+MAX_RANGES_PER_ENTRY = 256
+
+# owner id for bytes no single (trainer, engine) shard pair owns:
+# replicated entries, range-explosion fallbacks and alignment padding
+POOL = -1
+
+
+@dataclass(frozen=True)
+class ShardSpec:
+    """How one side of the fabric shards the flat layout's entries.
+
+    ``num_shards`` is the shard count of the mesh axis (engine ``tp``,
+    trainer ``fsdp``); ``axes`` maps entry name -> the tensor axis sharded
+    over it (absent/None = replicated on that side). Wire-format friendly:
+    receivers advertise it in their register message so the sender can
+    build a :class:`ReshardingMap` per registration.
+    """
+
+    num_shards: int
+    axes: dict[str, int | None]
+
+    def axis_of(self, name: str) -> int | None:
+        if self.num_shards <= 1:
+            return None
+        return self.axes.get(name)
+
+    def to_jsonable(self) -> dict:
+        return {"num_shards": int(self.num_shards),
+                "axes": {k: v for k, v in self.axes.items()
+                         if v is not None}}
+
+    @staticmethod
+    def from_jsonable(d: dict | None) -> "ShardSpec | None":
+        if not d:
+            return None
+        return ShardSpec(int(d.get("num_shards", 1)),
+                         {k: int(v) for k, v in d.get("axes", {}).items()})
+
+
+def build_shard_spec(params: Any, axis: str = "tp") -> ShardSpec:
+    """Derive a :class:`ShardSpec` from a pytree of (possibly) mesh-sharded
+    jax arrays: for each leaf, the tensor axis whose PartitionSpec names
+    ``axis``. Leaves without a NamedSharding (host arrays, single-device)
+    and leaves whose spec never names ``axis`` are replicated."""
+    leaves = jax.tree_util.tree_flatten_with_path(params)[0]
+    num_shards = 1
+    axes: dict[str, int | None] = {}
+    for path, leaf in leaves:
+        name = _path_str(path)
+        sharding = getattr(leaf, "sharding", None)
+        spec = getattr(sharding, "spec", None)
+        mesh = getattr(sharding, "mesh", None)
+        found = None
+        if spec is not None and mesh is not None and axis in mesh.shape:
+            num_shards = max(num_shards, int(mesh.shape[axis]))
+            for dim, names in enumerate(spec):
+                if names is None:
+                    continue
+                group = names if isinstance(names, tuple) else (names,)
+                if axis in group:
+                    found = dim
+                    break
+        axes[name] = found
+    return ShardSpec(num_shards, axes)
+
+
+def _shard_ranges(e: Entry, axis: int | None, n: int):
+    """Absolute (offset, length) byte ranges each of ``n`` shards owns of
+    entry ``e`` when sharded along tensor ``axis`` (row-major flat layout).
+    Returns None when the split doesn't apply cleanly (replicated, n==1,
+    non-divisible dim, or range explosion past MAX_RANGES_PER_ENTRY) —
+    callers then route the entry to the pool."""
+    if axis is None or n <= 1:
+        return None
+    if axis >= len(e.shape) or e.shape[axis] % n != 0:
+        return None
+    outer = int(np.prod(e.shape[:axis], dtype=np.int64)) if axis else 1
+    if outer > MAX_RANGES_PER_ENTRY:
+        return None
+    item = _np_dtype(e.dtype).itemsize
+    inner = (int(np.prod(e.shape[axis + 1:], dtype=np.int64))
+             if axis + 1 < len(e.shape) else 1) * item
+    d = e.shape[axis]
+    per = (d // n) * inner
+    out = []
+    for j in range(n):
+        rs = []
+        for o in range(outer):
+            off = e.offset + o * d * inner + j * per
+            if rs and rs[-1][0] + rs[-1][1] == off:
+                rs[-1] = (rs[-1][0], rs[-1][1] + per)
+            else:
+                rs.append((off, per))
+        out.append(rs)
+    return out
+
+
+def _intersect(a: list[tuple[int, int]], b: list[tuple[int, int]]):
+    """Intersection of two sorted disjoint (offset, length) range lists."""
+    out, i, j = [], 0, 0
+    while i < len(a) and j < len(b):
+        lo = max(a[i][0], b[j][0])
+        hi = min(a[i][0] + a[i][1], b[j][0] + b[j][1])
+        if lo < hi:
+            out.append((lo, hi - lo))
+        if a[i][0] + a[i][1] <= b[j][0] + b[j][1]:
+            i += 1
+        else:
+            j += 1
+    return out
+
+
+@dataclass(frozen=True)
+class ReshardingMap:
+    """Per-byte ownership of the flat layout across (trainer shard →
+    engine shard) pairs: ``atoms`` is a disjoint, offset-sorted cover of
+    ``[0, total_bytes)`` as (offset, length, trainer_shard, engine_shard)
+    with :data:`POOL` (-1) marking replicated/padding bytes. Built by
+    :func:`build_resharding_map`; consumed by :meth:`stream_assignments`
+    to fan a push round over N concurrent streams."""
+
+    total_bytes: int
+    num_trainer_shards: int
+    num_engine_shards: int
+    atoms: tuple[tuple[int, int, int, int], ...]
+
+    def reshard_bytes(self) -> int:
+        """Bytes with a real (non-pool) shard-pair owner."""
+        return sum(ln for _, ln, t, e in self.atoms
+                   if t != POOL or e != POOL)
+
+    def stream_assignments(self, num_streams: int):
+        """Pack the atoms into ``num_streams`` offset-sorted, coalesced
+        (offset, length) lists: disjoint union covering [0, total_bytes),
+        each stream carrying at most ceil(total/num_streams) + ALIGN
+        bytes. Atoms are laid out pair-grouped (all of (t0,e0) first, ...)
+        with the pool round-robined by the greedy fill, so a stream
+        usually carries whole shard-pairs; atoms split only at ALIGN
+        boundaries to keep resume ranges cheap to verify."""
+        n = max(1, int(num_streams))
+        if self.total_bytes == 0:
+            return [[] for _ in range(n)]
+        target = -(-self.total_bytes // n)
+        ordered = sorted(
+            self.atoms,
+            key=lambda a: ((1, 0, 0) if a[2] == POOL and a[3] == POOL
+                           else (0, a[2], a[3]), a[0]))
+        streams: list[list[tuple[int, int]]] = [[] for _ in range(n)]
+        fill = [0] * n
+        s = 0
+        for off, ln, _t, _e in ordered:
+            while ln > 0:
+                if fill[s] >= target and s < n - 1:
+                    s += 1
+                room = target - fill[s]
+                if room >= ln or s == n - 1:
+                    take = ln
+                else:
+                    # split at an ALIGN boundary, rounding UP so the
+                    # stream overshoots target by < ALIGN instead of
+                    # leaving an un-splittable sliver
+                    take = min(ln, -(-room // ALIGN) * ALIGN)
+                streams[s].append((off, take))
+                fill[s] += take
+                off += take
+                ln -= take
+        for rs in streams:
+            rs.sort()
+            i = 1
+            while i < len(rs):
+                if rs[i - 1][0] + rs[i - 1][1] == rs[i][0]:
+                    rs[i - 1] = (rs[i - 1][0], rs[i - 1][1] + rs[i][1])
+                    del rs[i]
+                else:
+                    i += 1
+        return streams
+
+
+def build_resharding_map(layout: ParamLayout,
+                         trainer_spec: ShardSpec | None,
+                         engine_spec: ShardSpec | None) -> ReshardingMap:
+    """Compute byte ownership of ``layout`` from the trainer's shard spec
+    and the engine's: for each entry, the intersection of trainer shard
+    i's ranges with engine shard j's. Replicated-on-both-sides entries,
+    non-divisible splits, range explosions and alignment padding all land
+    in the POOL. The atom set always covers [0, total_bytes) exactly —
+    the receiver's gap verifier demands full coverage."""
+    t_n = trainer_spec.num_shards if trainer_spec else 1
+    e_n = engine_spec.num_shards if engine_spec else 1
+    atoms: list[tuple[int, int, int, int]] = []
+    for k, e in enumerate(layout.entries):
+        t_ranges = _shard_ranges(
+            e, trainer_spec.axis_of(e.name) if trainer_spec else None, t_n)
+        e_ranges = _shard_ranges(
+            e, engine_spec.axis_of(e.name) if engine_spec else None, e_n)
+        if t_ranges is None and e_ranges is None:
+            atoms.append((e.offset, e.nbytes, POOL, POOL))
+        elif t_ranges is None:
+            for j, rs in enumerate(e_ranges):
+                atoms.extend((o, ln, POOL, j) for o, ln in rs)
+        elif e_ranges is None:
+            for i, rs in enumerate(t_ranges):
+                atoms.extend((o, ln, i, POOL) for o, ln in rs)
+        else:
+            for i, trs in enumerate(t_ranges):
+                for j, ers in enumerate(e_ranges):
+                    atoms.extend((o, ln, i, j)
+                                 for o, ln in _intersect(trs, ers))
+        # alignment padding up to the next entry (or total_bytes)
+        end = e.offset + e.nbytes
+        nxt = (layout.entries[k + 1].offset if k + 1 < len(layout.entries)
+               else layout.total_bytes)
+        if nxt > end:
+            atoms.append((end, nxt - end, POOL, POOL))
+    atoms.sort()
+    return ReshardingMap(layout.total_bytes, t_n, e_n, tuple(atoms))
+
+
+def pack_params_ranges(params: Any, layout: ParamLayout,
+                       buffer: np.ndarray,
+                       ranges: list[tuple[int, int]]) -> None:
+    """Range-restricted pack: copy into ``buffer`` only the bytes covered
+    by ``ranges`` (sorted, disjoint), gathering to host ONLY the entries
+    the ranges intersect — the per-shard path of the sharded push, where
+    each stream packs its own slice of the layout instead of every stream
+    waiting on a full-tree gather. For leaves mesh-sharded along axis 0
+    the copy reads the owning shard's host data directly
+    (``addressable_shards`` — no cross-shard gather); other leaves fall
+    back to a one-entry ``device_get``."""
+    if not ranges:
+        return
+    leaves = jax.tree_util.tree_flatten_with_path(params)[0]
+    by_name = {_path_str(p): leaf for p, leaf in leaves}
+    ri = 0
+    for e in layout.entries:
+        lo, hi = e.offset, e.offset + e.nbytes
+        while ri < len(ranges) and ranges[ri][0] + ranges[ri][1] <= lo:
+            ri += 1
+        need = []
+        j = ri
+        while j < len(ranges) and ranges[j][0] < hi:
+            r_lo = max(lo, ranges[j][0])
+            r_hi = min(hi, ranges[j][0] + ranges[j][1])
+            if r_lo < r_hi:
+                need.append((r_lo, r_hi))
+            j += 1
+        if not need:
+            continue
+        leaf = by_name[e.name]
+        flat = None
+        shards = getattr(leaf, "addressable_shards", None)
+        item = _np_dtype(e.dtype).itemsize
+        if shards is not None and len(shards) > 1:
+            # axis-0 shards are contiguous flat blocks — serve each needed
+            # range from the shard(s) that own it, host-copying shard data
+            # only (np.asarray on shard.data is the shard's bytes, not the
+            # global array)
+            blocks = []
+            ok = True
+            for sh in shards:
+                idx = sh.index[0] if sh.index else slice(None)
+                start = idx.start or 0
+                inner = (int(np.prod(e.shape[1:], dtype=np.int64))
+                         if len(e.shape) > 1 else 1) * item
+                b_lo = lo + start * inner
+                data = sh.data
+                b_hi = b_lo + data.size * item
+                rest = sh.index[1:] if sh.index else ()
+                if any(not (isinstance(s, slice) and s == slice(None))
+                       for s in rest):
+                    ok = False  # sharded beyond axis 0 — not flat blocks
+                    break
+                blocks.append((b_lo, b_hi, data))
+            if ok:
+                for r_lo, r_hi in need:
+                    for b_lo, b_hi, data in blocks:
+                        c_lo, c_hi = max(r_lo, b_lo), min(r_hi, b_hi)
+                        if c_lo >= c_hi:
+                            continue
+                        src = np.asarray(data).reshape(-1).view(np.uint8)
+                        buffer[c_lo:c_hi] = src[c_lo - b_lo:c_hi - b_lo]
+                continue
+        flat = np.asarray(jax.device_get(leaf)).reshape(-1).view(np.uint8)
+        for r_lo, r_hi in need:
+            buffer[r_lo:r_hi] = flat[r_lo - lo:r_hi - lo]
+
+
+def make_sharded_installer(template: Any):
+    """Like :func:`make_incremental_installer` but for a mesh-sharded
+    engine (tp>1): entries whose template leaf spans multiple devices are
+    installed shard-by-shard — per device, slice the landed bytes by the
+    sharding's index map, cast, ``device_put`` to THAT device only, then
+    assemble with ``jax.make_array_from_single_device_arrays``. Peak extra
+    host memory is one shard (not one full tensor), and no full-size
+    single-device array is ever materialized on the serving side.
+    Single-device leaves take the plain incremental path."""
+    tmpl = {_path_str(p): leaf for p, leaf in
+            jax.tree_util.tree_flatten_with_path(template)[0]}
+    device_named: dict[str, Any] = {}
+
+    def install(entry: Entry, raw) -> None:
+        old = tmpl[entry.name]
+        sharding = getattr(old, "sharding", None)
+        idx_map = None
+        if sharding is not None and getattr(old, "ndim", 0) > 0:
+            try:
+                devs = sharding.addressable_devices_indices_map(entry.shape)
+                if len(devs) > 1:
+                    idx_map = devs
+            except (AttributeError, TypeError, ValueError):
+                idx_map = None
+        host = np.asarray(raw).view(_np_dtype(entry.dtype)).reshape(
+            entry.shape)
+        if idx_map is None:  # single-device / replicated: incremental path
+            if sharding is not None:
+                device_named[entry.name] = jax.device_put(
+                    host.astype(old.dtype), sharding)
+            else:
+                device_named[entry.name] = jax.device_put(
+                    host.astype(old.dtype))
+            return
+        pieces = []
+        for dev, idx in idx_map.items():
+            piece = np.ascontiguousarray(host[idx]).astype(old.dtype)
+            pieces.append(jax.device_put(piece, dev))
+        device_named[entry.name] = jax.make_array_from_single_device_arrays(
+            entry.shape, sharding, pieces)
+
+    return install, device_named
+
+
 def unpack_params(buffer: np.ndarray, layout: ParamLayout) -> dict[str, np.ndarray]:
     """Zero-copy views into the buffer, name -> ndarray."""
     out = {}

@@ -373,6 +373,10 @@ class ReceiverSockets:
 @dataclass
 class TransferBatch:
     futures: list[Future] = field(default_factory=list)
+    # per-stream (offset, length) lists, index-aligned with ``futures`` —
+    # the sharded push reads these to scope a failed stream's re-push to
+    # exactly the ranges that stream owned
+    assignments: list[list[tuple[int, int]]] = field(default_factory=list)
 
     def done(self) -> bool:
         return all(f.done() for f in self.futures)
@@ -458,6 +462,8 @@ class TcpTransferEngine:
                               ranges: list[tuple[int, int]] | None = None,
                               gate_timeout_s: float | None = None,
                               fault=None, instance: str = "",
+                              assignments: list[list[tuple[int, int]]]
+                              | None = None,
                               ) -> TransferBatch:
         """Split ``buffer`` across ``ports`` and send concurrently.
 
@@ -469,10 +475,19 @@ class TcpTransferEngine:
         behind pack order (advisor r4). Explicit ``ranges`` is the RESUME
         path: only the given (offset, length) ranges are sent, assigned
         round-robin across the streams — a post-``verify_failed`` re-push
-        delivers the failed ranges without restarting the round."""
+        delivers the failed ranges without restarting the round. Explicit
+        ``assignments`` is the SHARDED path (transfer/layout.py
+        ReshardingMap.stream_assignments): stream i carries exactly
+        ``assignments[i]`` — the caller owns the balance/affinity."""
         mv = memoryview(buffer).cast("B")
         batch = TransferBatch()
-        if ranges is not None:
+        if assignments is not None:
+            assignments = [[(int(o), int(ln)) for o, ln in rs if int(ln) > 0]
+                           for rs in assignments]
+            assignments = [rs for rs in assignments if rs]
+            if not assignments:
+                assignments = [[(0, 0)]]
+        elif ranges is not None:
             rs = [(int(o), int(ln)) for o, ln in ranges if int(ln) > 0]
             n_active = min(len(ports), len(rs)) or 1
             assignments = [c for c in
@@ -490,6 +505,7 @@ class TcpTransferEngine:
                            (chunks[i::n_active] for i in range(n_active))
                            if c]
         for i, (rngs, port) in enumerate(zip(assignments, ports)):
+            batch.assignments.append(list(rngs))
             batch.futures.append(self._pool.submit(
                 self._send_ranges, host, port, mv, round_id, rngs,
                 len(assignments), watermark, gate_timeout_s, fault,

@@ -1,0 +1,483 @@
+"""Sharded weight fabric (ARCHITECTURE.md "Sharded weight fabric"): the
+trainer→engine ReshardingMap (byte ownership + per-stream assignments),
+range-restricted packing, the tp>1 shard-by-shard installer, and the
+N-stream push wire path — bitwise parity vs single-stream, and per-stream
+fault isolation (a corrupt/stalled stream re-pushes only its own ranges).
+"""
+
+import itertools
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from polyrl_tpu.rollout.faults import (TransferFaultConfig,
+                                       TransferFaultInjector)
+from polyrl_tpu.transfer import (
+    ReceiverAgent,
+    SenderAgent,
+    build_layout,
+    pack_params,
+    unflatten_like,
+    unpack_params,
+)
+from polyrl_tpu.transfer.layout import (
+    ALIGN,
+    POOL,
+    Entry,
+    MAX_RANGES_PER_ENTRY,
+    ShardSpec,
+    _shard_ranges,
+    alloc_buffer,
+    build_resharding_map,
+    build_shard_spec,
+    make_sharded_installer,
+    pack_params_ranges,
+)
+from tests.test_transfer_ft import assert_tree_equal, fast_cfg, wait_for
+
+
+def fabric_params(seed=0):
+    """A tree with 2D matmul-ish entries, a misaligned tail (10 floats =
+    40 bytes, indivisible by 4 shards) and a pool-only bf16 leaf."""
+    k = jax.random.PRNGKey(seed)
+    ks = jax.random.split(k, 5)
+    return {
+        "emb": {"w": jax.random.normal(ks[0], (16, 32), jnp.float32)},
+        "mlp": {"win": jax.random.normal(ks[1], (32, 24), jnp.float32),
+                "wout": jax.random.normal(ks[2], (24, 32), jnp.float32)},
+        "norm": jax.random.normal(ks[3], (10,), jnp.float32),
+        "bias": jax.random.normal(ks[4], (7,), jnp.bfloat16),
+    }
+
+
+ENGINE_AXES = {"emb.w": 1, "mlp.win": 0, "mlp.wout": 1, "norm": 0}
+TRAINER_AXES = {"emb.w": 0, "mlp.win": 0, "mlp.wout": 0, "norm": 0}
+
+
+def _owner_bytes(layout, spec):
+    """Per-byte shard owner (POOL where the spec doesn't split cleanly)."""
+    owner = np.full(layout.total_bytes, POOL, np.int64)
+    if spec is None:
+        return owner
+    for e in layout.entries:
+        rs = _shard_ranges(e, spec.axis_of(e.name), spec.num_shards)
+        if rs is None:
+            continue
+        for j, ranges in enumerate(rs):
+            for o, ln in ranges:
+                owner[o:o + ln] = j
+    return owner
+
+
+# -- map construction: coverage / disjointness / ownership -------------------
+
+
+def test_map_grid_full_coverage_and_ownership():
+    """Property grid over trainer {1,2,4} × engine {1,2,4}: the atoms are
+    a disjoint cover of [0, total_bytes) and every non-pool atom's bytes
+    are owned by exactly the claimed (trainer, engine) shard pair —
+    including the misaligned 40-byte tail and the alignment padding."""
+    layout = build_layout(fabric_params())
+    for t_n, e_n in itertools.product((1, 2, 4), (1, 2, 4)):
+        t_spec = ShardSpec(t_n, dict(TRAINER_AXES))
+        e_spec = ShardSpec(e_n, dict(ENGINE_AXES))
+        rmap = build_resharding_map(layout, t_spec, e_spec)
+        cover = np.zeros(layout.total_bytes, np.int32)
+        t_owner = _owner_bytes(layout, t_spec if t_n > 1 else None)
+        e_owner = _owner_bytes(layout, e_spec if e_n > 1 else None)
+        for off, ln, t, e in rmap.atoms:
+            assert ln > 0
+            cover[off:off + ln] += 1
+            want_t = t_owner[off:off + ln]
+            want_e = e_owner[off:off + ln]
+            assert (want_t == t).all(), (t_n, e_n, off, ln, t)
+            assert (want_e == e).all(), (t_n, e_n, off, ln, e)
+        assert (cover == 1).all(), f"grid ({t_n},{e_n}) not a disjoint cover"
+        assert rmap.reshard_bytes() == int(
+            ((t_owner != POOL) | (e_owner != POOL)).sum())
+
+
+def test_map_grid_from_real_meshes():
+    """The same grid built from REAL mesh-sharded trees (8 virtual CPU
+    devices): build_shard_spec reads each side's NamedShardings, and the
+    resulting map still covers the layout disjointly."""
+    devs = jax.devices()
+    assert len(devs) >= 8  # conftest forces 8 virtual CPU devices
+    params = fabric_params()
+    layout = build_layout(params)
+
+    def shard_tree(axis_name, n, axes):
+        mesh = Mesh(np.array(devs[:n]), (axis_name,))
+
+        def put(path_name, leaf):
+            dim = axes.get(path_name)
+            if dim is None or leaf.shape[dim] % n:
+                return jax.device_put(leaf, NamedSharding(mesh, P()))
+            spec = [None] * leaf.ndim
+            spec[dim] = axis_name
+            return jax.device_put(leaf, NamedSharding(mesh, P(*spec)))
+
+        return {
+            "emb": {"w": put("emb.w", params["emb"]["w"])},
+            "mlp": {"win": put("mlp.win", params["mlp"]["win"]),
+                    "wout": put("mlp.wout", params["mlp"]["wout"])},
+            "norm": put("norm", params["norm"]),
+            "bias": put("bias", params["bias"]),
+        }
+
+    for t_n, e_n in itertools.product((1, 2, 4), (1, 2, 4)):
+        t_spec = build_shard_spec(shard_tree("fsdp", t_n, TRAINER_AXES),
+                                  axis="fsdp")
+        e_spec = build_shard_spec(shard_tree("tp", e_n, ENGINE_AXES),
+                                  axis="tp")
+        assert t_spec.num_shards == t_n
+        assert e_spec.num_shards == e_n
+        if e_n > 1:
+            assert e_spec.axis_of("emb.w") == 1
+            assert e_spec.axis_of("mlp.win") == 0
+            assert e_spec.axis_of("bias") is None
+        rmap = build_resharding_map(layout, t_spec, e_spec)
+        cover = np.zeros(layout.total_bytes, np.int32)
+        for off, ln, _t, _e in rmap.atoms:
+            cover[off:off + ln] += 1
+        assert (cover == 1).all()
+
+
+def test_shard_ranges_bytes_match_numpy_slicing():
+    """_shard_ranges for an inner-axis split owns exactly the bytes numpy
+    row-major slicing says shard j owns."""
+    e = Entry("x", (4, 6), "float32", 64, 96)
+    rs = _shard_ranges(e, 1, 2)
+    elems = np.arange(24).reshape(4, 6)
+    for j in (0, 1):
+        want = set()
+        for el in elems[:, j * 3:(j + 1) * 3].reshape(-1):
+            base = 64 + int(el) * 4
+            want.update(range(base, base + 4))
+        got = set()
+        for o, ln in rs[j]:
+            got.update(range(o, o + ln))
+        assert got == want
+    # outer-axis split is one contiguous strip per shard
+    assert _shard_ranges(e, 0, 2) == [[(64, 48)], [(112, 48)]]
+
+
+def test_shard_ranges_fallbacks():
+    e = Entry("x", (10, 4), "float32", 0, 160)
+    assert _shard_ranges(e, None, 4) is None          # replicated
+    assert _shard_ranges(e, 0, 1) is None             # n == 1
+    assert _shard_ranges(e, 0, 4) is None             # 10 % 4 != 0
+    assert _shard_ranges(e, 2, 2) is None             # axis out of range
+    big = Entry("y", (MAX_RANGES_PER_ENTRY + 1, 2, 4), "float32", 0,
+                (MAX_RANGES_PER_ENTRY + 1) * 2 * 4 * 4)
+    assert _shard_ranges(big, 1, 2) is None           # range explosion
+
+
+def test_shard_spec_jsonable_roundtrip():
+    spec = ShardSpec(4, {"a": 0, "b": 1, "c": None})
+    d = spec.to_jsonable()
+    assert "c" not in d["axes"]  # replicated entries drop off the wire
+    back = ShardSpec.from_jsonable(d)
+    assert back.num_shards == 4
+    assert back.axis_of("a") == 0 and back.axis_of("b") == 1
+    assert back.axis_of("c") is None
+    assert ShardSpec.from_jsonable(None) is None
+    assert ShardSpec(1, {"a": 0}).axis_of("a") is None  # unsharded side
+
+
+# -- stream assignments: balance + completeness ------------------------------
+
+
+def test_stream_assignments_balanced_cover():
+    """For any stream count the assignment lists are a disjoint cover of
+    the layout and no stream carries more than ceil(total/n) + ALIGN."""
+    layout = build_layout(fabric_params())
+    rmap = build_resharding_map(layout, ShardSpec(2, dict(TRAINER_AXES)),
+                                ShardSpec(4, dict(ENGINE_AXES)))
+    for n in (1, 2, 3, 4, 7):
+        streams = rmap.stream_assignments(n)
+        assert len(streams) == n
+        target = -(-layout.total_bytes // n)
+        cover = np.zeros(layout.total_bytes, np.int32)
+        for rs in streams:
+            sbytes = sum(ln for _, ln in rs)
+            assert sbytes <= target + ALIGN, (n, sbytes, target)
+            assert rs == sorted(rs)
+            for o, ln in rs:
+                cover[o:o + ln] += 1
+        assert (cover == 1).all(), f"{n}-stream split not a disjoint cover"
+
+
+# -- range-restricted pack ---------------------------------------------------
+
+
+def test_pack_params_ranges_full_parity_and_partial():
+    params = fabric_params(3)
+    layout = build_layout(params)
+    want = alloc_buffer(layout)
+    pack_params(params, layout, want)
+    got = alloc_buffer(layout)
+    pack_params_ranges(params, layout, got,
+                       [(0, layout.total_bytes)])
+    np.testing.assert_array_equal(got, want)
+    # partial ranges touch ONLY the requested bytes
+    e = layout.entries[2]
+    ranges = [(e.offset + 8, 32)]
+    partial = np.full(layout.total_bytes, 0xAB, np.uint8)
+    pack_params_ranges(params, layout, partial, ranges)
+    np.testing.assert_array_equal(partial[e.offset + 8:e.offset + 40],
+                                  want[e.offset + 8:e.offset + 40])
+    mask = np.ones(layout.total_bytes, bool)
+    mask[e.offset + 8:e.offset + 40] = False
+    assert (partial[mask] == 0xAB).all()
+
+
+def test_pack_params_ranges_mesh_sharded_axis0():
+    """Axis-0 mesh-sharded leaves pack through the addressable-shards fast
+    path (shard host blocks, no global gather) — bitwise equal to the
+    plain pack."""
+    params = fabric_params(4)
+    mesh = Mesh(np.array(jax.devices()[:2]), ("fsdp",))
+    sharded = jax.tree_util.tree_map(
+        lambda a: jax.device_put(
+            a, NamedSharding(
+                mesh, P("fsdp") if a.ndim and a.shape[0] % 2 == 0
+                else P())),
+        params)
+    layout = build_layout(params)
+    want = alloc_buffer(layout)
+    pack_params(params, layout, want)
+    got = alloc_buffer(layout)
+    pack_params_ranges(sharded, layout, got, [(0, layout.total_bytes)])
+    np.testing.assert_array_equal(got, want)
+
+
+# -- tp>1 installer: shard-by-shard, no full-size device array ---------------
+
+
+def test_sharded_installer_tp2_no_full_materialization(monkeypatch):
+    """make_sharded_installer lands a tp=2 template's entries via
+    per-device pieces: every device_put carries at most half the entry
+    and the assembled tree is bitwise-identical + correctly sharded."""
+    src = fabric_params(5)
+    mesh = Mesh(np.array(jax.devices()[:2]), ("tp",))
+
+    def tp_sharding(name, leaf):
+        dim = ENGINE_AXES.get(name)
+        if dim is None or leaf.shape[dim] % 2:
+            return NamedSharding(mesh, P())
+        spec = [None] * leaf.ndim
+        spec[dim] = "tp"
+        return NamedSharding(mesh, P(*spec))
+
+    names = {"emb.w": src["emb"]["w"], "mlp.win": src["mlp"]["win"],
+             "mlp.wout": src["mlp"]["wout"], "norm": src["norm"],
+             "bias": src["bias"]}
+    template = {
+        "emb": {"w": jax.device_put(src["emb"]["w"] * 0,
+                                    tp_sharding("emb.w", src["emb"]["w"]))},
+        "mlp": {"win": jax.device_put(
+                    src["mlp"]["win"] * 0,
+                    tp_sharding("mlp.win", src["mlp"]["win"])),
+                "wout": jax.device_put(
+                    src["mlp"]["wout"] * 0,
+                    tp_sharding("mlp.wout", src["mlp"]["wout"]))},
+        "norm": jax.device_put(src["norm"] * 0,
+                               tp_sharding("norm", src["norm"])),
+        "bias": jax.device_put(src["bias"] * 0,
+                               tp_sharding("bias", src["bias"])),
+    }
+    layout = build_layout(src)
+    buf = alloc_buffer(layout)
+    pack_params(src, layout, buf)
+
+    real_put = jax.device_put
+    put_sizes: dict[str, list[int]] = {}
+    current = [""]
+
+    def spy_put(x, *a, **kw):
+        if isinstance(x, np.ndarray):
+            put_sizes.setdefault(current[0], []).append(x.nbytes)
+        return real_put(x, *a, **kw)
+
+    monkeypatch.setattr(jax, "device_put", spy_put)
+    install, device_named = make_sharded_installer(template)
+    for e in layout.entries:
+        current[0] = e.name
+        install(e, buf[e.offset:e.offset + e.nbytes])
+    monkeypatch.undo()
+
+    for e in layout.entries:
+        got = device_named[e.name]
+        np.testing.assert_array_equal(np.asarray(got),
+                                      np.asarray(names[e.name]))
+        assert got.sharding.is_equivalent_to(
+            tp_sharding(e.name, names[e.name]), got.ndim)
+        if ENGINE_AXES.get(e.name) is not None \
+                and e.shape[ENGINE_AXES[e.name]] % 2 == 0:
+            # tp-sharded entries: no single device_put saw the full tensor
+            assert max(put_sizes[e.name]) <= e.nbytes // 2, e.name
+
+
+# -- wire integration: N-stream sharded push ---------------------------------
+
+
+ENGINE_SPEC = ShardSpec(2, dict(ENGINE_AXES))
+
+
+def mk_sharded_pair(params, num_streams=4, cfg=None, fault=None,
+                    instance="inst-shard", engine_spec=ENGINE_SPEC):
+    layout = build_layout(params)
+    buf = alloc_buffer(layout)
+    sender = SenderAgent(buf, manager_client=None, listen_host="127.0.0.1",
+                         num_streams=num_streams, poll_s=0.05,
+                         advertise_host="127.0.0.1", cfg=cfg or fast_cfg(),
+                         fault=fault, layout=layout,
+                         trainer_spec=ShardSpec(1, {}))
+    sender.start()
+    rx = ReceiverAgent(layout, instance, sender.endpoint,
+                       num_streams=num_streams, listen_host="127.0.0.1",
+                       advertise_host="127.0.0.1", shard_spec=engine_spec)
+    rx.start()
+    return layout, buf, sender, rx
+
+
+def _push_once(params, layout, buf, sender, rx):
+    time.sleep(0.3)  # registration
+    with sender.buffer_write_lock():
+        pack_params(params, layout, buf)
+    v = sender.signal_update()
+    assert rx.wait_for_version(v, timeout=30.0) == v
+    wait_for(lambda: sender.rounds_verified >= 1,
+             msg="sender round bookkeeping")
+    return v
+
+
+def test_sharded_push_four_streams_bitwise_vs_single():
+    """A 4-stream shard-planned push lands a buffer bitwise-identical to
+    a 1-stream push of the same params, the sharded-plane counters fire,
+    and the receiver advertises its shard spec in health()."""
+    params = fabric_params(6)
+    l4, b4, s4, r4 = mk_sharded_pair(params, num_streams=4,
+                                     instance="inst-4s")
+    try:
+        _push_once(params, l4, b4, s4, r4)
+        assert np.array_equal(r4.buffer, b4)
+        assert s4.push_streams == 4
+        assert s4.stream_bw_mbps_min > 0.0
+        # trainer replicated × engine tp=2: every cleanly-split entry's
+        # bytes are shard-pair-routed
+        rmap = build_resharding_map(l4, ShardSpec(1, {}), ENGINE_SPEC)
+        assert s4.reshard_bytes == rmap.reshard_bytes() > 0
+        assert s4.stream_resumes == 0
+        counters = s4.counters()
+        for key in ("transfer/push_streams", "transfer/stream_bw_mbps_min",
+                    "transfer/reshard_bytes", "transfer/stream_resumes"):
+            assert key in counters
+        health = r4.health()
+        assert health["transfer_push_streams"] == 4
+        assert health["transfer_shard_tp"] == 2
+        assert_tree_equal(params,
+                          unflatten_like(params,
+                                         unpack_params(r4.buffer, l4)))
+    finally:
+        r4.stop()
+        s4.stop()
+    l1, b1, s1, r1 = mk_sharded_pair(params, num_streams=1,
+                                     instance="inst-1s")
+    try:
+        _push_once(params, l1, b1, s1, r1)
+        assert s1.push_streams == 1
+        assert np.array_equal(r1.buffer, r4.buffer)  # bitwise 4 ≡ 1
+    finally:
+        r1.stop()
+        s1.stop()
+
+
+def test_corrupt_one_stream_resumes_only_its_ranges():
+    """One corrupted frame on one stream: the receiver rejects exactly
+    that frame's range, the resume re-pushes ONLY bytes from the corrupt
+    stream's assignment (≤ one stream's share — every other stream's
+    contribution is 0), and the landed buffer is bitwise-exact."""
+    params = fabric_params(7)
+    injector = TransferFaultInjector(TransferFaultConfig(
+        enabled=True, corrupt_frames=1))
+    layout, buf, sender, rx = mk_sharded_pair(params, num_streams=4,
+                                              fault=injector,
+                                              instance="inst-corrupt")
+    try:
+        _push_once(params, layout, buf, sender, rx)
+        assert injector.corruptions == 1
+        assert rx.sockets.crc_failures == 1
+        assert sender.verify_failures == 1
+        plan = build_resharding_map(
+            layout, ShardSpec(1, {}), ENGINE_SPEC).stream_assignments(4)
+        per_stream = [sum(ln for _, ln in rs) for rs in plan]
+        assert 0 < sender.resumed_bytes <= max(per_stream)
+        assert sender.resumed_bytes < layout.total_bytes
+        # a CRC rejection is a verify failure, not a stream transport loss
+        assert sender.stream_resumes == 0
+        assert np.array_equal(rx.buffer, buf)
+    finally:
+        rx.stop()
+        sender.stop()
+
+
+def test_stalled_stream_converts_to_per_stream_resume():
+    """One stream stalled past its bandwidth-keyed deadline: the other
+    streams land, the failed stream's assignment is resumed (counted in
+    stream_resumes), and the round eventually verifies bitwise-exact.
+    Follow-up attempts may ALSO count verify failures: the stalled
+    connection head-of-line-blocks its port's serve thread, so resume
+    bytes queued behind it stay unread past the verify wait — those show
+    up as receiver-side gaps until the stall expires."""
+    params = fabric_params(8)
+    injector = TransferFaultInjector(TransferFaultConfig(
+        enabled=True, stall_s=3.0, stall_streams=1))
+    cfg = fast_cfg(deadline_slack_s=0.4, stream_slack_s=0.4,
+                   retry_budget=30, backoff_base_s=0.05,
+                   backoff_max_s=0.3)
+    layout, buf, sender, rx = mk_sharded_pair(params, num_streams=4,
+                                              cfg=cfg, fault=injector,
+                                              instance="inst-stall")
+    try:
+        _push_once(params, layout, buf, sender, rx)
+        assert injector.stalls == 1
+        assert sender.stream_resumes >= 1
+        assert sender.laggard_escalations == 0
+        plan = build_resharding_map(
+            layout, ShardSpec(1, {}), ENGINE_SPEC).stream_assignments(4)
+        assert sender.resumed_bytes <= max(
+            sum(ln for _, ln in rs) for rs in plan)
+        assert np.array_equal(rx.buffer, buf)
+    finally:
+        rx.stop()
+        sender.stop()
+
+
+def test_unsharded_receiver_keeps_legacy_split():
+    """A receiver that advertises no shard spec still gets a full sharded
+    plan keyed off the POOL atoms (coverage is mandatory), and a sender
+    with no layout falls back to the legacy contiguous split."""
+    params = fabric_params(9)
+    layout = build_layout(params)
+    buf = alloc_buffer(layout)
+    sender = SenderAgent(buf, manager_client=None, listen_host="127.0.0.1",
+                         num_streams=2, poll_s=0.05,
+                         advertise_host="127.0.0.1", cfg=fast_cfg())
+    sender.start()
+    rx = ReceiverAgent(layout, "inst-legacy", sender.endpoint,
+                       num_streams=2, listen_host="127.0.0.1",
+                       advertise_host="127.0.0.1")
+    rx.start()
+    try:
+        _push_once(params, layout, buf, sender, rx)
+        assert np.array_equal(rx.buffer, buf)
+        assert rx.health()["transfer_shard_tp"] == 1
+    finally:
+        rx.stop()
+        sender.stop()

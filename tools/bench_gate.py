@@ -104,6 +104,14 @@ WATCHED_EXTRA = (
     ("push_chaos.transfer_recovery_s", "high"),
     ("push_chaos.transfer_resumed_bytes", "high"),
     ("push_chaos.transfer_verify_failures", "high"),
+    # sharded weight fabric (bench.py --push-shard A/B, and the cb phase's
+    # real-weights drill promoted as push_shard_wall_s): the 1-vs-N-stream
+    # wall-clock speedup must hold, a clean loopback round growing resumes
+    # means streams started missing their bandwidth-keyed deadlines, and
+    # the real-weights sharded-push wall must not blow up between rounds
+    ("push_shard.speedup", "low"),
+    ("push_shard.stream_resumes", "high"),
+    ("push_shard_wall_s", "high"),
     # training health plane (bench.py --pipeline-microbench fit records,
     # obs/rlhealth.py): entropy collapsing between rounds is a regression
     # even when tok/s held; KL, TIS clipping and degenerate-group

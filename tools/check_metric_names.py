@@ -62,7 +62,14 @@ plane (obs/rlhealth.py) emits ``training/*`` — distribution summaries
 (``training/reward_mean/<src>``) and actor mirrors
 (``training/{entropy,approx_kl,grad_norm}``) — sharing the pre-existing
 ``training`` namespace with the trainer's step counter and balancer
-budget. New metric emitters in
+budget. The sharded weight fabric (transfer/agents.py ``counters``)
+emits ``transfer/push_streams`` (stream fan-out width of the last
+round), ``transfer/stream_bw_mbps_min`` (slowest stream's wire
+bandwidth — the round's critical stream), ``transfer/reshard_bytes``
+(cumulative bytes routed shard→shard by the resharding map) and
+``transfer/stream_resumes`` (per-stream transport-failure re-pushes,
+distinct from whole-round ``transfer/push_retries``). New metric
+emitters in
 ``polyrl_tpu/`` are linted automatically; nothing needs registering —
 EXCEPT a new top-level namespace, which must be added to ``NAMESPACES``
 below and documented in ARCHITECTURE.md in the same change (an
@@ -105,10 +112,12 @@ NAMESPACES = frozenset({
     "transfer",      # weight-fabric pack/push timings + supervision
                      # gauges (transfer/{push_failures,push_retries,
                      # verify_failures,resumed_bytes,rounds_verified,
-                     # laggard_escalations,catchup_pushes} and the
+                     # laggard_escalations,catchup_pushes}, the sharded-
+                     # push plane transfer/{push_streams,stream_bw_mbps_
+                     # min,reshard_bytes,stream_resumes}, and the
                      # min_bandwidth_mbps/retry_budget knob echo —
-                     # transfer/agents.py, ARCHITECTURE.md "Weight-fabric
-                     # fault tolerance")
+                     # transfer/agents.py, ARCHITECTURE.md "Sharded
+                     # weight fabric")
     "prefix_cache",  # engine prefix-cache hit telemetry
     "timing_s",      # marked_timer phase timings
     "obs",           # observability self-telemetry (scrape/log/anomaly/
