@@ -1377,7 +1377,7 @@ def chaos_bench(preset: str = "tiny", batch: int = 8, prompt_len: int = 24,
 
 def pool_bench(n_engines: int = 2, preset: str = "tiny", batch: int = 8,
                prompt_len: int = 24, new_tokens: int = 48, rounds: int = 2,
-               endpoints: tuple = ()) -> dict:
+               endpoints: tuple = (), spot_trace: str = "") -> dict:
     """Elastic-pool topology bench (``python bench.py --pool N``): N CB
     engines behind one C++ manager + PoolManager. Phase 1 runs ``rounds``
     steady-state generation batches and measures aggregate + per-engine
@@ -1387,11 +1387,21 @@ def pool_bench(n_engines: int = 2, preset: str = "tiny", batch: int = 8,
     finish on survivors with zero dropped groups, a replacement joins, and
     ``recovery_s`` is the wall until the pool is back at N.
 
+    Phase 3 (``--spot-trace FILE``, local pools only): replay a scripted
+    spot-market schedule (rollout/spotmarket.py JSONL: offers, preemption
+    notices, no-notice kills; live engines adopted as ``E0..En-1``) while
+    batches keep flowing — the bench plays the controller's role, adding
+    offered capacity as it appears. ``spot.completed_frac`` is the share
+    of storm-window requests that completed; ``spot.recovery_s`` the wall
+    from the first disruption to the pool back at target size.
+
     CPU-sized by default (the same CB engines the quick tier drives; set
     JAX_PLATFORMS/POLYRL_BENCH_PRESET to scale up). ``--pool-endpoints
     ep1,ep2`` benches REAL engines already serving (TPU hosts) instead of
-    building local ones — the drill is skipped there (don't preempt
-    engines this process doesn't own)."""
+    building local ones — the preemption drill is skipped there (don't
+    preempt engines this process doesn't own), reported as
+    ``pool_drill_skipped=1`` so bench_gate never mistakes a skipped drill
+    for a passed one; steady-state per-engine tok/s still reports."""
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -1436,6 +1446,7 @@ def pool_bench(n_engines: int = 2, preset: str = "tiny", batch: int = 8,
     mgr = ManagerClient(f"127.0.0.1:{port}")
     pool = PoolManager(mgr, PoolConfig(drain_grace_s=0.2))
     replacement = None
+    market = None
     try:
         mgr.wait_healthy()
         for ep in eps:
@@ -1481,8 +1492,52 @@ def pool_bench(n_engines: int = 2, preset: str = "tiny", batch: int = 8,
             pool.wait_for_size(len(eps), deadline_s=60.0)
             recovery_s = round(time.monotonic() - drill_t0, 2)
 
+        # phase 3: spot-market storm (local pools only) — scripted offers/
+        # notices/kills replayed while batches keep flowing; the bench
+        # plays the AutoscaleController's role on offered capacity
+        spot = None
+        if spot_trace and not endpoints:
+            from polyrl_tpu.rollout.spotmarket import (SpotMarket,
+                                                       SpotMarketConfig,
+                                                       load_trace)
+
+            market = SpotMarket(
+                pool, SpotMarketConfig(enabled=True, grace_s=0.2),
+                engine_factory=mk_server, events=load_trace(spot_trace))
+            live_eps = {e["endpoint"] for e in pool.engines(refresh=True)}
+            live = servers + ([replacement] if replacement else [])
+            for i, srv in enumerate(s for s in live
+                                    if s.endpoint in live_eps):
+                market.adopt(f"E{i}", srv)
+            target = pool.active_count(refresh=True)
+            market.start()
+            storm_submitted = storm_completed = 0
+            while not market.done.is_set():
+                storm_submitted += batch
+                storm_completed += run_batch()
+                while True:   # controller stand-in: add offered capacity
+                    offered = market.acquire()
+                    if offered is None:
+                        break
+                    pool.add_engine(endpoint=offered, wait=False)
+            pool.wait_for_size(target, deadline_s=120.0)
+            spot_recovery = (
+                round(time.monotonic() - market.first_disruption_t, 2)
+                if market.first_disruption_t is not None else 0.0)
+            spot = {
+                "completed_frac": round(
+                    storm_completed / storm_submitted, 3)
+                if storm_submitted else 1.0,
+                "recovery_s": spot_recovery,
+                "submitted": storm_submitted,
+                "completed": storm_completed,
+                "offers": market.offers,
+                "notices": market.notices,
+                "kills": market.kills,
+            }
+
         counters = pool.counters()
-        return {
+        out = {
             "pool_engines": len(eps),
             "pool_evictions": int(counters["pool/evictions"]),
             "pool_drain_departures": int(counters["pool/drain_departures"]),
@@ -1493,11 +1548,19 @@ def pool_bench(n_engines: int = 2, preset: str = "tiny", batch: int = 8,
             "drill_completed": drill_completed,
             "dropped_groups": rr.dropped_groups,
             "recovery_s": recovery_s,
+            # real endpoints are never preempted — flag the skipped drill
+            # so bench_gate can tell "skipped" from "passed"
+            "pool_drill_skipped": 1 if endpoints else 0,
             "steady_s": round(steady_s, 2),
         }
+        if spot is not None:
+            out["spot"] = spot
+        return out
     finally:
         proc.kill()
         pool.close()
+        if market is not None:
+            market.stop()   # also stops the engines its offers built
         for srv in servers + ([replacement] if replacement else []):
             try:
                 srv.stop()
@@ -2410,11 +2473,16 @@ if __name__ == "__main__":
         # engines via --pool-endpoints (never preempted).
         os.environ.setdefault("JAX_PLATFORMS", "cpu")
         eps = ()
+        spot_trace = ""
         for i, a in enumerate(sys.argv):
             if a == "--pool-endpoints" and i + 1 < len(sys.argv):
                 eps = tuple(e for e in sys.argv[i + 1].split(",") if e)
             elif a.startswith("--pool-endpoints="):
                 eps = tuple(e for e in a.split("=", 1)[1].split(",") if e)
+            elif a == "--spot-trace" and i + 1 < len(sys.argv):
+                spot_trace = sys.argv[i + 1]
+            elif a.startswith("--spot-trace="):
+                spot_trace = a.split("=", 1)[1]
         try:
             n_engines = int(_cli_float("--pool", 2))
         except ValueError:  # bare --pool with another flag following
@@ -2425,7 +2493,7 @@ if __name__ == "__main__":
             batch=int(_cli_float("--batch", 8)),
             new_tokens=int(_cli_float("--new-tokens", 48)),
             rounds=int(_cli_float("--rounds", 2)),
-            endpoints=eps)
+            endpoints=eps, spot_trace=spot_trace)
         print(json.dumps({"metric": "pool_tok_s", "value": res["tok_s"],
                           "unit": "tok/s", "extra": {"pool": res}}))
     elif "--push-chaos" in sys.argv:

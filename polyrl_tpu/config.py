@@ -18,8 +18,10 @@ import typing
 from dataclasses import dataclass, field
 from typing import Any
 
+from polyrl_tpu.rollout.autoscale import AutoscaleConfig
 from polyrl_tpu.rollout.faults import FaultInjectionConfig
 from polyrl_tpu.rollout.pool import PoolConfig
+from polyrl_tpu.rollout.spotmarket import SpotMarketConfig
 from polyrl_tpu.trainer.actor import ActorConfig
 from polyrl_tpu.trainer.critic import CriticConfig
 from polyrl_tpu.trainer.stream_trainer import TrainerConfig
@@ -142,6 +144,16 @@ class RolloutSection:
     # gating, preemption drills, membership sweeps for /statusz, and the
     # progressive train<->rollout balance estimator window
     pool: PoolConfig = field(default_factory=PoolConfig)
+    # closed-loop autoscaling (rollout/autoscale.py; ARCHITECTURE.md
+    # "Closed-loop autoscaling & degradation tiers"): the policy loop
+    # that ACTS on the balance trends + critpath bottleneck — PoolManager
+    # add/drain under hysteresis, cooldowns, a fleet envelope, and a rate
+    # limiter. Default OFF: the serial trainer stays bitwise pre-PR.
+    autoscale: AutoscaleConfig = field(default_factory=AutoscaleConfig)
+    # trace-driven spot-market chaos harness (rollout/spotmarket.py):
+    # scripted capacity offers / preemption notices / no-notice kills
+    # replayed against the pool — the controller's CapacityProvider
+    spot_market: SpotMarketConfig = field(default_factory=SpotMarketConfig)
 
 
 @dataclass

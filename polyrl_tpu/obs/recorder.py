@@ -162,6 +162,10 @@ DEFAULT_WATCH = {
     # push counter starting to climb means the sync fabric is degrading —
     # only a RISE is the anomaly
     "transfer/push_failures": "high",
+    # degradation-tier ladder (rollout/autoscale.py): 0 remote-preferred,
+    # 1 colocated fallback, 2 local degraded completion — climbing UP the
+    # ladder is the anomaly, recovering back down is healthy
+    "autoscale/degrade_tier": "high",
 }
 
 

@@ -8,9 +8,10 @@ trainer mounts a standalone :class:`StatuszServer` (it has no HTTP surface
 of its own); the rollout server mounts ``/statusz`` as a route on its
 existing listener (rollout/server.py).
 
-Schema (``polyrl/statusz/v4`` — additive evolution only; v2 added the
+Schema (``polyrl/statusz/v5`` — additive evolution only; v2 added the
 ``engine`` section, v3 the ``training`` section, v4 the ``timeseries``
-section; version-history table in ARCHITECTURE.md "Observability"):
+section, v5 the ``autoscale`` section; version-history table in
+ARCHITECTURE.md "Observability"):
 
 - ``role``      — ``trainer`` | ``rollout``
 - ``pid`` / ``time_unix_s`` / ``uptime_s``
@@ -40,8 +41,13 @@ section; version-history table in ARCHITECTURE.md "Observability"):
   fleet ``engine/*`` gauges, ``training/*`` and ``critpath/*`` scalars.
   The trainer windows its step records; the rollout server windows its
   ``server_info`` samples (one per manager stats poll / statusz hit).
+- ``autoscale`` — the closed-loop autoscaling plane (rollout/autoscale.py):
+  last decision (action, reason, inputs, suppressions), the degradation
+  tier, the fleet envelope, and cumulative action totals. Trainer role
+  with an AutoscaleController attached; empty elsewhere (including the
+  rollout plane — the controller lives trainer-side).
 
-Every v4 section is ALWAYS present on both planes (conformance-tested) so
+Every v5 section is ALWAYS present on both planes (conformance-tested) so
 consumers never need existence checks.
 
 ``GET /metrics`` on the same listener renders the snapshot's numeric
@@ -61,7 +67,7 @@ from typing import Callable
 
 log = logging.getLogger(__name__)
 
-SCHEMA = "polyrl/statusz/v4"
+SCHEMA = "polyrl/statusz/v5"
 _PROC_T0 = time.monotonic()
 _HIST_SUFFIXES = ("p50", "p95", "p99", "max", "mean", "count")
 
@@ -70,7 +76,7 @@ _HIST_SUFFIXES = ("p50", "p95", "p99", "max", "mean", "count")
 REQUIRED_SECTIONS = ("schema", "role", "pid", "time_unix_s", "uptime_s",
                      "step", "goodput", "histograms", "counters", "gauges",
                      "queues", "weights", "pool", "engine", "training",
-                     "timeseries")
+                     "timeseries", "autoscale")
 
 
 def build_snapshot(role: str, *, step: int | None = None,
@@ -83,7 +89,8 @@ def build_snapshot(role: str, *, step: int | None = None,
                    pool: dict | None = None,
                    engine: dict | None = None,
                    training: dict | None = None,
-                   timeseries: dict | None = None) -> dict:
+                   timeseries: dict | None = None,
+                   autoscale: dict | None = None) -> dict:
     """The shared statusz schema; every section present (empty when the
     plane has nothing for it) so consumers never need existence checks."""
     return {
@@ -103,6 +110,7 @@ def build_snapshot(role: str, *, step: int | None = None,
         "engine": engine or {},
         "training": training or {},
         "timeseries": timeseries or {},
+        "autoscale": autoscale or {},
     }
 
 

@@ -27,7 +27,7 @@ from collections import deque
 # walls, the critical-path attribution, perf/pool/engine/training gauges
 # — everything the autoscaling loop or a trend dashboard would window
 DEFAULT_PREFIXES = ("goodput/", "perf/", "pool/", "engine/", "training/",
-                    "manager/", "critpath/")
+                    "manager/", "critpath/", "autoscale/")
 
 
 def least_squares_slope(xs, ys) -> float:

@@ -221,6 +221,10 @@ class FaultInjector:
         # ``RolloutServer.kill`` or ``FakeEngine.kill``); armed by
         # engine_kill_times in the config
         self.engine_killer = None
+        # spot-market hook (rollout/spotmarket.py): when a SpotMarket is
+        # attached its fault/spot_* counters ride the same step record as
+        # the fault/* recovery counters its events cause
+        self.spot = None
         # telemetry
         self.kills = 0
         self.corruptions = 0
@@ -230,7 +234,7 @@ class FaultInjector:
         self.engine_kills = 0
 
     def counters(self) -> dict[str, float]:
-        return {
+        out = {
             "fault/injected_kills": float(self.kills),
             "fault/injected_corruptions": float(self.corruptions),
             "fault/injected_stalls": float(self.stalls),
@@ -238,6 +242,9 @@ class FaultInjector:
             "fault/injected_stream_kills": float(self.stream_kills),
             "fault/injected_engine_kills": float(self.engine_kills),
         }
+        if self.spot is not None:
+            out.update(self.spot.counters())
+        return out
 
     # -- engine/server-side hooks -------------------------------------------
 

@@ -101,6 +101,10 @@ class PoolManager:
         # train.py wires TransferInterface.sync_health) — merged into the
         # /statusz pool section's engine rows as their "transfer" block
         self.transfer_health_fn = None
+        # sweep fault isolation: transient manager HTTP errors are
+        # counted (pool/sweep_failed) and backed off, never fatal to the
+        # background sweep thread
+        self.sweep_failures = 0
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         if self.cfg.sweep_interval_s > 0:
@@ -122,6 +126,7 @@ class PoolManager:
         try:
             st = self.manager.get_instances_status()
         except Exception:  # noqa: BLE001 — manager mid-respawn
+            self.sweep_failures += 1
             log.warning("pool sweep failed; serving last snapshot",
                         exc_info=True)
             with self._lock:
@@ -132,8 +137,22 @@ class PoolManager:
         return st
 
     def _sweep_loop(self) -> None:
-        while not self._stop.wait(self.cfg.sweep_interval_s):
-            self.sweep()
+        # fault isolation: sweep() already swallows manager errors, but a
+        # flaky manager must not spin the thread at full cadence either —
+        # consecutive failures double the interval (capped at 8x), one
+        # success restores it, and the loop NEVER exits on error
+        base = self.cfg.sweep_interval_s
+        interval = base
+        while not self._stop.wait(interval):
+            before = self.sweep_failures
+            try:
+                self.sweep()
+            except Exception:  # noqa: BLE001 — belt and braces: nothing
+                # a sweep raises may kill the membership view
+                self.sweep_failures += 1
+                log.warning("pool sweep raised; continuing", exc_info=True)
+            interval = (min(interval * 2, base * 8)
+                        if self.sweep_failures > before else base)
 
     def engines(self, refresh: bool = True) -> list[dict]:
         st = self.sweep() if refresh else self._last_status
@@ -210,14 +229,31 @@ class PoolManager:
         engine refuses new admissions and aborts in-flight requests into
         salvageable partials, which re-route to survivors as suffix
         resumes through the manager's continuation), a short grace for
-        those aborts to flush, then graceful deregistration."""
+        those aborts to flush, then graceful deregistration.
+
+        Against an ALREADY-DEAD endpoint the drain POST fails: there are
+        no partials to flush, so the grace sleep is skipped and the
+        removal falls through to the hard-eviction path idempotently —
+        booked ONCE as an eviction (not a graceful departure), never a
+        raise (the heartbeat backstops a failed deregister too)."""
         self.preemptions += 1
         out: dict = {}
+        drained = True
         try:
             out = _http_post(endpoint, "/drain")
         except Exception:  # noqa: BLE001 — engine may already be gone
-            log.warning("drain of %s failed; deregistering anyway",
+            drained = False
+            log.warning("drain of %s failed; evicting instead",
                         endpoint, exc_info=True)
+        if not drained:
+            self.hard_evictions += 1
+            try:
+                self.manager.deregister_rollout_instance(endpoint,
+                                                         drained=False)
+            except Exception:  # noqa: BLE001 — heartbeat backstops
+                log.warning("eviction of %s failed; heartbeat will evict",
+                            endpoint, exc_info=True)
+            return out
         time.sleep(grace_s if grace_s is not None else self.cfg.drain_grace_s)
         try:
             self.manager.deregister_rollout_instance(endpoint, drained=True)
@@ -276,6 +312,7 @@ class PoolManager:
             "pool/drain_departures": float(pool.get("drain_departures", 0)),
             "pool/preemption_drills": float(self.preemptions),
             "pool/laggard_escalations": float(self.laggards),
+            "pool/sweep_failed": float(self.sweep_failures),
         }
         versions = [int(i.get("weight_version", -1)) for i in insts]
         if versions:
@@ -453,14 +490,24 @@ class BalanceEstimator:
         reads "the fleet is saturating and the trainer is starting to
         starve: add an engine"; both falling reads "drain one". Keys:
         ``{occupancy,bubble,step_time,throughput}_slope`` +
-        ``window_steps``; {} before the first observe."""
+        ``window_steps`` + ``balance_trends_valid``; {} before the first
+        observe.
+
+        Cold-window guard: a least-squares slope over 1-2 points is
+        noise (two points ALWAYS fit a line exactly), so with fewer than
+        3 observed steps every slope is forced to 0.0 and
+        ``balance_trends_valid`` is 0.0 — the AutoscaleController
+        suppresses trend-driven actions until the window is real."""
         with self._lock:
             if not self._steps:
                 return {}
             steps = list(self._steps)
         xs = list(range(len(steps)))
+        valid = len(steps) >= 3
 
         def slope(key: str) -> float:
+            if not valid:
+                return 0.0
             return least_squares_slope(xs, [s[key] for s in steps])
 
         return {
@@ -469,6 +516,7 @@ class BalanceEstimator:
             "step_time_slope": slope("step_time_s"),
             "throughput_slope": slope("throughput"),
             "window_steps": float(len(steps)),
+            "balance_trends_valid": 1.0 if valid else 0.0,
         }
 
     def stats(self) -> dict[str, float]:
@@ -511,4 +559,6 @@ class BalanceEstimator:
             "pool/balance_occupancy_slope": trends.get(
                 "occupancy_slope", 0.0),
             "pool/balance_bubble_slope": trends.get("bubble_slope", 0.0),
+            "pool/balance_trends_valid": trends.get(
+                "balance_trends_valid", 0.0),
         }

@@ -123,6 +123,11 @@ class RemoteRollout:
         # control-plane fault counters (cumulative; trainer gauges them)
         self.stream_resumes = 0
         self.local_fallbacks = 0
+        # requests completed by finish_locally (tier-2 degraded
+        # completion): local_fallbacks counts the fallback EVENTS, this
+        # counts the request volume those events had to finish on-host —
+        # what the degradation plane sizes the cost of tier 2 with
+        self.local_fallback_requests = 0
         # token-level salvage counters: tokens carried across a resume
         # instead of re-decoded, suffix re-issues performed, and the prefill
         # length those re-issues paid (prompt + salvage — the recovery cost
@@ -169,6 +174,8 @@ class RemoteRollout:
         out = {
             "fault/stream_resumes": float(self.stream_resumes),
             "fault/local_fallbacks": float(self.local_fallbacks),
+            "fault/local_fallback_requests": float(
+                self.local_fallback_requests),
             "fault/dropped_groups": float(self.dropped_groups),
             "fault/tokens_salvaged": float(self.tokens_salvaged),
             "fault/suffix_resumes": float(self.suffix_resumes),
@@ -341,6 +348,7 @@ class RemoteRollout:
             # degraded completion also resumes from the last token instead
             # of re-decoding from zero.
             eng = self.local_server.engine
+            self.local_fallback_requests += len(pending)
             was_released = released.is_set()
             if hasattr(eng, "resume_memory"):
                 eng.resume_memory()

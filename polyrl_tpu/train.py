@@ -483,11 +483,40 @@ def build_trainer(cfg: RunConfig, cleanup: list | None = None):
     else:
         health = False
 
+    # closed-loop autoscaling (rollout/autoscale.py): default OFF — when
+    # enabled (and a PoolManager exists to act on), the controller ticks
+    # once per step from the fit loop; a spot-market trace doubles as its
+    # CapacityProvider so scripted offers satisfy its add requests
+    autoscale = None
+    if (cfg.rollout.autoscale.enabled
+            and getattr(rollout, "pool", None) is not None):
+        from polyrl_tpu.rollout.autoscale import AutoscaleController
+
+        capacity = None
+        if cfg.rollout.spot_market.enabled:
+            from polyrl_tpu.rollout.spotmarket import SpotMarket
+
+            market = SpotMarket(
+                rollout.pool, cfg.rollout.spot_market,
+                injector=getattr(rollout, "fault_injector", None))
+            market.start()
+            cleanup.append(market.stop)
+            capacity = market
+        autoscale = AutoscaleController(
+            rollout.pool, rollout.balance, cfg.rollout.autoscale,
+            capacity=capacity, rollout=rollout)
+        cleanup.append(autoscale.close)
+        log.info("autoscale controller armed: envelope [%d, %d]%s",
+                 cfg.rollout.autoscale.min_engines,
+                 cfg.rollout.autoscale.max_engines,
+                 " (dry-run)" if cfg.rollout.autoscale.dry_run else "")
+
     val_dataset = build_dataset(cfg, "val")
     trainer = StreamRLTrainer(
         cfg.trainer, actor, rollout, tokenizer, reward_manager, loader,
         critic=critic, ref_policy=ref_policy, logger=logger,
-        val_dataset=val_dataset, recorder=recorder, health=health)
+        val_dataset=val_dataset, recorder=recorder, health=health,
+        autoscale=autoscale)
     if cfg.obs.statusz and multihost.is_main():
         # live health plane: GET /statusz answers "what is this trainer
         # doing right now" (shared schema with the rollout server's route)

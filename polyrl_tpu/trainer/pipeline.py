@@ -124,6 +124,11 @@ class RolloutPipeline:
                 self._drain_stats()
                 prod_metrics = MetricsTracker()
                 try:
+                    # degradation backpressure (rollout/autoscale.py): while
+                    # the fleet is EMPTY, hold the new stream instead of
+                    # slamming it straight into the tier-2 local-completion
+                    # path — a no-op without an AutoscaleController
+                    trainer._wait_pool_admission(prod_metrics)
                     # admission gate: limit=1 is the hard fence (the
                     # previous async push fully landed before this
                     # stream's first request — today's bitwise behavior);

@@ -220,6 +220,7 @@ class StreamRLTrainer:
         val_dataset=None,
         recorder=None,
         health=None,
+        autoscale=None,
     ):
         self.cfg = cfg
         self.actor = actor
@@ -284,6 +285,11 @@ class StreamRLTrainer:
         if health is None:
             health = obs.TrainingHealthLedger()
         self._health = health or None
+        # closed-loop autoscaling (rollout/autoscale.py): ticked once per
+        # finished step with the fresh pool counters + the previous step's
+        # record; also gates pipeline admission while the fleet is empty.
+        # None (the default) is the pre-autoscale trainer, bit for bit.
+        self._autoscale = autoscale
         # anomaly flight recorder (obs/recorder.py): fed each finished
         # step record; dumps post-mortem bundles on anomaly/crash
         self._recorder = recorder
@@ -1142,7 +1148,8 @@ class StreamRLTrainer:
             counters.update(self._recorder.counters())
         gauges = {k: float(v) for k, v in rec.items()
                   if k.startswith(("perf/", "training/", "manager/",
-                                   "pool/", "engine/", "critpath/"))}
+                                   "pool/", "engine/", "critpath/",
+                                   "autoscale/"))}
         pool = getattr(self.rollout, "pool", None)
         return statusz.build_snapshot(
             "trainer", step=self.global_step,
@@ -1180,7 +1187,11 @@ class StreamRLTrainer:
                       if self._health is not None else None),
             # fleet time-series rail: windowed aggregates + slopes over
             # the step-record stream (obs/timeseries.py)
-            timeseries=self._timeseries.section())
+            timeseries=self._timeseries.section(),
+            # closed-loop autoscaling plane: last decision + totals
+            # (rollout/autoscale.py; empty when no controller attached)
+            autoscale=(self._autoscale.statusz_section()
+                       if self._autoscale is not None else None))
 
     def _critical_path_view(self) -> dict:
         """Recorder hook: the last N per-step critical paths, dumped into
@@ -1190,6 +1201,22 @@ class StreamRLTrainer:
             return {}
         return {"count": len(self._critpaths),
                 "paths": list(self._critpaths)}
+
+    def _wait_pool_admission(self, metrics=None) -> float:
+        """Admission backpressure (degradation layer): before launching a
+        new rollout stream, hold while the fleet is EMPTY (``active==0``)
+        so a collapse window queues work instead of slamming every new
+        stream straight into the tier-2 local-completion path. A no-op
+        (0.0) without an AutoscaleController — the pre-autoscale trainer
+        never waits. Returns seconds waited; gauges the wait when a
+        metrics tracker is passed."""
+        if self._autoscale is None:
+            return 0.0
+        waited = self._autoscale.hold_admission()
+        if waited and metrics is not None:
+            metrics.update_gauge(
+                {"autoscale/admission_gate_wait_s": waited})
+        return waited
 
     # -- fit --------------------------------------------------------------
 
@@ -1333,7 +1360,16 @@ class StreamRLTrainer:
                     # every step record
                     metrics.update_gauge(self.rollout.balance.metrics())
                     if self.rollout.pool is not None:
-                        metrics.update_gauge(self.rollout.pool.counters())
+                        pool_counters = self.rollout.pool.counters()
+                        metrics.update_gauge(pool_counters)
+                        if self._autoscale is not None:
+                            # close the loop: the controller reads this
+                            # step's fleet gauges + the PREVIOUS record's
+                            # critpath attribution and acts on the pool;
+                            # its decision lands in THIS record
+                            metrics.update_gauge(self._autoscale.tick(
+                                self.global_step, fleet=pool_counters,
+                                record=self._last_record))
                 self._maybe_validate(metrics,
                                      force=self.global_step >= cfg.total_steps)
                 if self._ckpt is not None and ckpt_lib.should_save_checkpoint(

@@ -1,0 +1,201 @@
+"""Closed-loop autoscaling chaos e2e (quick tier, fake engines).
+
+One trainer, two fake engines, a step-paced spot-market trace, and the
+AutoscaleController wired into the fit loop. The storm: two preemption
+NOTICES (grace-window drains — tokens ride the salvage path), one
+no-notice KILL (heartbeat eviction + manager continuation), and capacity
+offers the controller turns into adds; a final ``auto_add`` offer pushes
+the fleet ABOVE the envelope to provoke a controller-initiated proactive
+drain. The fit must complete with zero dropped groups,
+``fault/suffix_resumes > 0``, at least one controller add AND one
+controller drain in the ``autoscale/*`` record, and the pool back at
+target size at exit.
+
+A second test pins the bitwise guarantee: a depth-0 serial fit without
+the controller (the default) and one with a DISABLED controller land on
+bit-identical parameters — autoscale off is the pre-autoscale trainer.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from polyrl_tpu.data.dataset import PromptDataLoader, make_arithmetic_dataset
+from polyrl_tpu.manager.client import ManagerClient, spawn_rollout_manager
+from polyrl_tpu.models import decoder
+from polyrl_tpu.rewards.manager import load_reward_manager
+from polyrl_tpu.rollout.autoscale import AutoscaleConfig, AutoscaleController
+from polyrl_tpu.rollout.faults import FaultInjectionConfig, FaultInjector
+from polyrl_tpu.rollout.pool import PoolConfig, PoolManager
+from polyrl_tpu.rollout.remote import RemoteRollout
+from polyrl_tpu.rollout.spotmarket import SpotMarket, SpotMarketConfig
+from polyrl_tpu.trainer.actor import ActorConfig, StreamActor
+from polyrl_tpu.trainer.stream_trainer import StreamRLTrainer, TrainerConfig
+from polyrl_tpu.utils.tokenizer import ByteTokenizer
+from tests.fake_engine import FakeEngine
+
+_FAST_ARGS = ["--health-check-interval-s", "0.1",
+              "--stats-poll-interval-s", "0.1",
+              "--heartbeat-failures", "2",
+              "--generate-timeout-ms", "10000",
+              "--schedule-wait-timeout-ms", "5000"]
+
+_TCFG = dict(
+    train_batch_size=4, rollout_n=2, ppo_mini_batch_size=8,
+    micro_batch_size=4, min_stream_batch_size=4,
+    max_prompt_length=16, max_response_length=8,
+    adv_estimator="grpo", temperature=1.0)
+
+
+def test_autoscale_chaos_spot_storm_fit():
+    proc, port = spawn_rollout_manager("127.0.0.1:0", extra_args=_FAST_ARGS)
+    mgr = ManagerClient(f"127.0.0.1:{port}")
+    eng_a = FakeEngine(start_token=30, token_delay_s=0.01).start()
+    eng_b = FakeEngine(start_token=30, token_delay_s=0.005).start()
+    # one worst-moment manager-stream kill guarantees the client-side
+    # salvage ledger runs (fault/suffix_resumes) on top of the storm
+    injector = FaultInjector(FaultInjectionConfig(
+        enabled=True, stream_kill_times=1, stream_kill_min_progress=1))
+    pool = PoolManager(mgr, PoolConfig(drain_grace_s=0.1))
+    # step-paced storm (t = trainer step, fired synchronously from the
+    # controller tick — deterministic pacing on a 1-core box):
+    # two notices, one kill, three offers (the last forced on, pushing
+    # the fleet over the [2,2] envelope to provoke a proactive drain)
+    events = [
+        {"t": 1, "event": "offer", "name": "C"},
+        {"t": 1, "event": "notice", "target": "A"},
+        {"t": 3, "event": "kill", "target": "B"},
+        {"t": 3, "event": "offer", "name": "D"},
+        {"t": 5, "event": "notice", "target": "C"},
+        {"t": 5, "event": "offer", "name": "E"},
+        {"t": 7, "event": "offer", "name": "F", "auto_add": True},
+    ]
+    market = SpotMarket(
+        pool, SpotMarketConfig(enabled=True, grace_s=0.1, time_base="step"),
+        engine_factory=lambda: FakeEngine(start_token=30,
+                                          token_delay_s=0.005).start(),
+        injector=injector, events=events)
+    market.adopt("A", eng_a)
+    market.adopt("B", eng_b)
+    market.start()
+    ctl = None
+    try:
+        mgr.wait_healthy()
+        for e in (eng_a, eng_b):
+            mgr.register_rollout_instance(e.endpoint)
+        pool.wait_for_size(2)
+
+        tok = ByteTokenizer()
+        cfg = decoder.get_config("tiny", dtype=jnp.float32)
+        params = decoder.init_params(jax.random.PRNGKey(0), cfg)
+        remote = RemoteRollout(mgr, pad_token_id=tok.pad_token_id,
+                               resume_budget=3, resume_wait_s=10.0,
+                               fault_injector=injector, pool=pool)
+        ctl = AutoscaleController(
+            pool, remote.balance,
+            AutoscaleConfig(enabled=True, min_engines=2, max_engines=2,
+                            hold_steps=1, cooldown_add_s=0.0,
+                            cooldown_drain_s=0.0, max_actions_per_hour=100,
+                            admission_max_wait_s=5.0),
+            capacity=market, rollout=remote)
+        actor = StreamActor(cfg, ActorConfig(lr=1e-4, remat=False), params)
+        trainer = StreamRLTrainer(
+            TrainerConfig(total_steps=9, **_TCFG), actor, remote, tok,
+            load_reward_manager("naive", tok, num_workers=1),
+            PromptDataLoader(make_arithmetic_dataset(48), 4),
+            autoscale=ctl)
+        history = trainer.fit()
+
+        assert len(history) == 9
+        # the headline: the storm cost throughput, never training data
+        assert remote.dropped_groups == 0
+        counters = remote.fault_counters()
+        assert counters["fault/suffix_resumes"] >= 1
+        assert counters["fault/dropped_groups"] == 0
+        # the whole trace replayed: 2 notices, 1 kill, 3 offers
+        assert market.done.is_set()
+        assert market.notices == 2
+        assert market.kills == 1
+        assert market.offers == 4
+        # the controller closed the loop: at least one add (from a market
+        # offer) and one proactive drain (the over-envelope repair)
+        last = history[-1]
+        assert last["autoscale/adds_total"] >= 1.0
+        assert last["autoscale/drains_total"] >= 1.0
+        assert last["autoscale/ticks"] == 9.0
+        assert last["autoscale/enabled"] == 1.0
+        # spot counters rode the fault-injection plane into the record
+        assert last["fault/spot_notices"] == 2.0
+        assert last["fault/spot_kills"] == 1.0
+        assert ctl.wait_idle()
+        # pool back at target size at exit
+        pool.wait_for_size(2, deadline_s=20.0)
+        # /statusz carries the autoscale section with the decision trail
+        snap = trainer.statusz_snapshot()
+        assert snap["schema"] == "polyrl/statusz/v5"
+        assert snap["autoscale"]["totals"]["adds"] >= 1
+        assert snap["autoscale"]["totals"]["drains"] >= 1
+        assert snap["autoscale"]["envelope"] == {"min": 2, "max": 2}
+    finally:
+        if ctl is not None:
+            ctl.close()
+        market.stop()
+        proc.kill()
+        pool.close()
+        for e in (eng_a, eng_b):
+            e.stop()
+
+
+def _serial_fit(mgr, pool, tok, cfg, autoscale=None):
+    """One 2-step depth-0 fit from a fixed seed; returns final params."""
+    params = decoder.init_params(jax.random.PRNGKey(0), cfg)
+    remote = RemoteRollout(mgr, pad_token_id=tok.pad_token_id,
+                           resume_budget=3, resume_wait_s=10.0, pool=pool)
+    actor = StreamActor(cfg, ActorConfig(lr=1e-4, remat=False), params)
+    kwargs = {} if autoscale is None else {"autoscale": autoscale}
+    trainer = StreamRLTrainer(
+        TrainerConfig(total_steps=2, **_TCFG), actor, remote, tok,
+        load_reward_manager("naive", tok, num_workers=1),
+        PromptDataLoader(make_arithmetic_dataset(32), 4), **kwargs)
+    history = trainer.fit()
+    return history, actor.params
+
+
+def test_autoscale_disabled_is_bitwise_identical():
+    """Depth-0 serial fit with autoscale DISABLED (the default-off config)
+    must be bit-for-bit the pre-autoscale trainer: same parameters as a
+    fit constructed without the controller at all, and no pool actions."""
+    proc, port = spawn_rollout_manager("127.0.0.1:0", extra_args=_FAST_ARGS)
+    mgr = ManagerClient(f"127.0.0.1:{port}")
+    eng = FakeEngine(start_token=30).start()
+    pool = PoolManager(mgr, PoolConfig(drain_grace_s=0.1))
+    ctl = None
+    try:
+        mgr.wait_healthy()
+        mgr.register_rollout_instance(eng.endpoint)
+        pool.wait_for_size(1)
+        tok = ByteTokenizer()
+        cfg = decoder.get_config("tiny", dtype=jnp.float32)
+
+        hist_a, params_a = _serial_fit(mgr, pool, tok, cfg)
+
+        ctl = AutoscaleController(pool, None, AutoscaleConfig(enabled=False))
+        hist_b, params_b = _serial_fit(mgr, pool, tok, cfg, autoscale=ctl)
+
+        same = jax.tree_util.tree_map(
+            lambda a, b: bool(jnp.array_equal(a, b)), params_a, params_b)
+        assert all(jax.tree_util.tree_leaves(same))
+        # the default path carries no autoscale keys at all; the disabled
+        # controller records its (inert) gauges but never acted
+        assert not any(k.startswith("autoscale/")
+                       for rec in hist_a for k in rec)
+        assert hist_b[-1]["autoscale/enabled"] == 0.0
+        assert hist_b[-1]["autoscale/adds_total"] == 0.0
+        assert hist_b[-1]["autoscale/drains_total"] == 0.0
+        assert pool.preemptions == 0
+        assert pool.hard_evictions == 0
+    finally:
+        if ctl is not None:
+            ctl.close()
+        proc.kill()
+        pool.close()
+        eng.stop()

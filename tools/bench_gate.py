@@ -73,6 +73,12 @@ WATCHED_EXTRA = (
     ("pool.tok_s", "low"),
     ("pool.pool_engines", "low"),
     ("pool.recovery_s", "high"),
+    # spot-market chaos drill (bench.py --pool --spot-trace FILE): the
+    # fraction of requests that complete THROUGH the scripted offer/
+    # notice/kill storm must hold, and the wall from first disruption to
+    # the pool being back at target must not blow up
+    ("pool.spot.completed_frac", "low"),
+    ("pool.spot.recovery_s", "high"),
     # engine flight deck (server-side ledger, promoted from the cb phase):
     # decode occupancy and prefix-cache hit rate must hold; the
     # server-measured TTFT/TPOT tails must not blow up

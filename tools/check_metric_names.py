@@ -125,6 +125,10 @@ NAMESPACES = frozenset({
     "critpath",      # per-step critical-path attribution: bottleneck
                      # segment, per-segment critical fractions, slack and
                      # 10%-speedup headroom (obs/critical_path.py)
+    "autoscale",     # closed-loop autoscaling: per-tick decision gauges
+                     # (action/reason/suppressions), action totals, the
+                     # degradation tier, and the admission-gate wait
+                     # (rollout/autoscale.py)
 })
 
 # APIs whose first positional string argument IS a metric key
