@@ -620,6 +620,16 @@ def bench_cb(cfg, params, batch, prompt_len, new_tokens, max_slots=64,
             "shared_prefix_read_frac", 0.0)),
         "engine_kv_read_pages_per_token": float(rl.get(
             "kv_read_pages_per_token", 0.0)),
+        # KV memory plane (rollout/kvledger.py via server_info): cold
+        # residency at end of run and the device HBM headroom — the two
+        # gauges bench_gate holds across rounds (cold creeping up = a
+        # residency leak; headroom dropping = something grew into the
+        # page pool's margin). Headroom is absent on CPU-sized rounds.
+        "engine_kv_cold_page_frac": round(float(srv_info.get(
+            "kv_cold_page_frac", 0.0)), 4),
+        **({"engine_hbm_headroom_gb": round(float(
+            srv_info["hbm_headroom_gb"]), 3)}
+           if "hbm_headroom_gb" in srv_info else {}),
     }
 
 
@@ -2040,7 +2050,8 @@ def assemble_result(state: dict) -> dict:
               "engine_cache_hit_rate", "engine_ttft_p95_ms",
               "engine_tpot_p95_ms", "engine_attributed_frac",
               "engine_prefill_reuse_frac", "engine_shared_prefix_read_frac",
-              "engine_kv_read_pages_per_token"):
+              "engine_kv_read_pages_per_token",
+              "engine_kv_cold_page_frac", "engine_hbm_headroom_gb"):
         v = cb.get(k)
         if isinstance(v, (int, float)) and not isinstance(v, bool):
             extra[k] = v
@@ -2355,21 +2366,27 @@ def parent_main() -> None:
                           sort_keys=True)
 
     prev = snapshot()
+    down_streak = 0  # consecutive down polls (log collapse state)
     while time.monotonic() - t_start < budget_s:
         if runs >= 12 or no_progress >= MAX_ATTEMPTS:
             break  # retry ladder exhausted — emit now, relay state moot
         # ---- relay pre-probe: NEVER hand a dead relay to a jax dial ----
         # (r4 post-mortem: two 900 s dead dials ate the whole window). A
         # down relay costs one 2 s socket probe + a 30 s sleep per poll;
-        # the heartbeat lines make a tunnel-down round diagnosable from
-        # the driver's stderr tail.
+        # state-CHANGE lines plus an every-10th-poll summary keep a
+        # tunnel-down round diagnosable from the driver's stderr tail
+        # without a 30 s-cadence spam wall (an hour down = 120 identical
+        # lines burying the actual failure).
         if _relay_required() and not _relay_up():
             relay_stats["down_polls"] += 1
+            down_streak += 1
             remaining = budget_s - (time.monotonic() - t_start)
-            print(f"[bench] relay 127.0.0.1:{RELAY_PROBE_PORT} DOWN "
-                  f"(poll {relay_stats['down_polls']}, "
-                  f"{remaining:.0f}s of budget left) — sleeping "
-                  f"{RELAY_POLL_S:.0f}s", file=sys.stderr, flush=True)
+            if down_streak == 1 or down_streak % 10 == 0:
+                print(f"[bench] relay 127.0.0.1:{RELAY_PROBE_PORT} DOWN "
+                      f"(poll {down_streak} of this outage, "
+                      f"{relay_stats['down_s']:.0f}s down so far, "
+                      f"{remaining:.0f}s of budget left) — polling every "
+                      f"{RELAY_POLL_S:.0f}s", file=sys.stderr, flush=True)
             nap = min(RELAY_POLL_S, max(remaining, 0.0))
             time.sleep(nap)
             relay_stats["down_s"] = round(relay_stats["down_s"] + nap, 1)
@@ -2385,6 +2402,14 @@ def parent_main() -> None:
                     f"{relay_down_budget:.0f}s); failing fast", relay_stats)
                 return
             continue  # polls consume neither runs nor the progress streak
+        if down_streak:
+            # state change: the relay came back — one line closes the
+            # outage the collapsed polls above were riding out
+            print(f"[bench] relay UP after {down_streak} down polls "
+                  f"({relay_stats['down_s']:.0f}s of "
+                  f"{relay_down_budget:.0f}s down-budget spent)",
+                  file=sys.stderr, flush=True)
+            down_streak = 0
         runs += 1
         print(f"[bench] child run {runs} (no-progress streak {no_progress})",
               file=sys.stderr, flush=True)

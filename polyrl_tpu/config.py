@@ -97,6 +97,14 @@ class RolloutSection:
     # refs survive waiting for siblings that never arrive (dropped
     # groups, mis-sized hints) before the TTL sweep releases them
     group_preref_ttl_s: float = 30.0
+    # KV memory plane (ARCHITECTURE.md "KV memory plane"): per-page
+    # residency/lifetime ledger feeding the ``memory`` statusz section,
+    # ``engine/kv_{hot,warm,cold}_page_frac`` gauges and HBM attribution.
+    # False restores the pre-ledger engine, bit for bit.
+    kv_ledger: bool = True
+    # idle age (in decode dispatches since last touch) past which a
+    # resident page counts as COLD (warm = a quarter of this)
+    kv_cold_after_dispatches: int = 256
     # disaggregated plumbing (reference rollout_manager.{port,endpoint},
     # workers/config/rollout.py:95-101)
     manager_endpoint: str = ""            # "" → spawn the C++ manager locally

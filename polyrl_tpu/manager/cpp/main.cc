@@ -363,6 +363,10 @@ class Manager {
               fwd("attributed_frac", inst->attributed_frac);
               fwd("prefill_reuse_frac", inst->prefill_reuse_frac);
               fwd("prefix_hit_frac", inst->prefix_hit_frac);
+              // KV memory plane: cold residency + HBM headroom. Absent on
+              // ledger-off / CPU engines — headroom keeps its -1 sentinel
+              fwd("kv_cold_page_frac", inst->kv_cold_page_frac);
+              fwd("hbm_headroom_gb", inst->hbm_headroom_gb);
               if (info["draining"].as_bool() && !inst->draining.load()) {
                 log_line("instance " + inst->endpoint +
                          " announced draining; leaving routing set");
@@ -497,6 +501,11 @@ void register_routes(phttp::Server& server, Manager& mgr) {
       o["attributed_frac"] = Value(inst->attributed_frac.load());
       o["prefill_reuse_frac"] = Value(inst->prefill_reuse_frac.load());
       o["prefix_hit_frac"] = Value(inst->prefix_hit_frac.load());
+      o["kv_cold_page_frac"] = Value(inst->kv_cold_page_frac.load());
+      // -1 sentinels "engine never reported headroom" (CPU / ledger off);
+      // omitting the key keeps the fleet min from counting it as 0 GB
+      if (inst->hbm_headroom_gb.load() >= 0.0)
+        o["hbm_headroom_gb"] = Value(inst->hbm_headroom_gb.load());
       arr.push_back(Value(std::move(o)));
     }
     Object top;
@@ -561,6 +570,15 @@ void register_routes(phttp::Server& server, Manager& mgr) {
       per += "polyrl_mgr_instance_ttft_p95_s{endpoint=\"" +
              esc(inst->endpoint) + "\"} " +
              std::to_string(inst->ttft_p95_s.load()) + "\n";
+      // KV memory plane per-instance view: which engine's resident set is
+      // going cold, and who is closest to HBM exhaustion (-1 = unreported)
+      per += "polyrl_mgr_instance_kv_cold_page_frac{endpoint=\"" +
+             esc(inst->endpoint) + "\"} " +
+             std::to_string(inst->kv_cold_page_frac.load()) + "\n";
+      if (inst->hbm_headroom_gb.load() >= 0.0)
+        per += "polyrl_mgr_instance_hbm_headroom_gb{endpoint=\"" +
+               esc(inst->endpoint) + "\"} " +
+               std::to_string(inst->hbm_headroom_gb.load()) + "\n";
       if (inst->healthy.load()) {
         occ_sum += inst->occupancy.load();
         ++occ_n;
@@ -614,6 +632,8 @@ void register_routes(phttp::Server& server, Manager& mgr) {
     body += "# TYPE polyrl_mgr_instance_occupancy gauge\n";
     body += "# TYPE polyrl_mgr_instance_page_util gauge\n";
     body += "# TYPE polyrl_mgr_instance_ttft_p95_s gauge\n";
+    body += "# TYPE polyrl_mgr_instance_kv_cold_page_frac gauge\n";
+    body += "# TYPE polyrl_mgr_instance_hbm_headroom_gb gauge\n";
     body += per;
     long total_reqs = 0;
     std::string per_route;

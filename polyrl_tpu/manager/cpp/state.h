@@ -62,6 +62,12 @@ struct Instance {
   // hit fraction
   std::atomic<double> prefill_reuse_frac{0.0};
   std::atomic<double> prefix_hit_frac{0.0};
+  // KV memory plane telemetry (rollout/kvledger.py): fraction of resident
+  // pages gone cold (idle past the tier threshold) and device HBM headroom
+  // in GB. headroom < 0 sentinels "not reported" (CPU engines / ledger
+  // off) so the fleet min never counts an unreporting engine as 0 GB.
+  std::atomic<double> kv_cold_page_frac{0.0};
+  std::atomic<double> hbm_headroom_gb{-1.0};
 };
 
 using InstancePtr = std::shared_ptr<Instance>;

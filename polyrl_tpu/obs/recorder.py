@@ -20,6 +20,13 @@ bundle into the run directory:
                          (obs/critical_path.py via ``critical_path_fn``;
                          when wired) — the bundle answers "what chain
                          bounded the steps before this died"
+    ``memory.json``    — the KV memory plane view (rollout/kvledger.py via
+                         ``memory_fn``; when wired) — page roles, residency
+                         tiers, free-cause churn and the ledger↔pool
+                         reconciliation at anomaly time
+    ``memprof.pprof``  — best-effort ``jax.profiler.device_memory_profile``
+                         snapshot (real devices only; silently skipped on
+                         CPU or when jax is absent)
 
 Detector design: EWMA mean + EW variance with a **median-initialized
 warmup** (the first step carries jit compiles — seeding the mean from the
@@ -166,6 +173,11 @@ DEFAULT_WATCH = {
     # 1 colocated fallback, 2 local degraded completion — climbing UP the
     # ladder is the anomaly, recovering back down is healthy
     "autoscale/degrade_tier": "high",
+    # KV memory plane (rollout/kvledger.py): the resident set going COLD
+    # (pages nobody touches accumulating) is the anomaly — a busy cache
+    # keeps its pages warm; HBM headroom only matters when it DROPS
+    "engine/kv_cold_page_frac": "high",
+    "engine/hbm_headroom_gb": "low",
 }
 
 
@@ -223,6 +235,11 @@ class FlightRecorder:
         # written as critical_path.json so a stall bundle shows which
         # chain bounded the steps leading into the anomaly
         self.critical_path_fn = None
+        # optional zero-arg callable returning the KV memory plane view
+        # (PageLedger.snapshot via the engine/pool) — written as
+        # memory.json so a cold-frac / headroom anomaly bundle carries the
+        # page roles, tiers, free-cause churn and reconciliation state
+        self.memory_fn = None
 
     # -- step stream ---------------------------------------------------------
 
@@ -317,6 +334,27 @@ class FlightRecorder:
                     with open(os.path.join(path, "critical_path.json"),
                               "w") as f:
                         json.dump(cp_view, f, indent=2)
+            if self.memory_fn is not None:
+                try:
+                    memory_view = dict(self.memory_fn())
+                except Exception:  # noqa: BLE001 — best-effort like counters
+                    log.exception("flight recorder memory_fn failed")
+                    memory_view = {}
+                if memory_view:
+                    with open(os.path.join(path, "memory.json"), "w") as f:
+                        json.dump(memory_view, f, indent=2)
+            try:
+                # device memory profile: only real backends serve one (the
+                # CPU test backend raises / returns nothing useful) — any
+                # failure here must not cost the rest of the bundle
+                import jax
+                prof = jax.profiler.device_memory_profile()
+                if prof and jax.default_backend() != "cpu":
+                    with open(os.path.join(path, "memprof.pprof"), "wb") as f:
+                        f.write(prof)
+            except Exception:  # noqa: BLE001 — profile is best-effort
+                log.debug("flight recorder: no device memory profile",
+                          exc_info=True)
             with open(os.path.join(path, "counters.json"), "w") as f:
                 json.dump({
                     "reason": reason,

@@ -8,10 +8,10 @@ trainer mounts a standalone :class:`StatuszServer` (it has no HTTP surface
 of its own); the rollout server mounts ``/statusz`` as a route on its
 existing listener (rollout/server.py).
 
-Schema (``polyrl/statusz/v5`` — additive evolution only; v2 added the
+Schema (``polyrl/statusz/v6`` — additive evolution only; v2 added the
 ``engine`` section, v3 the ``training`` section, v4 the ``timeseries``
-section, v5 the ``autoscale`` section; version-history table in
-ARCHITECTURE.md "Observability"):
+section, v5 the ``autoscale`` section, v6 the ``memory`` section;
+version-history table in ARCHITECTURE.md "Observability"):
 
 - ``role``      — ``trainer`` | ``rollout``
 - ``pid`` / ``time_unix_s`` / ``uptime_s``
@@ -46,8 +46,16 @@ ARCHITECTURE.md "Observability"):
   tier, the fleet envelope, and cumulative action totals. Trainer role
   with an AutoscaleController attached; empty elsewhere (including the
   rollout plane — the controller lives trainer-side).
+- ``memory``    — the KV memory plane (rollout/kvledger.py): per-page
+  role counts (free / active-decode / published / preref-held),
+  hot/warm/cold residency tiers, churn + free-cause counters,
+  page-lifetime histograms, the ledger↔pool ``attributed_frac``
+  reconciliation block, and HBM truth (used/headroom/unaccounted).
+  Rollout role serves its engine's ledger; trainer role serves the
+  fleet worst-case aggregate from PoolManager sweeps; empty elsewhere
+  (and with ``rollout.kv_ledger=false``).
 
-Every v5 section is ALWAYS present on both planes (conformance-tested) so
+Every v6 section is ALWAYS present on both planes (conformance-tested) so
 consumers never need existence checks.
 
 ``GET /metrics`` on the same listener renders the snapshot's numeric
@@ -67,7 +75,7 @@ from typing import Callable
 
 log = logging.getLogger(__name__)
 
-SCHEMA = "polyrl/statusz/v5"
+SCHEMA = "polyrl/statusz/v6"
 _PROC_T0 = time.monotonic()
 _HIST_SUFFIXES = ("p50", "p95", "p99", "max", "mean", "count")
 
@@ -76,7 +84,7 @@ _HIST_SUFFIXES = ("p50", "p95", "p99", "max", "mean", "count")
 REQUIRED_SECTIONS = ("schema", "role", "pid", "time_unix_s", "uptime_s",
                      "step", "goodput", "histograms", "counters", "gauges",
                      "queues", "weights", "pool", "engine", "training",
-                     "timeseries", "autoscale")
+                     "timeseries", "autoscale", "memory")
 
 
 def build_snapshot(role: str, *, step: int | None = None,
@@ -90,7 +98,8 @@ def build_snapshot(role: str, *, step: int | None = None,
                    engine: dict | None = None,
                    training: dict | None = None,
                    timeseries: dict | None = None,
-                   autoscale: dict | None = None) -> dict:
+                   autoscale: dict | None = None,
+                   memory: dict | None = None) -> dict:
     """The shared statusz schema; every section present (empty when the
     plane has nothing for it) so consumers never need existence checks."""
     return {
@@ -111,6 +120,7 @@ def build_snapshot(role: str, *, step: int | None = None,
         "training": training or {},
         "timeseries": timeseries or {},
         "autoscale": autoscale or {},
+        "memory": memory or {},
     }
 
 

@@ -59,6 +59,7 @@ from polyrl_tpu import obs
 from polyrl_tpu.models import decoder
 from polyrl_tpu.rollout.engine import next_bucket
 from polyrl_tpu.rollout.flightdeck import EngineFlightDeck, ThroughputEWMA
+from polyrl_tpu.rollout.kvledger import PageLedger
 from polyrl_tpu.rollout.prefix_cache import PrefixCache
 from polyrl_tpu.rollout.sampling import (
     SamplingParams,
@@ -210,6 +211,8 @@ class CBEngine:
         group_share: bool = True,
         decode_group_share: bool = True,
         group_preref_ttl_s: float | None = None,
+        kv_ledger: bool = True,
+        kv_cold_after_dispatches: int = 256,
     ):
         if any(b % page_size for b in prompt_buckets):
             raise ValueError("prompt buckets must be page-aligned")
@@ -266,7 +269,20 @@ class CBEngine:
         self._slot_gen = np.zeros((s,), np.int64)
 
         self.allocator = PageAllocator(self.num_pages)
-        self.prefix_cache = (PrefixCache(page_size, self.allocator.free)
+        # KV memory plane (rollout/kvledger.py): per-page owner/role/age
+        # ledger + hot/warm/cold residency tiers, fed synchronously at
+        # every page transition below. None (rollout.kv_ledger=false)
+        # disables all accounting — the engine's output is bitwise
+        # identical either way (the ledger never touches RNG, device state
+        # or scheduling).
+        self.kvledger = (PageLedger(
+            self.num_pages, page_size,
+            cold_after_dispatches=kv_cold_after_dispatches)
+            if kv_ledger else None)
+        self._weight_bytes: int | None = None  # cached tree-leaves total
+        # the cache frees through _free_cache_pages so the ledger sees the
+        # cause the cache booked (capacity / flush / preref_ttl)
+        self.prefix_cache = (PrefixCache(page_size, self._free_cache_pages)
                              if enable_prefix_cache else None)
         self._pools = self._make_pools()
         self._rng = jax.random.PRNGKey(seed)
@@ -447,6 +463,62 @@ class CBEngine:
     def trace_report(self) -> dict:
         """Cumulative seconds per phase (POLYRL_CB_TRACE=1), else empty."""
         return dict(self._trace or {})
+
+    # -- KV memory plane (rollout/kvledger.py) -------------------------------
+
+    # cache-side free causes → ledger taxonomy
+    _CACHE_CAUSE = {"capacity": "cache_pressure", "flush": "flush",
+                    "preref_ttl": "preref_ttl"}
+
+    def _free_cache_pages(self, pages: list[int]) -> None:
+        """The prefix cache's free callback: return the pages to the
+        allocator, then attribute them in the ledger with the cause the
+        cache booked just before calling (PrefixCache._free)."""
+        self.allocator.free(pages)
+        if self.kvledger is not None:
+            cause = getattr(self.prefix_cache, "last_free_cause", "capacity")
+            self.kvledger.on_free(pages,
+                                  self._CACHE_CAUSE.get(cause,
+                                                        "cache_pressure"))
+
+    def _accounted_bytes(self) -> float:
+        """Bytes the ledger can attribute on device: KV pools + weights
+        (weights cached — the tree never changes size across swaps)."""
+        if self._weight_bytes is None:
+            self._weight_bytes = sum(
+                int(x.nbytes) for x in jax.tree_util.tree_leaves(self.params)
+                if hasattr(x, "nbytes"))
+        pool_b = 0
+        pools = self._pools
+        if pools is not None:
+            pool_b = sum(int(x.nbytes)
+                         for x in jax.tree_util.tree_leaves(pools)
+                         if hasattr(x, "nbytes"))
+        if self.kvledger is not None and pool_b:
+            self.kvledger.page_bytes = pool_b // max(1, self.num_pages)
+        return float(self._weight_bytes + pool_b)
+
+    def _cache_pages(self) -> int:
+        return (self.prefix_cache.num_entries
+                if self.prefix_cache is not None else 0)
+
+    def kv_memory_info(self) -> dict:
+        """Flat server_info fields for the memory plane ({} when the
+        ledger is off). Safe from HTTP handler threads: the ledger locks
+        internally and the pool reads are atomic snapshots."""
+        if self.kvledger is None:
+            return {}
+        return self.kvledger.server_info_fields(
+            self.allocator.free_count, self._cache_pages(),
+            self._accounted_bytes())
+
+    def kv_memory_snapshot(self) -> dict:
+        """The /statusz ``memory`` section ({} when the ledger is off)."""
+        if self.kvledger is None:
+            return {}
+        return self.kvledger.snapshot(
+            self.allocator.free_count, self._cache_pages(),
+            self._accounted_bytes())
 
     def _shard_params_for_mesh(self, params):
         from polyrl_tpu.models.quant import (
@@ -909,7 +981,7 @@ class CBEngine:
         if req.abort is not None and req.abort.is_set():
             self._chunk_jobs.popleft()
             self._emit_abort(req)
-            self._finalize(job["slot"])
+            self._finalize(job["slot"], cause="abort")
             return
         if self.weight_version != job["version"]:
             # a weight swap landed mid-job: the filled chunks' KV belongs
@@ -918,7 +990,7 @@ class CBEngine:
             # the manager's continuation layer re-dispatches.
             self._chunk_jobs.popleft()
             self._emit_abort(req)
-            self._finalize(job["slot"])
+            self._finalize(job["slot"], cause="abort")
             return
         n_prompt = len(req.input_ids)
         remaining = n_prompt - job["pos"]
@@ -937,6 +1009,8 @@ class CBEngine:
                 # mirror _admit's failure contract: the job left the deque
                 # and the slot placeholder, so no other path can clean it
                 self.allocator.free(job["pages"])
+                if self.kvledger is not None:
+                    self.kvledger.on_free(job["pages"], "abort")
                 if self.prefix_cache is not None:
                     self.prefix_cache.release(job["matched_entries"])
                 self._emit_error(req, "prefill failed")
@@ -1232,7 +1306,7 @@ class CBEngine:
         while self._chunk_jobs:
             job = self._chunk_jobs.popleft()
             self._emit_error(job["req"], "engine shutdown")
-            self._finalize(job["slot"])
+            self._finalize(job["slot"], cause="abort")
         self._drain_queue()
         while self._pending:
             self._emit_error(self._pending.popleft(), "engine shutdown")
@@ -1358,7 +1432,7 @@ class CBEngine:
         while self._chunk_jobs:
             job = self._chunk_jobs.popleft()
             self._emit_abort(job["req"])
-            self._finalize(job["slot"])
+            self._finalize(job["slot"], cause="abort")
 
     def _recover(self) -> None:
         """After any jit failure the pools may have been donated to the dead
@@ -1417,6 +1491,8 @@ class CBEngine:
             except Exception:
                 for req, _slot, pages, _b, _mp, me in wave:
                     self.allocator.free(pages)
+                    if self.kvledger is not None:
+                        self.kvledger.on_free(pages, "abort")
                     if self.prefix_cache is not None:
                         self.prefix_cache.release(me)
                     self._emit_error(req, "prefill failed")
@@ -1528,6 +1604,11 @@ class CBEngine:
             del self._pending[scan]
             slot = free[0]
             assigned.add(slot)
+            if self.kvledger is not None:
+                # the single alloc site (every _try_alloc caller lands
+                # here): pages become slot-owned active-decode
+                self.kvledger.on_alloc(pages,
+                                       owner=req.group_id or req.rid)
             if self.prefix_cache is not None:
                 self.prefix_cache.note_request(bool(matched_pages))
             if chunked:
@@ -1636,6 +1717,8 @@ class CBEngine:
                 pub_pages = {e.page for _, e in published}
                 private = [p for p in pages if p not in pub_pages]
                 entries = [e for _, e in published]
+                if self.kvledger is not None:
+                    self.kvledger.on_publish(pub_pages)
             sp = req.sampling
             n_prompt = len(req.input_ids)
             self._page_table[slot] = row
@@ -1768,6 +1851,8 @@ class CBEngine:
             "entries": list(entries), "remaining": n,
             "t": time.monotonic(),
         }
+        if self.kvledger is not None:
+            self.kvledger.on_preref_hold([e.page for e in entries])
 
     def _consume_group_preref(self, req: _Request) -> None:
         """One group member accounted for (admitted, aborted, or errored
@@ -1782,6 +1867,9 @@ class CBEngine:
         g["remaining"] -= 1
         if g["remaining"] <= 0:
             del self._group_prerefs[req.group_id]
+            if self.kvledger is not None:
+                self.kvledger.on_preref_release(
+                    [e.page for e in g["entries"]])
 
     def _sweep_group_prerefs(self) -> None:
         """Expire pre-refs for groups whose siblings never arrived (dropped
@@ -1795,7 +1883,14 @@ class CBEngine:
             g = self._group_prerefs.pop(gid)
             if self.prefix_cache is not None:
                 for _ in range(max(0, g["remaining"])):
-                    self.prefix_cache.release(g["entries"])
+                    # TTL expiry: orphan frees under this release book as
+                    # preref_ttl (the page died because the group's
+                    # siblings never came for it)
+                    self.prefix_cache.release(g["entries"],
+                                              cause="preref_ttl")
+            if self.kvledger is not None:
+                self.kvledger.on_preref_release(
+                    [e.page for e in g["entries"]])
 
     def _disband_group_prerefs(self) -> None:
         """Release every outstanding pre-ref NOW — called before any cache
@@ -1806,6 +1901,9 @@ class CBEngine:
             if self.prefix_cache is not None:
                 for _ in range(max(0, g["remaining"])):
                     self.prefix_cache.release(g["entries"])
+            if self.kvledger is not None:
+                self.kvledger.on_preref_release(
+                    [e.page for e in g["entries"]])
         self._group_prerefs.clear()
 
     # -- shared-prefix decode groups -----------------------------------------
@@ -1970,6 +2068,8 @@ class CBEngine:
             pub_pages = {e.page for _, e in published}
             private = [p for p in pages if p not in pub_pages]
             matched_entries += [e for _, e in published]
+            if self.kvledger is not None:
+                self.kvledger.on_publish(pub_pages)
         self._consume_group_preref(req)
         self._register_group_prerefs(req, matched_entries)
         # singleton admission (leader, full/partial hit, chunk final): the
@@ -2277,9 +2377,16 @@ class CBEngine:
         self.deck.on_first_token(slot)
         self._count_tokens(1)
         if fin:
-            info.req.out.put(STREAM_END)
+            # finalize BEFORE the terminal marker: a client that saw
+            # STREAM_END may read the flight deck immediately, so both
+            # deck sides must already be folded (quiescence invariant).
+            # finally: the terminal must reach the client even if finalize
+            # raises (a deactivated slot is invisible to _recover's sweep)
             self._active[slot] = False
-            self._finalize(slot)
+            try:
+                self._finalize(slot)
+            finally:
+                info.req.out.put(STREAM_END)
             if not device_done:
                 # stop token beyond the device table: device active is stale
                 self._invalidate_dev_state()
@@ -2299,6 +2406,7 @@ class CBEngine:
         if emitted is not None:
             emitted = np.atleast_2d(np.asarray(emitted))
         n_emitted = 0
+        finished: list[int] = []
         host_stop_fix = False
         for r in range(token.shape[0]):
             for i, gen in idxs:
@@ -2327,9 +2435,10 @@ class CBEngine:
                 if self._hist is not None:
                     self._hist[i].append(t)
                 if fin:
-                    info.req.out.put(STREAM_END)
+                    # deactivate now (later rows of this dispatch must skip
+                    # the finished slot) but defer finalize + STREAM_END
                     self._active[i] = False
-                    self._finalize(i)
+                    finished.append(i)
                     if not bool(done[r, i]):
                         # device missed this stop (beyond its table): its
                         # active mask is stale — force a state re-upload. Any
@@ -2343,6 +2452,19 @@ class CBEngine:
         if emitted is not None:
             self.spec_emitted += n_emitted
         self._count_tokens(n_emitted)
+        # terminal markers LAST: a client that saw STREAM_END may read the
+        # flight deck immediately (quiescence reconciliation), so both the
+        # scheduler-side total above and the per-request fold in _finalize
+        # must land before the stream visibly ends
+        for i in finished:
+            info = self._slots[i]
+            # finally: the terminal must reach the client even if finalize
+            # raises — these slots are already inactive, so _recover's
+            # _fail_all sweep would never release them
+            try:
+                self._finalize(i)
+            finally:
+                info.req.out.put(STREAM_END)
         self.num_running = int(self._active.sum())
 
     def _step_once(self) -> None:
@@ -2437,7 +2559,7 @@ class CBEngine:
                 self._drain_emit_q()
             finally:
                 for i in aborted:
-                    self._finalize(i)
+                    self._finalize(i, cause="abort")
                 self._invalidate_dev_state()
 
     def _abort_with_salvage(self) -> None:
@@ -2467,10 +2589,19 @@ class CBEngine:
                 self.tokens_salvaged += len(info.emitted) - before[i]
                 self._active[i] = False
                 self._slot_gen[i] += 1
-                self._emit_abort(info.req, emit_line=True)
-                self._salvage_publish(i, info)
-                self.deck.on_salvage(i)
-                self._finalize(i)
+                # terminal AFTER the fold: the drain above already released
+                # every salvaged token, so this costs no client latency —
+                # and a client that saw the abort terminal reads a deck
+                # whose request side includes this slot (quiescence).
+                # finally: the terminal must still reach the client if any
+                # of the salvage bookkeeping raises (slot already inactive,
+                # so _recover's _fail_all sweep would never release it)
+                try:
+                    self._salvage_publish(i, info)
+                    self.deck.on_salvage(i)
+                    self._finalize(i, cause="salvage")
+                finally:
+                    self._emit_abort(info.req, emit_line=True)
             self._invalidate_dev_state()
 
     def _salvage_publish(self, slot: int, info: _SlotInfo) -> None:
@@ -2499,6 +2630,8 @@ class CBEngine:
         pub_pages = {e.page for _, e in published}
         info.pages = [p for p in info.pages if p not in pub_pages]
         self.salvage_published_pages += len(pub_pages)
+        if self.kvledger is not None:
+            self.kvledger.on_publish(pub_pages)
         # drop the refs this publish round took (match + publish): the
         # entries stay resident, unreferenced, LRU-evictable — exactly the
         # state admission-published pages reach after their slot finalizes
@@ -2554,6 +2687,14 @@ class CBEngine:
             self.prefix_cache.num_entries
             if self.prefix_cache is not None else 0,
             self._outstanding(), len(self._pending))
+        if self.kvledger is not None:
+            # touch every active slot's page row (the pages this dispatch's
+            # attention logically reads — cache-matched prefix included)
+            # and re-sweep the hot/warm/cold residency tiers. Page-0
+            # padding in the rows is filtered; the reserved role would
+            # keep it out of the tier counts anyway.
+            rows = self._page_table[self._active].ravel()
+            self.kvledger.on_dispatch(rows[rows != 0])
 
     @property
     def spec_accept_rate(self) -> float:
@@ -2566,7 +2707,7 @@ class CBEngine:
             return 0.0
         return self.spec_emitted / self.spec_token_ceiling
 
-    def _finalize(self, slot: int) -> None:
+    def _finalize(self, slot: int, cause: str = "finalize") -> None:
         self.deck.on_finalize(slot)
         # leave the decode group FIRST: the next dispatch must not seat a
         # finalized slot (its freed pages may be reallocated; in-flight
@@ -2576,6 +2717,10 @@ class CBEngine:
         info = self._slots[slot]
         if info is not None:
             self.allocator.free(info.pages)
+            if self.kvledger is not None:
+                # cause: "finalize" for natural completion, "abort"/
+                # "salvage" when the abort paths finalize the slot
+                self.kvledger.on_free(info.pages, cause)
             if self.prefix_cache is not None and info.cache_entries:
                 self.prefix_cache.release(info.cache_entries)
             # per-request serving telemetry: submit→finalize wall and the
@@ -2619,7 +2764,7 @@ class CBEngine:
                     self._emit_abort(info.req)
                 else:
                     self._emit_error(info.req, msg)
-            self._finalize(i)
+            self._finalize(i, cause="abort")
 
     def _count_tokens(self, n: int) -> None:
         self.total_tokens_served += n

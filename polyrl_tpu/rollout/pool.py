@@ -330,7 +330,7 @@ class PoolManager:
         if not rep:
             return {}
         occ = [float(i.get("occupancy", 0.0)) for i in rep]
-        return {
+        out = {
             "engine/occupancy": sum(occ) / len(occ),
             "engine/occupancy_min": min(occ),
             "engine/page_util": max(float(i.get("page_util", 0.0))
@@ -365,6 +365,20 @@ class PoolManager:
                 sum(float(i.get("shared_prefix_read_frac", 0.0))
                     for i in rep) / len(rep)),
         }
+        # KV memory plane (rollout/kvledger.py) — worst-case semantics:
+        # the coldest engine is the one the spill/autoscale tiers act on,
+        # the tightest HBM headroom is the one that OOMs first. Per-field
+        # presence guard: engines with the ledger off (or predating it)
+        # are skipped, not counted as 0 cold / 0 headroom.
+        cold = [float(i["kv_cold_page_frac"]) for i in rep
+                if "kv_cold_page_frac" in i]
+        if cold:
+            out["engine/kv_cold_page_frac"] = max(cold)
+        heads = [float(i["hbm_headroom_gb"]) for i in rep
+                 if "hbm_headroom_gb" in i]
+        if heads:
+            out["engine/hbm_headroom_gb"] = min(heads)
+        return out
 
     def engine_section(self) -> dict:
         """The trainer-side /statusz ``engine`` block: the fleet aggregate
@@ -392,8 +406,40 @@ class PoolManager:
                 "shared_prefix_read_frac": float(
                     i.get("shared_prefix_read_frac", 0.0)),
                 "throughput_tok_s": float(i.get("last_gen_throughput", 0.0)),
+                "kv_cold_page_frac": float(i.get("kv_cold_page_frac", 0.0)),
                 "running": int(i.get("num_running_reqs", 0)),
             } for i in insts if "occupancy" in i],
+        }
+
+    def memory_section(self) -> dict:
+        """The trainer-side /statusz ``memory`` block (and the
+        FlightRecorder's ``memory_fn`` view): fleet worst-case KV
+        residency + HBM headroom plus the per-engine rows, served from
+        the cached sweep. Empty when no engine reports the ledger fields
+        (ledger off fleet-wide, or engines predating it)."""
+        with self._lock:
+            insts = list(dict(self._last_status).get("instances", []))
+        rep = [i for i in insts
+               if i.get("healthy") and "kv_cold_page_frac" in i]
+        if not rep:
+            return {}
+        fleet: dict = {
+            "engines_reporting": len(rep),
+            "kv_cold_page_frac_max": max(
+                float(i["kv_cold_page_frac"]) for i in rep),
+        }
+        heads = [float(i["hbm_headroom_gb"]) for i in rep
+                 if "hbm_headroom_gb" in i]
+        if heads:
+            fleet["hbm_headroom_gb_min"] = min(heads)
+        return {
+            "fleet": fleet,
+            "engines": [{
+                "endpoint": i.get("endpoint", ""),
+                "kv_cold_page_frac": float(i["kv_cold_page_frac"]),
+                **({"hbm_headroom_gb": float(i["hbm_headroom_gb"])}
+                   if "hbm_headroom_gb" in i else {}),
+            } for i in rep],
         }
 
     def statusz_section(self) -> dict:

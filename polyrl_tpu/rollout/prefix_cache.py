@@ -41,6 +41,17 @@ class PrefixCache:
         self._tick = 0
         self.hits = 0       # pages served from cache
         self.misses = 0     # full pages prefilled fresh
+        # eviction cause split (ARCHITECTURE.md "KV memory plane"): the
+        # spill tier needs to know WHICH kind of page it is stealing from —
+        # capacity = pool-pressure LRU (+ stale-squatter replacement),
+        # flush = weight swap / memory release invalidation (immediate
+        # frees AND deferred orphan frees), preref_ttl = orphan frees
+        # during a group pre-ref TTL sweep (``release(cause=...)``).
+        self.evictions = {"capacity": 0, "flush": 0, "preref_ttl": 0}
+        # cause of the most recent _free_pages call: the engine's ledger
+        # wrapper reads it to attribute cache-side frees (set BEFORE the
+        # callback runs)
+        self.last_free_cause = "capacity"
         # request-level counters: the page-granular hits/misses above are
         # length-skewed (one 4k-prompt hit counts 64× a 128-token hit), so
         # the reported hit RATE said nothing about how many requests
@@ -48,6 +59,14 @@ class PrefixCache:
         # admitted request (any matched page = hit).
         self.req_hits = 0
         self.req_misses = 0
+
+    def _free(self, pages: list[int], cause: str) -> None:
+        """Single free choke point: book the cause, then hand the pages
+        back through the engine's callback (which may feed the page
+        ledger off ``last_free_cause``)."""
+        self.evictions[cause] = self.evictions.get(cause, 0) + len(pages)
+        self.last_free_cause = cause
+        self._free_pages(pages)
 
     # -- keys ---------------------------------------------------------------
 
@@ -129,7 +148,7 @@ class PrefixCache:
                     # or a colliding entry): replace it so this prefix stays
                     # cacheable instead of permanently re-prefilling
                     del self._map[key]
-                    self._free_pages([existing.page])
+                    self._free([existing.page], "capacity")
                     e = _Entry(key=key, page=page_ids[i], refcount=1,
                                tick=self._tick, page_toks=page_toks,
                                parent=prev)
@@ -167,14 +186,18 @@ class PrefixCache:
         for e in entries:
             e.refcount += n
 
-    def release(self, entries: list[_Entry]) -> None:
+    def release(self, entries: list[_Entry], cause: str = "flush") -> None:
+        """Drop one ref per entry; orphaned entries (flushed while
+        referenced) free their page at refcount 0. Orphans only exist
+        post-flush, so their frees default to the ``flush`` cause; the
+        engine's pre-ref TTL sweep overrides with ``preref_ttl``."""
         freed: list[int] = []
         for e in entries:
             e.refcount -= 1
             if e.refcount == 0 and e.orphaned:
                 freed.append(e.page)
         if freed:
-            self._free_pages(freed)
+            self._free(freed, cause)
 
     # -- eviction / flush ----------------------------------------------------
 
@@ -188,7 +211,7 @@ class PrefixCache:
             return 0
         for e in victims:
             del self._map[e.key]
-        self._free_pages([e.page for e in victims])
+        self._free([e.page for e in victims], "capacity")
         return len(victims)
 
     def flush(self) -> None:
@@ -203,7 +226,7 @@ class PrefixCache:
                 e.orphaned = True
         self._map.clear()
         if freed:
-            self._free_pages(freed)
+            self._free(freed, "flush")
 
     @property
     def num_entries(self) -> int:
@@ -222,4 +245,11 @@ class PrefixCache:
                 "prefix_cache/hit_rate": self.hits / total if total else 0.0,
                 "prefix_cache/req_hits": float(self.req_hits),
                 "prefix_cache/req_misses": float(self.req_misses),
-                "prefix_cache/req_hit_frac": self.request_hit_frac}
+                "prefix_cache/req_hit_frac": self.request_hit_frac,
+                # eviction cause split — one undifferentiated total told
+                # the spill tier nothing about what it would be stealing
+                "prefix_cache/evict_capacity": float(
+                    self.evictions["capacity"]),
+                "prefix_cache/evict_flush": float(self.evictions["flush"]),
+                "prefix_cache/evict_preref_ttl": float(
+                    self.evictions["preref_ttl"])}

@@ -52,6 +52,8 @@ def create_server(model: str, manager_endpoint: str | None = None,
                   group_share: bool = True,
                   decode_group_share: bool = True,
                   group_preref_ttl_s: float | None = None,
+                  kv_ledger: bool = True,
+                  kv_cold_after_dispatches: int = 256,
                   fault_injector=None):
     """Build engine + server, register with the manager, attach receiver.
 
@@ -160,7 +162,9 @@ def create_server(model: str, manager_endpoint: str | None = None,
             salvage_partials=salvage_partials, admit_wave=admit_wave,
             admit_reorder_window=admit_reorder_window,
             group_share=group_share, decode_group_share=decode_group_share,
-            group_preref_ttl_s=group_preref_ttl_s)
+            group_preref_ttl_s=group_preref_ttl_s,
+            kv_ledger=kv_ledger,
+            kv_cold_after_dispatches=kv_cold_after_dispatches)
     else:
         kwargs = {}
         if batch_buckets:
@@ -295,6 +299,13 @@ def main() -> None:
     p.add_argument("--group-preref-ttl-s", type=float, default=None,
                    help="sibling-wait pre-ref expiry for groups whose "
                         "members never arrive (default 30)")
+    p.add_argument("--no-kv-ledger", action="store_true",
+                   help="disable the per-page KV memory ledger (the "
+                        "memory statusz section / kv_*_page_frac gauges "
+                        "go empty; engine output is identical either way)")
+    p.add_argument("--kv-cold-after-dispatches", type=int, default=256,
+                   help="idle age (decode dispatches) past which a "
+                        "resident KV page counts as cold")
     p.add_argument("--lora-rank", type=int, default=0,
                    help="LoRA delta sync: serve base + adapters; pushes "
                         "carry only adapters (match the trainer's rank)")
@@ -328,6 +339,9 @@ def main() -> None:
                            group_share=not args.no_group_share,
                            decode_group_share=not args.no_decode_group_share,
                            group_preref_ttl_s=args.group_preref_ttl_s,
+                           kv_ledger=not args.no_kv_ledger,
+                           kv_cold_after_dispatches=(
+                               args.kv_cold_after_dispatches),
                            lora_rank=args.lora_rank,
                            lora_alpha=args.lora_alpha)
     log.info("rollout server on %s", server.endpoint)

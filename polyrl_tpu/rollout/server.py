@@ -606,6 +606,13 @@ class RolloutServer:
             # TTFT+TPOT tails / token-accounting reconciliation — flat keys
             # the manager's stats poller forwards and bench reads
             info.update(deck.server_info_fields())
+        kv_info = getattr(self.engine, "kv_memory_info", None)
+        if kv_info is not None:
+            # KV memory plane (rollout/kvledger.py): residency tiers, the
+            # ledger↔pool reconciliation gauge, HBM truth — flat keys so
+            # the manager's stats poller forwards kv_cold_page_frac /
+            # hbm_headroom_gb per instance ({} when rollout.kv_ledger=false)
+            info.update(kv_info())
         if self.receiver is not None:
             # weight-sync health (transfer/agents.py ReceiverAgent.health):
             # control-channel reconnects, rejected CRC frames, verify
@@ -689,6 +696,7 @@ class RolloutServer:
                     "shared_prefix_read_frac": float(
                         info.get("shared_prefix_read_frac", 0.0)),
                 }
+        kv_snap = getattr(self.engine, "kv_memory_snapshot", None)
         return statusz.build_snapshot(
             "rollout",
             counters=counters, gauges=gauges,
@@ -696,7 +704,10 @@ class RolloutServer:
                     "queued": float(info.get("num_queued_reqs", 0))},
             weights={"version": float(self.engine.weight_version)},
             engine=engine_section,
-            timeseries=self._timeseries.section())
+            timeseries=self._timeseries.section(),
+            # KV memory plane (v6): per-page roles/tiers/churn + the
+            # reconciliation block ({} for non-cb engines / ledger off)
+            memory=kv_snap() if kv_snap is not None else None)
 
     def metrics_text(self) -> str:
         """Prometheus text format for /metrics: server_info fields as

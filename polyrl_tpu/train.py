@@ -120,7 +120,10 @@ def _build_rollout(cfg: RunConfig, mcfg, params, tokenizer, cleanup: list):
                 admit_reorder_window=cfg.rollout.admit_reorder_window,
                 group_share=cfg.rollout.group_share,
                 decode_group_share=cfg.rollout.decode_group_share,
-                group_preref_ttl_s=cfg.rollout.group_preref_ttl_s, **kwargs)
+                group_preref_ttl_s=cfg.rollout.group_preref_ttl_s,
+                kv_ledger=cfg.rollout.kv_ledger,
+                kv_cold_after_dispatches=(
+                    cfg.rollout.kv_cold_after_dispatches), **kwargs)
         from polyrl_tpu.rollout.engine import RolloutEngine
 
         kwargs = {}
@@ -216,6 +219,8 @@ def _build_rollout(cfg: RunConfig, mcfg, params, tokenizer, cleanup: list):
             group_share=cfg.rollout.group_share,
             decode_group_share=cfg.rollout.decode_group_share,
             group_preref_ttl_s=cfg.rollout.group_preref_ttl_s,
+            kv_ledger=cfg.rollout.kv_ledger,
+            kv_cold_after_dispatches=cfg.rollout.kv_cold_after_dispatches,
             **({"prompt_buckets": tuple(cfg.rollout.prompt_buckets)}
                if cfg.rollout.prompt_buckets else {}))
         local_server = RolloutServer(eng, host="127.0.0.1", port=0)

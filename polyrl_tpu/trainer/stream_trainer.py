@@ -310,6 +310,11 @@ class StreamRLTrainer:
             recorder.engine_fn = (
                 lambda: rollout.pool.engine_section()
                 if rollout.pool is not None else {})
+            # cold-frac / HBM-headroom anomaly bundles carry the fleet KV
+            # memory plane (per-engine residency + headroom) as memory.json
+            recorder.memory_fn = (
+                lambda: rollout.pool.memory_section()
+                if rollout.pool is not None else {})
 
     # -- profiling (reference _start/_stop_profiling with continuous-step
     # logic, stream_ray_trainer.py:356-361,629-641) ----------------------
@@ -1191,7 +1196,10 @@ class StreamRLTrainer:
             # closed-loop autoscaling plane: last decision + totals
             # (rollout/autoscale.py; empty when no controller attached)
             autoscale=(self._autoscale.statusz_section()
-                       if self._autoscale is not None else None))
+                       if self._autoscale is not None else None),
+            # KV memory plane: fleet worst-case residency + headroom from
+            # the pool sweep (the rollout plane serves its own ledger)
+            memory=pool.memory_section() if pool is not None else None)
 
     def _critical_path_view(self) -> dict:
         """Recorder hook: the last N per-step critical paths, dumped into

@@ -238,14 +238,14 @@ def test_statusz_v4_conformance_both_planes(tiny):
     the rollout plane's ``engine`` section carries the live ledger."""
     from polyrl_tpu.rollout.server import RolloutServer
 
-    assert statusz.SCHEMA == "polyrl/statusz/v5"
+    assert statusz.SCHEMA == "polyrl/statusz/v6"
     # trainer plane: the standalone exporter over build_snapshot (the only
     # snapshot constructor the trainer uses)
     srv = statusz.StatuszServer(lambda: statusz.build_snapshot(
         "trainer", step=3), host="127.0.0.1").start()
     try:
         snap = _get_json(f"http://{srv.endpoint}/statusz")
-        assert snap["schema"] == "polyrl/statusz/v5"
+        assert snap["schema"] == "polyrl/statusz/v6"
         for section in statusz.REQUIRED_SECTIONS:
             assert section in snap, f"trainer plane missing {section}"
     finally:
@@ -260,7 +260,7 @@ def test_statusz_v4_conformance_both_planes(tiny):
         engine.generate([[5, 3, 9]], SamplingParams(temperature=0.0,
                                                     max_new_tokens=4))
         snap = _get_json(f"http://127.0.0.1:{server.port}/statusz")
-        assert snap["schema"] == "polyrl/statusz/v5"
+        assert snap["schema"] == "polyrl/statusz/v6"
         for section in statusz.REQUIRED_SECTIONS:
             assert section in snap, f"rollout plane missing {section}"
         eng = snap["engine"]
@@ -350,6 +350,7 @@ def test_manager_forwards_flight_deck_telemetry():
         "occupancy": 0.75, "page_util": 0.25, "ttft_p95_s": 0.33,
         "tpot_p95_s": 0.02, "prefix_cache/hit_rate": 0.6,
         "spec_accept_rate": 0.4, "attributed_frac": 0.99,
+        "kv_cold_page_frac": 0.125, "hbm_headroom_gb": 3.5,
     }
     try:
         client.wait_healthy()
@@ -373,15 +374,23 @@ def test_manager_forwards_flight_deck_telemetry():
         assert inst["cache_hit_rate"] == 0.6
         assert inst["spec_accept_rate"] == 0.4
         assert inst["attributed_frac"] == 0.99
+        # KV memory plane: cold frac always forwarded; the HBM headroom
+        # only once the engine reported it (−1 sentinel stays hidden)
+        assert inst["kv_cold_page_frac"] == 0.125
+        assert inst["hbm_headroom_gb"] == 3.5
         # PoolManager aggregates the forwarded view into engine/* gauges
         pool = PoolManager(client, PoolConfig())
         c = pool.counters()
         assert c["engine/occupancy"] == pytest.approx(0.75)
         assert c["engine/page_util"] == pytest.approx(0.25)
+        assert c["engine/kv_cold_page_frac"] == pytest.approx(0.125)
+        assert c["engine/hbm_headroom_gb"] == pytest.approx(3.5)
         # and the manager's own Prometheus surface carries the fleet view
         text = client.metrics_text()
         assert "polyrl_mgr_fleet_occupancy 0.75" in text
         assert "polyrl_mgr_instance_page_util" in text
+        assert "polyrl_mgr_instance_kv_cold_page_frac" in text
+        assert "polyrl_mgr_instance_hbm_headroom_gb" in text
     finally:
         eng.stop()
         proc.kill()

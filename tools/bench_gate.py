@@ -149,6 +149,13 @@ WATCHED_EXTRA = (
     # ROADMAP bench debt names, and its per-token staleness spread
     ("rollout_decode_tok_s_per_chip", "low"),
     ("rl_staleness_p95", "high"),
+    # KV memory plane (rollout/kvledger.py, promoted from the cb phase):
+    # the resident set going cold between rounds means the cache is
+    # accumulating pages nobody reads (a leak or an eviction regression);
+    # the device HBM headroom dropping means something else grew into
+    # the page pool's margin
+    ("engine_kv_cold_page_frac", "high"),
+    ("engine_hbm_headroom_gb", "low"),
 )
 
 

@@ -68,7 +68,15 @@ round), ``transfer/stream_bw_mbps_min`` (slowest stream's wire
 bandwidth — the round's critical stream), ``transfer/reshard_bytes``
 (cumulative bytes routed shard→shard by the resharding map) and
 ``transfer/stream_resumes`` (per-stream transport-failure re-pushes,
-distinct from whole-round ``transfer/push_retries``). New metric
+distinct from whole-round ``transfer/push_retries``). The KV memory
+plane (rollout/kvledger.py) emits ``memory/*`` — the ledger↔pool
+reconciliation ratio ``memory/attributed_frac``, churn counters
+(``memory/page_allocs``, ``memory/page_frees``, ``memory/page_publishes``)
+and the per-cause free split ``memory/freed_<cause>`` — alongside the
+``engine/kv_{hot,warm,cold}_page_frac`` residency tiers and
+``engine/hbm_{used,headroom,unaccounted}_gb`` HBM-truth gauges, all
+riding ``server_info`` and aggregated fleet-wide in rollout/pool.py
+(worst-case: max cold fraction, min headroom). New metric
 emitters in
 ``polyrl_tpu/`` are linted automatically; nothing needs registering —
 EXCEPT a new top-level namespace, which must be added to ``NAMESPACES``
@@ -129,6 +137,11 @@ NAMESPACES = frozenset({
                      # (action/reason/suppressions), action totals, the
                      # degradation tier, and the admission-gate wait
                      # (rollout/autoscale.py)
+    "memory",        # KV memory plane: ledger reconciliation
+                     # (memory/attributed_frac), page churn + free-cause
+                     # counters riding server_info next to the
+                     # engine/kv_{hot,warm,cold}_page_frac residency tiers
+                     # and HBM truth gauges (rollout/kvledger.py)
 })
 
 # APIs whose first positional string argument IS a metric key
