@@ -1889,6 +1889,127 @@ def group_share_bench(preset: str = "tiny", g: int = 8, groups: int = 4,
     }
 
 
+def kv_spill_bench(preset: str = "tiny", sessions: int = 12,
+                   prompt_len: int = 64, new_tokens: int = 16,
+                   page_size: int = 16, max_slots: int = 4) -> dict:
+    """Host-RAM KV spill oversubscription A/B (``python bench.py
+    --kv-spill``): a session-resume workload (``sessions`` prompts
+    established then resumed — the multi-turn shape where each session's
+    published prefix KV must SURVIVE between turns) through two engines
+    at the SAME HBM-capped page budget (sized to hold the active decode
+    set plus only a couple of idle sessions): spill ON pages cold
+    published KV out to pinned host RAM and restores it on the resume
+    hit, spill OFF (the PR 17 engine) capacity-evicts it — destroyed KV
+    means the resume re-prefills from scratch. A session counts as
+    surviving when its resume prefill is served from cached pages. The
+    headline is the survival multiplier; a big-pool never-spilled
+    reference engine pins the resumed greedy outputs bitwise (restore at
+    a new physical index must be invisible to decode). Extras carry the
+    abort count (must be 0 — oversubscription is not allowed to shed
+    load), the ledger's quiescent ``attributed_frac`` with the spilled
+    tier counted, and the restore-rate thrash signal bench_gate watches.
+    CPU-sized by default; scale via env/flags on a real chip."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from polyrl_tpu.models import decoder
+    from polyrl_tpu.rollout.cb_engine import CBEngine
+    from polyrl_tpu.rollout.sampling import SamplingParams
+
+    jax.config.update("jax_default_matmul_precision", "highest")
+    cfg = decoder.get_config(preset, dtype=jnp.float32 if preset == "tiny"
+                             else jnp.bfloat16)
+    params = jax.jit(lambda: decoder.init_params(jax.random.PRNGKey(0),
+                                                 cfg))()
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(1, cfg.vocab_size, prompt_len).tolist()
+               for _ in range(sessions)]
+    sp = SamplingParams(temperature=0.0, max_new_tokens=new_tokens,
+                        stop_token_ids=())
+    pages_per = -(-(prompt_len + new_tokens) // page_size)
+    max_seq = pages_per * page_size
+    # the fixed page budget: the active decode set + ~2 idle sessions.
+    # Far less than ``sessions`` worth of KV — the oversubscription shape.
+    capped_pages = (max_slots + 2) * pages_per + 4
+    big_pages = sessions * pages_per * 2 + 8
+
+    def run(spill: bool, num_pages: int) -> dict:
+        eng = CBEngine(
+            cfg, params, pad_token_id=0, kv_cache_dtype=jnp.float32,
+            max_slots=max_slots, page_size=page_size, max_seq_len=max_seq,
+            prompt_buckets=(prompt_len,), num_pages=num_pages,
+            steps_per_dispatch=4, kv_ledger=True,
+            kv_cold_after_dispatches=4, kv_spill=spill,
+            kv_spill_host_gb=1.0)
+        aborted = 0
+        t0 = time.monotonic()
+        est = eng.generate(prompts, sp, timeout=600.0)
+        aborted += sum(1 for r in est
+                       if r["finish_reason"] in ("abort", "error"))
+        # resume one session at a time so the deck's cached-token delta
+        # attributes survival per session (a full-prefix hit means the
+        # session's KV was still addressable — resident or restored)
+        hot = 0
+        resumed = []
+        for p in prompts:
+            c0 = eng.deck.cached_prompt_tokens
+            r = eng.generate([p], sp, timeout=600.0)[0]
+            if r["finish_reason"] in ("abort", "error"):
+                aborted += 1
+            if eng.deck.cached_prompt_tokens - c0 >= prompt_len - page_size:
+                hot += 1
+            resumed.append(r)
+        wall = time.monotonic() - t0
+        time.sleep(0.3)  # let the loop settle before the quiescent read
+        info = eng.kv_memory_info()
+        res = {
+            "wall_s": round(wall, 3),
+            "sessions_hot": hot,
+            "aborted_requests": aborted,
+            "attributed_frac": float(info.get("memory/attributed_frac",
+                                              1.0)),
+            "kv_spilled_frac": float(info.get("kv_spilled_frac", 0.0)),
+            "restore_rate": float(info.get("kv_restore_rate", 0.0)),
+            "pages_spilled": int(info.get("memory/pages_spilled", 0)),
+            "pages_restored": int(info.get("memory/pages_restored", 0)),
+        }
+        if eng.kvspill is not None:
+            s = eng.kvspill.stats()
+            res["spill_host"] = {k: s[k] for k in
+                                 ("resident_pages", "bytes_spilled",
+                                  "bytes_restored", "copy_batches",
+                                  "sync_fetches")}
+        res["_resumed"] = resumed
+        eng.stop()
+        return res
+
+    spill_on = run(True, capped_pages)
+    baseline = run(False, capped_pages)
+    reference = run(False, big_pages)
+    bitwise = all(
+        a["token_ids"] == b["token_ids"]
+        for a, b in zip(spill_on.pop("_resumed"), reference["_resumed"]))
+    baseline.pop("_resumed")
+    reference.pop("_resumed")
+    return {
+        "sessions": sessions, "prompt_len": prompt_len,
+        "new_tokens": new_tokens, "page_size": page_size,
+        "capped_pages": capped_pages, "big_pages": big_pages,
+        "spill": spill_on, "baseline": baseline, "reference": reference,
+        # headline + gate fields: the survival multiplier at the fixed
+        # page budget, the thrash signal, and the correctness pins
+        "sessions_speedup": round(
+            spill_on["sessions_hot"] / max(baseline["sessions_hot"], 1), 2),
+        "restore_rate": spill_on["restore_rate"],
+        "aborted_requests": (spill_on["aborted_requests"]
+                             + baseline["aborted_requests"]
+                             + reference["aborted_requests"]),
+        "bitwise_identical": bool(bitwise),
+        "attributed_frac": spill_on["attributed_frac"],
+    }
+
+
 def decode_attn_bench(preset: str = "tiny", gs: tuple = (1, 8),
                       prefixes: tuple = (512, 2048), slots: int = 16,
                       suffix: int = 64, page_size: int = 64,
@@ -2562,6 +2683,22 @@ if __name__ == "__main__":
         print(json.dumps({"metric": "group_share_dispatch_reduction",
                           "value": res["dispatch_reduction"], "unit": "x",
                           "extra": {"group_share": res}}))
+    elif "--kv-spill" in sys.argv:
+        # host-RAM KV spill oversubscription A/B: session-resume workload
+        # at a fixed HBM-capped page budget, spill vs capacity-evict, with
+        # a big-pool reference pinning resumed greedy outputs bitwise —
+        # its own entry, CPU-sized by default
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        res = kv_spill_bench(
+            preset=os.environ.get("POLYRL_BENCH_PRESET", "tiny"),
+            sessions=int(_cli_float("--sessions", 12)),
+            prompt_len=int(_cli_float("--prompt-len", 64)),
+            new_tokens=int(_cli_float("--new-tokens", 16)),
+            page_size=int(_cli_float("--page-size", 16)),
+            max_slots=int(_cli_float("--slots", 4)))
+        print(json.dumps({"metric": "kv_spill_sessions_speedup",
+                          "value": res["sessions_speedup"], "unit": "x",
+                          "extra": {"kv_spill": res}}))
     elif "--decode-attn" in sys.argv:
         # shared-prefix decode attention A/B: grouped two-phase kernel vs
         # the per-slot kernel at the GRPO traffic shape — its own entry,

@@ -105,6 +105,20 @@ class RolloutSection:
     # idle age (in decode dispatches since last touch) past which a
     # resident page counts as COLD (warm = a quarter of this)
     kv_cold_after_dispatches: int = 256
+    # host-RAM KV spill tier (rollout/kvspill.py; ARCHITECTURE.md "KV
+    # spill tier"): cold unreferenced published prefix-cache pages page
+    # out of HBM into pinned host memory under watermark pressure and
+    # restore on a prefix hit — sessions oversubscribe HBM instead of
+    # losing their KV to eviction. Requires kv_ledger (candidate ranking
+    # + reconciliation); kv_ledger=false disables the sweep entirely.
+    kv_spill: bool = True
+    # host-side capacity of the spill tier, in GB
+    kv_spill_host_gb: float = 4.0
+    # page-util watermarks with hysteresis: the sweep arms at >= high and
+    # spills down toward low; the gap is what keeps demand restores from
+    # re-arming the sweep page-by-page (spill/restore thrash)
+    kv_spill_high_watermark: float = 0.92
+    kv_spill_low_watermark: float = 0.80
     # disaggregated plumbing (reference rollout_manager.{port,endpoint},
     # workers/config/rollout.py:95-101)
     manager_endpoint: str = ""            # "" → spawn the C++ manager locally

@@ -367,6 +367,10 @@ class Manager {
               // ledger-off / CPU engines — headroom keeps its -1 sentinel
               fwd("kv_cold_page_frac", inst->kv_cold_page_frac);
               fwd("hbm_headroom_gb", inst->hbm_headroom_gb);
+              // host-RAM spill tier: paged-out fraction + restore rate
+              // (absent on spill-off engines — atomics keep their zeros)
+              fwd("kv_spilled_frac", inst->kv_spilled_frac);
+              fwd("kv_restore_rate", inst->kv_restore_rate);
               if (info["draining"].as_bool() && !inst->draining.load()) {
                 log_line("instance " + inst->endpoint +
                          " announced draining; leaving routing set");
@@ -506,6 +510,8 @@ void register_routes(phttp::Server& server, Manager& mgr) {
       // omitting the key keeps the fleet min from counting it as 0 GB
       if (inst->hbm_headroom_gb.load() >= 0.0)
         o["hbm_headroom_gb"] = Value(inst->hbm_headroom_gb.load());
+      o["kv_spilled_frac"] = Value(inst->kv_spilled_frac.load());
+      o["kv_restore_rate"] = Value(inst->kv_restore_rate.load());
       arr.push_back(Value(std::move(o)));
     }
     Object top;
@@ -579,6 +585,13 @@ void register_routes(phttp::Server& server, Manager& mgr) {
         per += "polyrl_mgr_instance_hbm_headroom_gb{endpoint=\"" +
                esc(inst->endpoint) + "\"} " +
                std::to_string(inst->hbm_headroom_gb.load()) + "\n";
+      // host-RAM spill tier: who has KV paged out, and who is thrashing
+      per += "polyrl_mgr_instance_kv_spilled_frac{endpoint=\"" +
+             esc(inst->endpoint) + "\"} " +
+             std::to_string(inst->kv_spilled_frac.load()) + "\n";
+      per += "polyrl_mgr_instance_kv_restore_rate{endpoint=\"" +
+             esc(inst->endpoint) + "\"} " +
+             std::to_string(inst->kv_restore_rate.load()) + "\n";
       if (inst->healthy.load()) {
         occ_sum += inst->occupancy.load();
         ++occ_n;
@@ -634,6 +647,8 @@ void register_routes(phttp::Server& server, Manager& mgr) {
     body += "# TYPE polyrl_mgr_instance_ttft_p95_s gauge\n";
     body += "# TYPE polyrl_mgr_instance_kv_cold_page_frac gauge\n";
     body += "# TYPE polyrl_mgr_instance_hbm_headroom_gb gauge\n";
+    body += "# TYPE polyrl_mgr_instance_kv_spilled_frac gauge\n";
+    body += "# TYPE polyrl_mgr_instance_kv_restore_rate gauge\n";
     body += per;
     long total_reqs = 0;
     std::string per_route;

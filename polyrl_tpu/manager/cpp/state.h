@@ -68,6 +68,11 @@ struct Instance {
   // off) so the fleet min never counts an unreporting engine as 0 GB.
   std::atomic<double> kv_cold_page_frac{0.0};
   std::atomic<double> hbm_headroom_gb{-1.0};
+  // host-RAM KV spill tier (rollout/kvspill.py): fraction of the page pool
+  // currently paged out to host RAM (can exceed 1.0 under oversubscription)
+  // and the windowed restore rate in pages/dispatch (the thrash signal).
+  std::atomic<double> kv_spilled_frac{0.0};
+  std::atomic<double> kv_restore_rate{0.0};
 };
 
 using InstancePtr = std::shared_ptr<Instance>;

@@ -178,6 +178,10 @@ DEFAULT_WATCH = {
     # keeps its pages warm; HBM headroom only matters when it DROPS
     "engine/kv_cold_page_frac": "high",
     "engine/hbm_headroom_gb": "low",
+    # host-RAM spill tier (rollout/kvspill.py): a climbing restore rate
+    # means pages are thrashing between host and HBM — spilled pages being
+    # pulled straight back means the watermarks are fighting the workload
+    "engine/kv_restore_rate": "high",
 }
 
 

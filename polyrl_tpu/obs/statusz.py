@@ -8,9 +8,10 @@ trainer mounts a standalone :class:`StatuszServer` (it has no HTTP surface
 of its own); the rollout server mounts ``/statusz`` as a route on its
 existing listener (rollout/server.py).
 
-Schema (``polyrl/statusz/v6`` — additive evolution only; v2 added the
+Schema (``polyrl/statusz/v7`` — additive evolution only; v2 added the
 ``engine`` section, v3 the ``training`` section, v4 the ``timeseries``
-section, v5 the ``autoscale`` section, v6 the ``memory`` section;
+section, v5 the ``autoscale`` section, v6 the ``memory`` section, v7 the
+``spill`` block inside ``memory`` (host-RAM KV spill tier);
 version-history table in ARCHITECTURE.md "Observability"):
 
 - ``role``      — ``trainer`` | ``rollout``
@@ -51,11 +52,15 @@ version-history table in ARCHITECTURE.md "Observability"):
   hot/warm/cold residency tiers, churn + free-cause counters,
   page-lifetime histograms, the ledger↔pool ``attributed_frac``
   reconciliation block, and HBM truth (used/headroom/unaccounted).
-  Rollout role serves its engine's ledger; trainer role serves the
-  fleet worst-case aggregate from PoolManager sweeps; empty elsewhere
-  (and with ``rollout.kv_ledger=false``).
+  Since v7 it also carries a ``spill`` block when the host-RAM KV spill
+  tier is on (rollout/kvspill.py): spilled page/byte totals, cumulative
+  spill/restore traffic, the windowed restore rate (thrash signal), and
+  the host pool's lane/capacity stats. Rollout role serves its engine's
+  ledger; trainer role serves the fleet worst-case aggregate from
+  PoolManager sweeps; empty elsewhere (and with
+  ``rollout.kv_ledger=false``).
 
-Every v6 section is ALWAYS present on both planes (conformance-tested) so
+Every v7 section is ALWAYS present on both planes (conformance-tested) so
 consumers never need existence checks.
 
 ``GET /metrics`` on the same listener renders the snapshot's numeric
@@ -75,7 +80,7 @@ from typing import Callable
 
 log = logging.getLogger(__name__)
 
-SCHEMA = "polyrl/statusz/v6"
+SCHEMA = "polyrl/statusz/v7"
 _PROC_T0 = time.monotonic()
 _HIST_SUFFIXES = ("p50", "p95", "p99", "max", "mean", "count")
 

@@ -60,6 +60,7 @@ from polyrl_tpu.models import decoder
 from polyrl_tpu.rollout.engine import next_bucket
 from polyrl_tpu.rollout.flightdeck import EngineFlightDeck, ThroughputEWMA
 from polyrl_tpu.rollout.kvledger import PageLedger
+from polyrl_tpu.rollout.kvspill import HostSpillPool
 from polyrl_tpu.rollout.prefix_cache import PrefixCache
 from polyrl_tpu.rollout.sampling import (
     SamplingParams,
@@ -213,6 +214,10 @@ class CBEngine:
         group_preref_ttl_s: float | None = None,
         kv_ledger: bool = True,
         kv_cold_after_dispatches: int = 256,
+        kv_spill: bool = True,
+        kv_spill_host_gb: float = 4.0,
+        kv_spill_high_watermark: float = 0.92,
+        kv_spill_low_watermark: float = 0.80,
     ):
         if any(b % page_size for b in prompt_buckets):
             raise ValueError("prompt buckets must be page-aligned")
@@ -284,6 +289,30 @@ class CBEngine:
         # cause the cache booked (capacity / flush / preref_ttl)
         self.prefix_cache = (PrefixCache(page_size, self._free_cache_pages)
                              if enable_prefix_cache else None)
+        # host-RAM KV spill tier (rollout/kvspill.py): cold published
+        # prefix-cache pages page out to host under watermark pressure and
+        # restore on a prefix hit. Requires the ledger (candidate ranking
+        # + accounting) and the prefix cache (the spillable population) —
+        # kv_ledger=False therefore disables the sweep entirely, keeping
+        # the off-engine bitwise identical (spill never touches RNG or
+        # device state unless a spill/restore actually fires, and with the
+        # pool absent none can).
+        self.kvspill = (HostSpillPool(
+            capacity_bytes=int(float(kv_spill_host_gb) * 1e9))
+            if (kv_spill and kv_ledger and enable_prefix_cache) else None)
+        if not 0.0 < kv_spill_low_watermark <= kv_spill_high_watermark <= 1.0:
+            raise ValueError(
+                f"kv spill watermarks must satisfy 0 < low <= high <= 1, "
+                f"got low={kv_spill_low_watermark} "
+                f"high={kv_spill_high_watermark}")
+        self.kv_spill_high_watermark = float(kv_spill_high_watermark)
+        self.kv_spill_low_watermark = float(kv_spill_low_watermark)
+        if self.prefix_cache is not None and self.kvledger is not None:
+            # cold-first capacity eviction (ledger idle age beats
+            # insertion order) — on whenever the ledger is, spill or not
+            self.prefix_cache.idle_age = self.kvledger.idle_age
+        if self.kvspill is not None:
+            self.prefix_cache.drop_spilled = self._drop_spilled_entries
         self._pools = self._make_pools()
         self._rng = jax.random.PRNGKey(seed)
 
@@ -513,12 +542,160 @@ class CBEngine:
             self._accounted_bytes())
 
     def kv_memory_snapshot(self) -> dict:
-        """The /statusz ``memory`` section ({} when the ledger is off)."""
+        """The /statusz ``memory`` section ({} when the ledger is off).
+        The ledger owns the spill page/byte counters; the host-pool truth
+        (residency, capacity, copy-lane depth) merges in as
+        ``spill.host``."""
         if self.kvledger is None:
             return {}
-        return self.kvledger.snapshot(
+        snap = self.kvledger.snapshot(
             self.allocator.free_count, self._cache_pages(),
             self._accounted_bytes())
+        if self.kvspill is not None:
+            snap.setdefault("spill", {})["host"] = self.kvspill.stats()
+        return snap
+
+    # -- host-RAM KV spill tier (rollout/kvspill.py) -------------------------
+
+    def _drop_spilled_entries(self, entries: list) -> None:
+        """Spilled content died without a restore (cache flush, stale-
+        squatter replacement, engine stop): free the host tier and settle
+        the ledger — the physical pages were freed at spill time."""
+        handles = [e.spill_handle for e in entries if e.spilled]
+        for e in entries:
+            e.spilled = False
+            e.spill_handle = -1
+        if not handles:
+            return
+        self.kvspill.drop(handles)
+        if self.kvledger is not None:
+            self.kvledger.on_spill_drop(len(handles))
+
+    def _spill_sweep(self) -> None:
+        """Per-dispatch watermark check (loop thread, off the traced hot
+        path — the same seam as the ledger's residency sweep): page util
+        at or over the HIGH watermark spills cold unreferenced published
+        pages down toward the LOW watermark. The high/low gap is the
+        hysteresis band — demand restores land util between the marks
+        without immediately re-arming the sweep, so spill/restore cannot
+        thrash page-by-page at a single threshold."""
+        n = max(1, self.num_pages - 1)
+        util = 1.0 - self.allocator.free_count / n
+        if util < self.kv_spill_high_watermark:
+            return
+        target = int(np.ceil((util - self.kv_spill_low_watermark) * n))
+        if target > 0:
+            self._spill_pages(target, cold_only=True)
+
+    def _spill_pages(self, target: int, cold_only: bool) -> int:
+        """Page out up to ``target`` unreferenced published prefix-cache
+        pages, coldest first (``cold_only`` restricts to the ledger's cold
+        tier — the sweep's proactive mode; allocation pressure relaxes it
+        to any unreferenced published page, still coldest-first, because
+        spilling preserves the KV that plain eviction would destroy).
+        Returns how many pages were spilled.
+
+        The extraction slices are independent device buffers ordered after
+        every previously dispatched write by the pools data dependency, so
+        the physical pages return to the allocator immediately; nothing
+        can rewrite them until a later prefill reallocates them, which the
+        same dependency orders after the extraction."""
+        if (self.kvspill is None or self.kvledger is None
+                or self._pools is None or target <= 0):
+            return 0
+        if not self.kvspill.lane_free():
+            return 0  # copy lane full: double-buffer backpressure
+        age = self.kvledger.idle_age
+        cands = [(age(e.page), e) for e in self.prefix_cache.spill_candidates()]
+        if cold_only:
+            cands = [c for c in cands if c[0] >= self.kvledger.cold_after]
+        if not cands:
+            return 0
+        cands.sort(key=lambda c: (-c[0], c[1].tick))
+        page_bytes = int(self.kvledger.page_bytes)
+        if page_bytes <= 0:
+            self._accounted_bytes()  # sets ledger.page_bytes from the pools
+            page_bytes = int(self.kvledger.page_bytes)
+        take = min(target, len(cands))
+        while take > 0 and not self.kvspill.can_spill(take, page_bytes):
+            take -= 1  # host capacity: spill what fits, never evict here
+        if take <= 0:
+            return 0
+        entries = [e for _age, e in cands[:take]]
+        pages = [e.page for e in entries]
+        kp, vp = self._pools
+        idx = jnp.asarray(np.asarray(pages, np.int32))
+        k_dev = jnp.stack([kp[layer][:, idx] for layer in range(len(kp))])
+        v_dev = jnp.stack([vp[layer][:, idx] for layer in range(len(vp))])
+        handles = self.kvspill.spill(k_dev, v_dev, len(pages), page_bytes)
+        for e, h in zip(entries, handles):
+            e.spilled = True
+            e.spill_handle = h
+        self.allocator.free(pages)
+        self.kvledger.on_spill(pages)
+        return len(pages)
+
+    def _restore_matched(self, matched_entries: list
+                         ) -> tuple[list[int], list]:
+        """A prefix-cache match landed on spilled entries: restore them
+        into fresh physical pages before the attach (restore-then-attach).
+        If pages for the full chain cannot be found, the chain truncates
+        at the first still-spilled entry (the dropped tail's match refs
+        are released) — a shorter hit, never a corrupt one. Returns the
+        (possibly truncated) page list + entry list."""
+        spilled = [e for e in matched_entries if e.spilled]
+        if spilled and not self._restore_entries(spilled):
+            cut = next(i for i, e in enumerate(matched_entries) if e.spilled)
+            self.prefix_cache.release(matched_entries[cut:])
+            matched_entries = matched_entries[:cut]
+        return [e.page for e in matched_entries], matched_entries
+
+    def _restore_entries(self, entries: list) -> bool:
+        """Batch-restore spilled entries into freshly allocated physical
+        pages (host→device, one scatter per layer). The new physical index
+        is fine: every consumer goes through the page-table indirection,
+        and decode-group seating keys on exact physical chains so a
+        restored chain simply decodes solo. Returns False (nothing
+        restored) when no pages can be found even after spilling colder
+        pages / evicting the cache."""
+        need = len(entries)
+        pages = self.allocator.alloc(need)
+        while pages is None and self._outstanding():
+            self._drain_emit_q(keep=self._outstanding() - 1)
+            pages = self.allocator.alloc(need)
+        if pages is None:
+            # colder spillable pages can make room without losing KV;
+            # the entries being restored are already spilled, so they are
+            # not candidates — no recursion, no self-displacement
+            if self._spill_pages(need - self.allocator.free_count,
+                                 cold_only=False):
+                pages = self.allocator.alloc(need)
+        if pages is None and self.prefix_cache.evict(
+                need - self.allocator.free_count):
+            pages = self.allocator.alloc(need)
+        if pages is None:
+            return False
+        k_host = np.stack([self.kvspill.fetch(e.spill_handle)[0]
+                           for e in entries], axis=2)
+        v_host = np.stack([self.kvspill.fetch(e.spill_handle)[1]
+                           for e in entries], axis=2)
+        kp, vp = self._pools
+        idx = jnp.asarray(np.asarray(pages, np.int32))
+        self._pools = (
+            tuple(kp[layer].at[:, idx].set(
+                jnp.asarray(k_host[layer]).astype(kp[layer].dtype))
+                for layer in range(len(kp))),
+            tuple(vp[layer].at[:, idx].set(
+                jnp.asarray(v_host[layer]).astype(vp[layer].dtype))
+                for layer in range(len(vp))))
+        self.kvspill.drop([e.spill_handle for e in entries], restored=True)
+        for e, p in zip(entries, pages):
+            e.page = int(p)
+            e.spilled = False
+            e.spill_handle = -1
+        if self.kvledger is not None:
+            self.kvledger.on_restore(pages)
+        return True
 
     def _shard_params_for_mesh(self, params):
         from polyrl_tpu.models.quant import (
@@ -1310,6 +1487,10 @@ class CBEngine:
         self._drain_queue()
         while self._pending:
             self._emit_error(self._pending.popleft(), "engine shutdown")
+        if self.kvspill is not None:
+            # the cache flush above dropped every spilled entry (both
+            # tiers freed); now join the copy lane thread
+            self.kvspill.stop()
 
     # -- weight / memory lifecycle ------------------------------------------
 
@@ -1567,6 +1748,13 @@ class CBEngine:
             if self.prefix_cache is not None:
                 matched_pages, matched_entries = self.prefix_cache.match(
                     req.input_ids)
+                if self.kvspill is not None and any(
+                        e.spilled for e in matched_entries):
+                    # a hit on spilled KV restores-then-attaches: the
+                    # chain lands in fresh physical pages (truncating at
+                    # the first entry that cannot be restored)
+                    matched_pages, matched_entries = \
+                        self._restore_matched(matched_entries)
                 if n_full > 0:
                     first_key = self.prefix_cache._keys_for(
                         req.input_ids, 1)[0]
@@ -1655,6 +1843,13 @@ class CBEngine:
             # often the oldest fetch batch already holds the finisher
             self._drain_emit_q(keep=self._outstanding() - 1)
             pages = self.allocator.alloc(need)
+        if pages is None and self.kvspill is not None:
+            # allocation pressure: page unreferenced published KV out to
+            # host BEFORE evicting it — spilling preserves what eviction
+            # destroys, which is what lets sessions oversubscribe HBM
+            if self._spill_pages(need - self.allocator.free_count,
+                                 cold_only=False):
+                pages = self.allocator.alloc(need)
         if pages is None and self.prefix_cache is not None:
             # pool pressure: evict unreferenced cached pages and retry
             if self.prefix_cache.evict(need - self.allocator.free_count):
@@ -2622,6 +2817,17 @@ class CBEngine:
             return
         page_row = [int(p) for p in self._page_table[slot][:n_full]]
         matched_pages, matched_entries = self.prefix_cache.match(seq)
+        if self.kvspill is not None and any(e.spilled
+                                            for e in matched_entries):
+            # salvage must not pay a restore just to dedup its publish:
+            # truncate the verified chain at the first spilled entry —
+            # publish walks the rest against the existing (spilled)
+            # entries by token + parent identity, pages stay slot-private
+            cut = next(i for i, e in enumerate(matched_entries)
+                       if e.spilled)
+            self.prefix_cache.release(matched_entries[cut:])
+            matched_pages = matched_pages[:cut]
+            matched_entries = matched_entries[:cut]
         published = self.prefix_cache.publish(
             seq, page_row, n_cached=len(matched_pages),
             matched_entries=matched_entries)
@@ -2695,6 +2901,11 @@ class CBEngine:
             # keep it out of the tier counts anyway.
             rows = self._page_table[self._active].ravel()
             self.kvledger.on_dispatch(rows[rows != 0])
+            if self.kvspill is not None:
+                # host-RAM spill sweep rides the same off-hot-path seam:
+                # page util over the high watermark pages the coldest
+                # unreferenced published pages out to host
+                self._spill_sweep()
 
     @property
     def spec_accept_rate(self) -> float:

@@ -11,7 +11,10 @@ engine loop thread at every page transition:
 - **role** — ``free`` / ``active_decode`` (slot-owned) /
   ``prefix_cache_published`` (cache-owned, refcounted) /
   ``group_preref_held`` (published AND pinned by group-shared prefill
-  pre-refs); page 0 is the reserved null page and stays out of every count.
+  pre-refs) / ``spilled`` (a LOGICAL role: the content lives in the host
+  spill tier, rollout/kvspill.py, while the physical page is back on the
+  free list); page 0 is the reserved null page and stays out of every
+  count.
 - **owner** — the rid (or group id) the page was allocated for.
 - **birth / last-touch dispatch** — decode-dispatch ticks; each dispatch
   touches every page of every active slot's page row (the pages the
@@ -23,9 +26,11 @@ engine loop thread at every page transition:
 
 **Residency tiers**: a per-dispatch sweep buckets resident pages by idle
 age — hot (< cold_after/4 dispatches), warm (< cold_after), cold
-(>= cold_after, ``rollout.kv_cold_after_dispatches``). The cold set is the
-future spill tier's eviction candidate set, observable one PR before it
-acts.
+(>= cold_after, ``rollout.kv_cold_after_dispatches``). The cold set IS the
+spill tier's candidate set: the engine's per-dispatch sweep pages cold
+unreferenced published pages out to host RAM under watermark pressure
+(``kv_spilled_frac`` / ``kv_restore_rate``, spill block in the statusz
+``memory`` section).
 
 **Reconciliation** (the flight-deck ``attributed_frac`` discipline): the
 ledger's role counts must match the allocator free list + the prefix
@@ -60,6 +65,11 @@ ROLE_RESERVED = 4  # page 0: the null page — never allocated, never counted
 
 ROLE_NAMES = ("free", "active_decode", "prefix_cache_published",
               "group_preref_held")
+
+# the "spilled" role is LOGICAL, not physical: a spilled page's content
+# lives in host RAM (rollout/kvspill.py) while its physical page is back
+# on the allocator free list — so it is tracked as a scalar count beside
+# the physical role array, and role_counts() reports it as a fifth role
 
 FREE_CAUSES = ("finalize", "abort", "salvage", "cache_pressure", "flush",
                "preref_ttl")
@@ -144,6 +154,21 @@ class PageLedger:
         }
         # last sweep (scalars; served without re-sweeping)
         self._tier_pages = {"hot": 0, "warm": 0, "cold": 0}
+        # host-RAM spill tier (rollout/kvspill.py): page-count/byte truth.
+        # spilled_pages is the CURRENT logical-spilled count (the "spilled"
+        # role); the rest are cumulative. Reconciliation stays exact:
+        # HBM-resident cache pages + spilled == prefix-cache entries.
+        self.spilled_pages = 0
+        self.pages_spilled = 0   # cumulative device→host
+        self.pages_restored = 0  # cumulative host→device
+        self.spill_drops = 0     # spilled content freed without restore
+        self.spill_bytes = 0     # cumulative bytes device→host
+        self.restore_bytes = 0   # cumulative bytes host→device
+        # restore rate (pages/dispatch over a short window): the
+        # spill-thrash signal the FlightRecorder watches — a HIGH rate
+        # means restores chase the sweep (watermark hysteresis defeated)
+        self.restore_rate = 0.0
+        self._restore_marks: list[tuple[int, int]] = []
 
     # -- transitions (engine loop thread) ------------------------------------
 
@@ -215,6 +240,59 @@ class PageLedger:
             self.page_frees += n
             self.freed_by_cause[cause] = self.freed_by_cause.get(cause, 0) + n
 
+    def on_spill(self, pages) -> None:
+        """Published pages left HBM for the host spill tier: the physical
+        pages are FREE again (the engine hands them to the allocator), the
+        content moves to the logical ``spilled`` role. Not a free-cause —
+        the KV survives, so lifetime/idle histograms stay untouched."""
+        if not len(pages):
+            return
+        idx = np.asarray(list(pages), np.int64)
+        with self._lock:
+            sel = idx[self._role[idx] == ROLE_PUBLISHED]
+            self._role[sel] = ROLE_FREE
+            for p in sel.tolist():
+                self._owner[p] = ""
+            n = len(sel)
+            self.spilled_pages += n
+            self.pages_spilled += n
+            self.spill_bytes += n * self.page_bytes
+
+    def on_restore(self, pages) -> None:
+        """Spilled content landed back in HBM at freshly allocated pages:
+        they are cache-owned (published) immediately — a restore only ever
+        happens for a prefix hit or a resuming chain about to attach."""
+        if not len(pages):
+            return
+        idx = np.asarray(list(pages), np.int64)
+        with self._lock:
+            sel = idx[self._role[idx] == ROLE_FREE]
+            self._role[sel] = ROLE_PUBLISHED
+            self._birth[sel] = self.dispatch
+            self._touch[sel] = self.dispatch
+            n = len(sel)
+            self.spilled_pages = max(0, self.spilled_pages - n)
+            self.pages_restored += n
+            self.restore_bytes += n * self.page_bytes
+
+    def on_spill_drop(self, n: int) -> None:
+        """Spilled content died without a restore (abort while spilled,
+        cache flush, weight swap): both tiers are now free."""
+        with self._lock:
+            n = int(n)
+            self.spilled_pages = max(0, self.spilled_pages - n)
+            self.spill_drops += n
+
+    def idle_age(self, page: int) -> int:
+        """Dispatches since a decode last touched this resident page (the
+        prefix cache's cold-first eviction order and the spill sweep's
+        candidate ranking both key on it)."""
+        with self._lock:
+            return int(self.dispatch - self._touch[int(page)])
+
+    def is_cold(self, page: int) -> bool:
+        return self.idle_age(page) >= self.cold_after
+
     def on_dispatch(self, touched) -> None:
         """One decode dispatch: advance the tick, touch the pages the
         dispatch attends (every active slot's page row), and re-sweep the
@@ -236,6 +314,14 @@ class PageLedger:
                              & (idle < self.cold_after)).sum()),
                 "cold": int((idle >= self.cold_after).sum()),
             }
+            # restore rate over the last ≤64 dispatches (pages/dispatch)
+            self._restore_marks.append((self.dispatch, self.pages_restored))
+            if len(self._restore_marks) > 64:
+                self._restore_marks.pop(0)
+            t0, r0 = self._restore_marks[0]
+            span = self.dispatch - t0
+            self.restore_rate = ((self.pages_restored - r0) / span
+                                 if span > 0 else 0.0)
 
     # -- views ----------------------------------------------------------------
 
@@ -245,7 +331,10 @@ class PageLedger:
 
     def _role_counts_locked(self) -> dict[str, int]:
         counts = np.bincount(self._role, minlength=5)
-        return {name: int(counts[i]) for i, name in enumerate(ROLE_NAMES)}
+        out = {name: int(counts[i]) for i, name in enumerate(ROLE_NAMES)}
+        # the logical fifth role: content in host RAM, physical page free
+        out["spilled"] = int(self.spilled_pages)
+        return out
 
     def attributed_frac(self, pool_free: int, cache_pages: int) -> float:
         """1.0 exactly when the ledger's role counts match the pool truth:
@@ -258,9 +347,13 @@ class PageLedger:
 
     def _attributed_locked(self, pool_free: int, cache_pages: int) -> float:
         c = self._role_counts_locked()
+        # cache entries split across two tiers: HBM-resident (published /
+        # preref-held physical pages) + spilled (content in host RAM) must
+        # cover the prefix cache's entry count exactly
         mismatch = (abs(c["free"] - int(pool_free))
                     + abs(c["prefix_cache_published"]
-                          + c["group_preref_held"] - int(cache_pages)))
+                          + c["group_preref_held"] + c["spilled"]
+                          - int(cache_pages)))
         return max(0.0, 1.0 - mismatch / max(1, self.num_alloc_pages))
 
     def server_info_fields(self, pool_free: int, cache_pages: int,
@@ -276,11 +369,23 @@ class PageLedger:
                 "kv_warm_page_frac": round(tiers["warm"] / n, 6),
                 "kv_cold_page_frac": round(tiers["cold"] / n, 6),
                 "kv_cold_bytes": float(tiers["cold"] * self.page_bytes),
+                # host-RAM spill tier (the manager forwards both per
+                # instance; spilled_frac is relative to the HBM pool —
+                # >1.0 legitimately means MORE KV lives on host than fits
+                # on chip, the oversubscription win itself)
+                "kv_spilled_frac": round(self.spilled_pages / n, 6),
+                "kv_restore_rate": round(self.restore_rate, 6),
                 "memory/attributed_frac": round(
                     self._attributed_locked(pool_free, cache_pages), 6),
                 "memory/page_allocs": float(self.page_allocs),
                 "memory/page_frees": float(self.page_frees),
                 "memory/page_publishes": float(self.page_publishes),
+                "memory/spilled_pages": float(self.spilled_pages),
+                "memory/pages_spilled": float(self.pages_spilled),
+                "memory/pages_restored": float(self.pages_restored),
+                "memory/spill_drops": float(self.spill_drops),
+                "memory/spill_bytes": float(self.spill_bytes),
+                "memory/restore_bytes": float(self.restore_bytes),
             }
             for cause, count in self.freed_by_cause.items():
                 fields[f"memory/freed_{cause}"] = float(count)
@@ -319,9 +424,21 @@ class PageLedger:
                         pool_free, cache_pages), 6),
                     "ledger_free": counts["free"],
                     "pool_free": int(pool_free),
+                    # HBM-resident cache pages + spilled == cache entries
                     "ledger_cache": counts["prefix_cache_published"]
-                    + counts["group_preref_held"],
+                    + counts["group_preref_held"] + counts["spilled"],
                     "cache_pages": int(cache_pages),
+                },
+                "spill": {
+                    "spilled_pages": int(self.spilled_pages),
+                    "spilled_bytes": int(self.spilled_pages
+                                         * self.page_bytes),
+                    "pages_spilled": int(self.pages_spilled),
+                    "pages_restored": int(self.pages_restored),
+                    "spill_drops": int(self.spill_drops),
+                    "spill_bytes": int(self.spill_bytes),
+                    "restore_bytes": int(self.restore_bytes),
+                    "restore_rate": round(self.restore_rate, 6),
                 },
                 "hists": {name: {"p50": h.percentile(50.0),
                                  "p95": h.percentile(95.0),

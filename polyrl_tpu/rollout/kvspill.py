@@ -1,0 +1,242 @@
+"""Host-RAM KV spill tier — the backing store behind the paged HBM pool
+(ARCHITECTURE.md "KV spill tier").
+
+One chip's HBM bounds concurrent sessions; the page ledger
+(rollout/kvledger.py) already knows which resident pages are COLD and who
+owns them. This module adds the tier the ledger was built to enable: cold
+published prefix-cache pages are copied device→host, their physical pages
+return to the :class:`~polyrl_tpu.rollout.cb_engine.PageAllocator`, and the
+KV content survives in host RAM until a prefix-cache hit (or a resuming
+session) restores it into a freshly allocated page — at a NEW physical
+index, which is safe because every consumer goes through the page-table
+indirection (the PR 4 salvage-republish machinery relies on the same
+property).
+
+Design (mirrors the engine's fetcher-thread pattern):
+
+- :meth:`HostSpillPool.spill` takes the extracted per-page device slices
+  (``[L, Hkv, n, page_size, D]`` stacked over layers) and queues them on a
+  DOUBLE-BUFFERED background lane: a dedicated copy thread owns the
+  blocking ``device_get``; at most ``lane_depth`` batches are in flight, so
+  spilling never stalls the engine loop and the transient HBM held by the
+  extracted slices stays bounded. Until a batch lands, its entries keep
+  their device buffers — a restore that races the copy just reads those
+  (synchronous fallback, same discipline as the dead-fetcher drain path).
+- The engine frees the physical pages IMMEDIATELY after extraction: the
+  slices are independent device buffers ordered after every previously
+  dispatched write (pool data dependency), and nothing can write the freed
+  pages until a later prefill reallocates them — which the same dependency
+  orders after the extraction.
+- :meth:`fetch` returns the page's host KV (blocking out an in-flight copy
+  if needed); :meth:`drop` discards entries (restore consumed it, or an
+  abort/flush while spilled frees the host tier).
+
+Byte accounting (``resident_bytes`` vs ``capacity_bytes``) backs the
+``--kv-spill-host-gb`` knob; the LEDGER owns the page-count/byte counters
+that feed ``kv_spilled_frac`` and reconciliation (HBM-resident + spilled ==
+accounted) — this pool only reports host-side truth.
+
+Thread-safety: ``spill``/``fetch``/``drop`` run on the engine loop thread
+(under ``_pool_lock``); the copy thread only moves queued batches from
+device refs to host arrays under the pool's own condition variable.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import threading
+
+import numpy as np
+
+log = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class _SpillEntry:
+    handle: int
+    nbytes: int
+    # exactly one of (host k/v) or the batch device ref is set; the batch
+    # ref is dropped when the background copy lands (that is what releases
+    # the transient HBM the extracted slices pin)
+    k_host: np.ndarray | None = None
+    v_host: np.ndarray | None = None
+    # (k_batch, v_batch, page index into the batch) while in flight
+    dev: tuple | None = None
+    dead: bool = False  # dropped while the copy was still in flight
+
+
+class HostSpillPool:
+    """Pinned host-memory backing tier for spilled KV pages."""
+
+    def __init__(self, capacity_bytes: int, lane_depth: int = 2):
+        self.capacity_bytes = int(capacity_bytes)
+        self.lane_depth = max(1, int(lane_depth))
+        self._cv = threading.Condition()
+        self._entries: dict[int, _SpillEntry] = {}
+        self._next_handle = 0
+        # background copy lane: (handles, k_dev, v_dev) batches awaiting
+        # device_get; bounded by lane_depth (double-buffered by default)
+        self._lane: list[tuple[list[int], object, object]] = []
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        # host-side truth (cumulative; the ledger owns the page counters)
+        self.resident_bytes = 0
+        self.bytes_spilled = 0
+        self.bytes_restored = 0
+        self.copy_batches = 0
+        self.sync_fetches = 0  # restores that beat the background copy
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def _ensure_thread(self) -> None:
+        if self._thread is None or not self._thread.is_alive():
+            if self._stop.is_set():
+                return
+            self._thread = threading.Thread(target=self._copy_loop,
+                                            name="kv-spill-copy",
+                                            daemon=True)
+            self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        with self._cv:
+            self._cv.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+            self._thread = None
+
+    # -- spill side (engine loop thread) -------------------------------------
+
+    def lane_free(self) -> bool:
+        """Backpressure: the double-buffered lane has room for one more
+        batch (a full lane means the copy thread is behind — the sweep
+        skips this dispatch instead of queueing unbounded device refs)."""
+        with self._cv:
+            return len(self._lane) < self.lane_depth
+
+    def can_spill(self, n_pages: int, page_bytes: int) -> bool:
+        with self._cv:
+            return (len(self._lane) < self.lane_depth
+                    and self.resident_bytes + n_pages * page_bytes
+                    <= self.capacity_bytes)
+
+    def spill(self, k_dev, v_dev, n_pages: int,
+              page_bytes: int) -> list[int]:
+        """Queue ``n_pages`` extracted page slices (``k_dev``/``v_dev`` are
+        ``[L, Hkv, n_pages, page_size, D]`` device arrays) for the
+        background device→host copy. Returns one handle per page (index
+        ``i`` of the slice ↔ handle ``i``)."""
+        handles: list[int] = []
+        with self._cv:
+            for i in range(n_pages):
+                h = self._next_handle
+                self._next_handle += 1
+                # the entry keeps a ref to the WHOLE batch + its index: the
+                # copy thread lands the batch in ONE device_get; a restore
+                # that beats it slices its own page out synchronously
+                self._entries[h] = _SpillEntry(
+                    handle=h, nbytes=page_bytes, dev=(k_dev, v_dev, i))
+                handles.append(h)
+            self._lane.append((list(handles), k_dev, v_dev))
+            self.resident_bytes += n_pages * page_bytes
+            self.bytes_spilled += n_pages * page_bytes
+            self._cv.notify_all()
+        self._ensure_thread()
+        return handles
+
+    # -- copy thread ----------------------------------------------------------
+
+    def _copy_loop(self) -> None:
+        import jax
+
+        while not self._stop.is_set():
+            with self._cv:
+                if not self._lane:
+                    self._cv.wait(timeout=0.05)
+                    continue
+                handles, k_dev, v_dev = self._lane[0]
+            try:
+                k_host, v_host = jax.device_get([k_dev, v_dev])
+            except Exception:  # noqa: BLE001 — a poisoned buffer must not
+                # kill the lane; the entries keep their device refs and a
+                # later fetch retries (or surfaces) synchronously
+                log.exception("kv spill copy failed; entries stay on device")
+                with self._cv:
+                    if self._lane and self._lane[0][0] is handles:
+                        self._lane.pop(0)
+                    self._cv.notify_all()
+                continue
+            k_host = np.asarray(k_host)
+            v_host = np.asarray(v_host)
+            with self._cv:
+                for i, h in enumerate(handles):
+                    e = self._entries.get(h)
+                    if e is None or e.dead or e.k_host is not None:
+                        continue  # dropped or sync-fetched while in flight
+                    e.k_host = np.ascontiguousarray(k_host[:, :, i])
+                    e.v_host = np.ascontiguousarray(v_host[:, :, i])
+                    e.dev = None
+                if self._lane and self._lane[0][0] is handles:
+                    self._lane.pop(0)
+                self.copy_batches += 1
+                self._cv.notify_all()
+
+    # -- restore / drop side (engine loop thread) -----------------------------
+
+    def fetch(self, handle: int) -> tuple[np.ndarray, np.ndarray]:
+        """The page's host KV (``[L, Hkv, page_size, D]`` each). A fetch
+        that beats the background copy lands the page's own slice
+        synchronously (device refs are per-page views of the batch)."""
+        with self._cv:
+            e = self._entries[handle]
+            if e.k_host is not None:
+                return e.k_host, e.v_host
+            k_dev, v_dev, i = e.dev
+        import jax
+
+        k_host, v_host = (np.asarray(a) for a in jax.device_get(
+            [k_dev[:, :, i], v_dev[:, :, i]]))
+        with self._cv:
+            if e.k_host is None:
+                e.k_host, e.v_host = k_host, v_host
+                e.dev = None
+                self.sync_fetches += 1
+            return e.k_host, e.v_host
+
+    def drop(self, handles, restored: bool = False) -> None:
+        """Discard entries: a restore consumed them (``restored=True``,
+        bytes move to the restored counter) or the content died while
+        spilled (abort / cache flush / weight swap — both tiers freed)."""
+        with self._cv:
+            for h in handles:
+                e = self._entries.pop(h, None)
+                if e is None:
+                    continue
+                e.dead = True  # an in-flight copy discards it on landing
+                self.resident_bytes -= e.nbytes
+                if restored:
+                    self.bytes_restored += e.nbytes
+            self._cv.notify_all()
+
+    # -- views ----------------------------------------------------------------
+
+    @property
+    def resident_pages(self) -> int:
+        with self._cv:
+            return len(self._entries)
+
+    def stats(self) -> dict:
+        """Host-side truth for the statusz ``memory.spill.host`` block."""
+        with self._cv:
+            return {
+                "resident_pages": len(self._entries),
+                "resident_bytes": int(self.resident_bytes),
+                "capacity_bytes": int(self.capacity_bytes),
+                "bytes_spilled": int(self.bytes_spilled),
+                "bytes_restored": int(self.bytes_restored),
+                "copy_batches": int(self.copy_batches),
+                "sync_fetches": int(self.sync_fetches),
+                "lane_inflight": len(self._lane),
+                "lane_depth": self.lane_depth,
+            }

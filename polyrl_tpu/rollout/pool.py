@@ -378,6 +378,17 @@ class PoolManager:
                  if "hbm_headroom_gb" in i]
         if heads:
             out["engine/hbm_headroom_gb"] = min(heads)
+        # host-RAM spill tier (rollout/kvspill.py) — worst case again: the
+        # engine with the most KV paged out (frac can exceed 1.0 under
+        # oversubscription) and the hottest restore churn (thrash signal)
+        spilled = [float(i["kv_spilled_frac"]) for i in rep
+                   if "kv_spilled_frac" in i]
+        if spilled:
+            out["engine/kv_spilled_frac"] = max(spilled)
+        restores = [float(i["kv_restore_rate"]) for i in rep
+                    if "kv_restore_rate" in i]
+        if restores:
+            out["engine/kv_restore_rate"] = max(restores)
         return out
 
     def engine_section(self) -> dict:
@@ -432,6 +443,14 @@ class PoolManager:
                  if "hbm_headroom_gb" in i]
         if heads:
             fleet["hbm_headroom_gb_min"] = min(heads)
+        spilled = [float(i["kv_spilled_frac"]) for i in rep
+                   if "kv_spilled_frac" in i]
+        if spilled:
+            fleet["kv_spilled_frac_max"] = max(spilled)
+        restores = [float(i["kv_restore_rate"]) for i in rep
+                    if "kv_restore_rate" in i]
+        if restores:
+            fleet["kv_restore_rate_max"] = max(restores)
         return {
             "fleet": fleet,
             "engines": [{
@@ -439,6 +458,10 @@ class PoolManager:
                 "kv_cold_page_frac": float(i["kv_cold_page_frac"]),
                 **({"hbm_headroom_gb": float(i["hbm_headroom_gb"])}
                    if "hbm_headroom_gb" in i else {}),
+                **({"kv_spilled_frac": float(i["kv_spilled_frac"])}
+                   if "kv_spilled_frac" in i else {}),
+                **({"kv_restore_rate": float(i["kv_restore_rate"])}
+                   if "kv_restore_rate" in i else {}),
             } for i in rep],
         }
 

@@ -31,6 +31,12 @@ class _Entry:
     # sequence of entries already verified for this request.
     page_toks: tuple = ()
     parent: "_Entry | None" = None
+    # host-RAM spill tier (rollout/kvspill.py): a spilled entry's KV lives
+    # in the HostSpillPool under spill_handle and ``page`` is STALE — the
+    # engine restores it into a fresh physical page (updating ``page``)
+    # before any attach. Only refcount==0 entries ever spill.
+    spilled: bool = False
+    spill_handle: int = -1
 
 
 class PrefixCache:
@@ -59,6 +65,19 @@ class PrefixCache:
         # admitted request (any matched page = hit).
         self.req_hits = 0
         self.req_misses = 0
+        # cold-first capacity eviction (set by the engine when the page
+        # ledger is on): physical page id → idle age in dispatches.
+        # Eviction then prefers the COLDEST unreferenced entries instead
+        # of insertion order, so a hot shared group prefix is never evicted
+        # while a cold singleton survives.
+        self.idle_age: "Callable[[int], int] | None" = None
+        self.evict_cold_first = 0  # pages evicted under cold-first order
+        # spill-tier hook (set by the engine when the spill tier is on):
+        # called with entries whose SPILLED content must be dropped (a
+        # flush, or a stale-squatter replacement, while spilled) — their
+        # physical page is already free, so they must NOT go through
+        # _free_pages.
+        self.drop_spilled: "Callable[[list], None] | None" = None
 
     def _free(self, pages: list[int], cause: str) -> None:
         """Single free choke point: book the cause, then hand the pages
@@ -148,7 +167,13 @@ class PrefixCache:
                     # or a colliding entry): replace it so this prefix stays
                     # cacheable instead of permanently re-prefilling
                     del self._map[key]
-                    self._free([existing.page], "capacity")
+                    if existing.spilled:
+                        # its physical page is already free — only the
+                        # host-side copy dies
+                        if self.drop_spilled is not None:
+                            self.drop_spilled([existing])
+                    else:
+                        self._free([existing.page], "capacity")
                     e = _Entry(key=key, page=page_ids[i], refcount=1,
                                tick=self._tick, page_toks=page_toks,
                                parent=prev)
@@ -202,11 +227,22 @@ class PrefixCache:
     # -- eviction / flush ----------------------------------------------------
 
     def evict(self, n_pages: int) -> int:
-        """Free up to ``n_pages`` unreferenced pages, LRU first. Returns how
-        many were freed."""
-        victims = sorted(
-            (e for e in self._map.values() if e.refcount == 0),
-            key=lambda e: e.tick)[:n_pages]
+        """Free up to ``n_pages`` unreferenced HBM-resident pages. With the
+        ledger's ``idle_age`` hook attached, the COLDEST pages go first
+        (idle-age descending, insertion tick as the tiebreak) — a hot
+        shared group prefix is never evicted while a cold singleton
+        survives; without it, plain LRU by insertion tick. Spilled entries
+        are skipped: their physical page is already free, so evicting them
+        would reclaim no HBM. Returns how many pages were freed."""
+        candidates = [e for e in self._map.values()
+                      if e.refcount == 0 and not e.spilled]
+        if self.idle_age is not None:
+            age = self.idle_age
+            victims = sorted(candidates,
+                             key=lambda e: (-age(e.page), e.tick))[:n_pages]
+            self.evict_cold_first += len(victims)
+        else:
+            victims = sorted(candidates, key=lambda e: e.tick)[:n_pages]
         if not victims:
             return 0
         for e in victims:
@@ -214,17 +250,30 @@ class PrefixCache:
         self._free([e.page for e in victims], "capacity")
         return len(victims)
 
+    def spill_candidates(self) -> list[_Entry]:
+        """Entries the spill tier may page out: unreferenced, HBM-resident
+        (the sweep ranks them by ledger idle age and takes the coldest)."""
+        return [e for e in self._map.values()
+                if e.refcount == 0 and not e.spilled]
+
     def flush(self) -> None:
         """Invalidate everything (weight update / memory release):
         unreferenced pages return to the allocator now; referenced ones are
-        orphaned and freed when their last holder releases."""
+        orphaned and freed when their last holder releases; spilled entries
+        drop their host-side copy (their physical page is already free —
+        abort/flush-while-spilled frees both tiers)."""
         freed: list[int] = []
+        spilled: list[_Entry] = []
         for e in self._map.values():
-            if e.refcount == 0:
+            if e.spilled:
+                spilled.append(e)
+            elif e.refcount == 0:
                 freed.append(e.page)
             else:
                 e.orphaned = True
         self._map.clear()
+        if spilled and self.drop_spilled is not None:
+            self.drop_spilled(spilled)
         if freed:
             self._free(freed, "flush")
 
@@ -250,6 +299,10 @@ class PrefixCache:
                 # the spill tier nothing about what it would be stealing
                 "prefix_cache/evict_capacity": float(
                     self.evictions["capacity"]),
+                # capacity evictions ordered cold-first by ledger idle age
+                # (0 when the ledger hook is off — insertion-order LRU)
+                "prefix_cache/evict_cold_first": float(
+                    self.evict_cold_first),
                 "prefix_cache/evict_flush": float(self.evictions["flush"]),
                 "prefix_cache/evict_preref_ttl": float(
                     self.evictions["preref_ttl"])}

@@ -54,6 +54,10 @@ def create_server(model: str, manager_endpoint: str | None = None,
                   group_preref_ttl_s: float | None = None,
                   kv_ledger: bool = True,
                   kv_cold_after_dispatches: int = 256,
+                  kv_spill: bool = True,
+                  kv_spill_host_gb: float = 4.0,
+                  kv_spill_high_watermark: float = 0.92,
+                  kv_spill_low_watermark: float = 0.80,
                   fault_injector=None):
     """Build engine + server, register with the manager, attach receiver.
 
@@ -164,7 +168,11 @@ def create_server(model: str, manager_endpoint: str | None = None,
             group_share=group_share, decode_group_share=decode_group_share,
             group_preref_ttl_s=group_preref_ttl_s,
             kv_ledger=kv_ledger,
-            kv_cold_after_dispatches=kv_cold_after_dispatches)
+            kv_cold_after_dispatches=kv_cold_after_dispatches,
+            kv_spill=kv_spill,
+            kv_spill_host_gb=kv_spill_host_gb,
+            kv_spill_high_watermark=kv_spill_high_watermark,
+            kv_spill_low_watermark=kv_spill_low_watermark)
     else:
         kwargs = {}
         if batch_buckets:
@@ -306,6 +314,13 @@ def main() -> None:
     p.add_argument("--kv-cold-after-dispatches", type=int, default=256,
                    help="idle age (decode dispatches) past which a "
                         "resident KV page counts as cold")
+    p.add_argument("--no-kv-spill", action="store_true",
+                   help="disable the host-RAM KV spill tier (cold "
+                        "published pages stay in HBM and capacity "
+                        "eviction destroys them; --no-kv-ledger also "
+                        "disables spilling)")
+    p.add_argument("--kv-spill-host-gb", type=float, default=4.0,
+                   help="host-side capacity of the KV spill tier, GB")
     p.add_argument("--lora-rank", type=int, default=0,
                    help="LoRA delta sync: serve base + adapters; pushes "
                         "carry only adapters (match the trainer's rank)")
@@ -342,6 +357,8 @@ def main() -> None:
                            kv_ledger=not args.no_kv_ledger,
                            kv_cold_after_dispatches=(
                                args.kv_cold_after_dispatches),
+                           kv_spill=not args.no_kv_spill,
+                           kv_spill_host_gb=args.kv_spill_host_gb,
                            lora_rank=args.lora_rank,
                            lora_alpha=args.lora_alpha)
     log.info("rollout server on %s", server.endpoint)

@@ -609,9 +609,11 @@ class RolloutServer:
         kv_info = getattr(self.engine, "kv_memory_info", None)
         if kv_info is not None:
             # KV memory plane (rollout/kvledger.py): residency tiers, the
-            # ledger↔pool reconciliation gauge, HBM truth — flat keys so
+            # ledger↔pool reconciliation gauge, HBM truth, and the host
+            # spill tier's kv_spilled_frac / kv_restore_rate — flat keys so
             # the manager's stats poller forwards kv_cold_page_frac /
-            # hbm_headroom_gb per instance ({} when rollout.kv_ledger=false)
+            # hbm_headroom_gb / kv_spilled_frac per instance
+            # ({} when rollout.kv_ledger=false)
             info.update(kv_info())
         if self.receiver is not None:
             # weight-sync health (transfer/agents.py ReceiverAgent.health):

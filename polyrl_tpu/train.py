@@ -123,7 +123,12 @@ def _build_rollout(cfg: RunConfig, mcfg, params, tokenizer, cleanup: list):
                 group_preref_ttl_s=cfg.rollout.group_preref_ttl_s,
                 kv_ledger=cfg.rollout.kv_ledger,
                 kv_cold_after_dispatches=(
-                    cfg.rollout.kv_cold_after_dispatches), **kwargs)
+                    cfg.rollout.kv_cold_after_dispatches),
+                kv_spill=cfg.rollout.kv_spill,
+                kv_spill_host_gb=cfg.rollout.kv_spill_host_gb,
+                kv_spill_high_watermark=cfg.rollout.kv_spill_high_watermark,
+                kv_spill_low_watermark=(
+                    cfg.rollout.kv_spill_low_watermark), **kwargs)
         from polyrl_tpu.rollout.engine import RolloutEngine
 
         kwargs = {}
@@ -221,6 +226,10 @@ def _build_rollout(cfg: RunConfig, mcfg, params, tokenizer, cleanup: list):
             group_preref_ttl_s=cfg.rollout.group_preref_ttl_s,
             kv_ledger=cfg.rollout.kv_ledger,
             kv_cold_after_dispatches=cfg.rollout.kv_cold_after_dispatches,
+            kv_spill=cfg.rollout.kv_spill,
+            kv_spill_host_gb=cfg.rollout.kv_spill_host_gb,
+            kv_spill_high_watermark=cfg.rollout.kv_spill_high_watermark,
+            kv_spill_low_watermark=cfg.rollout.kv_spill_low_watermark,
             **({"prompt_buckets": tuple(cfg.rollout.prompt_buckets)}
                if cfg.rollout.prompt_buckets else {}))
         local_server = RolloutServer(eng, host="127.0.0.1", port=0)

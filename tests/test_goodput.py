@@ -206,7 +206,7 @@ def test_statusz_server_and_prometheus(tmp_path):
     srv = StatuszServer(lambda: snap).start()
     try:
         got = _get_json(f"http://{srv.endpoint}/statusz")
-        assert got["schema"] == "polyrl/statusz/v6"
+        assert got["schema"] == "polyrl/statusz/v7"
         assert got["role"] == "trainer" and got["step"] == 7
         # every schema section always present
         for section in ("goodput", "histograms", "counters", "gauges",
@@ -588,7 +588,7 @@ def test_e2e_goodput_statusz_and_stall_bundle(stall_stack, tmp_path):
         assert r_snap["weights"]["version"] >= 1.0
         assert r_snap["counters"]["fault/injected_stalls"] == 1.0
         # (b') the v4 timeseries rail is live on BOTH planes
-        assert t_snap["schema"] == "polyrl/statusz/v6"
+        assert t_snap["schema"] == "polyrl/statusz/v7"
         t_ts = t_snap["timeseries"]
         assert t_ts["tracked_keys"] >= 1
         # global_step climbs by exactly 1 per step -> OLS slope 1.0

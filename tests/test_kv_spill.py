@@ -1,0 +1,279 @@
+"""Host-RAM KV spill tier (ARCHITECTURE.md "KV spill tier"): cold
+published KV pages out to a pinned host pool and back — greedy decode
+after a spill→restore round trip is bitwise the never-spilled engine's
+(restore lands at a NEW physical index; the page-table indirection makes
+relocation invisible), dropping spilled content (flush / stop) frees
+BOTH tiers, the ledger's ``spilled`` logical role reconciles exactly
+into ``attributed_frac``, capacity eviction prefers the coldest entries
+by ledger idle age, and the off-switches (``kv_spill=False`` or
+``kv_ledger=False``) leave the engine bitwise identical."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from polyrl_tpu.models import decoder
+from polyrl_tpu.rollout.cb_engine import CBEngine
+from polyrl_tpu.rollout.kvspill import HostSpillPool
+from polyrl_tpu.rollout.sampling import SamplingParams
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = decoder.get_config("tiny")
+    params = decoder.init_params(jax.random.PRNGKey(0), cfg)
+    return cfg, params
+
+
+def _mk_engine(tiny, **kw):
+    cfg, params = tiny
+    defaults = dict(max_slots=2, page_size=8, max_seq_len=48,
+                    prompt_buckets=(32,), num_pages=20,
+                    kv_cold_after_dispatches=2)
+    defaults.update(kw)
+    return CBEngine(cfg, params, **defaults)
+
+
+def _quiesce(eng):
+    import time
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < 30:
+        if not eng._active.any() and not eng._pending \
+                and eng._queue.empty():
+            time.sleep(0.2)
+            if not eng._active.any():
+                return
+        time.sleep(0.05)
+    raise AssertionError("engine did not quiesce")
+
+
+GREEDY = SamplingParams(temperature=0.0, max_new_tokens=8,
+                        stop_token_ids=())
+
+
+def _prompts(cfg, n, length=32, seed=0):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(1, cfg.vocab_size, length).tolist()
+            for _ in range(n)]
+
+
+# -- host pool unit ----------------------------------------------------------
+
+
+def test_host_pool_spill_fetch_drop_roundtrip():
+    """HostSpillPool round trip: spilled device slices come back byte-
+    identical (background copy or the sync-fetch fallback), drop frees
+    residency, and capacity gating refuses what does not fit."""
+    rng = np.random.default_rng(0)
+    k = jnp.asarray(rng.normal(size=(2, 4, 3, 8, 16)).astype(np.float32))
+    v = jnp.asarray(rng.normal(size=(2, 4, 3, 8, 16)).astype(np.float32))
+    page_bytes = k[:, :, 0].nbytes + v[:, :, 0].nbytes
+    pool = HostSpillPool(capacity_bytes=page_bytes * 8)
+    try:
+        assert pool.can_spill(3, page_bytes)
+        handles = pool.spill(k, v, 3, page_bytes)
+        assert len(handles) == 3
+        for i, h in enumerate(handles):
+            kh, vh = pool.fetch(h)
+            np.testing.assert_array_equal(kh, np.asarray(k[:, :, i]))
+            np.testing.assert_array_equal(vh, np.asarray(v[:, :, i]))
+        assert pool.resident_pages == 3
+        assert not pool.can_spill(6, page_bytes)  # over capacity
+        pool.drop(handles[:1], restored=True)
+        pool.drop(handles[1:])
+        s = pool.stats()
+        assert pool.resident_pages == 0 and s["resident_bytes"] == 0
+        assert s["bytes_spilled"] == 3 * page_bytes
+        assert s["bytes_restored"] == 1 * page_bytes
+    finally:
+        pool.stop()
+
+
+# -- spill -> restore -> decode parity ---------------------------------------
+
+
+def test_spill_restore_decode_parity(tiny):
+    """Session-resume under an HBM-capped pool: spilled sessions restore
+    on the prefix hit and the resumed greedy output is BITWISE the
+    big-pool never-spilled engine's; logprobs match to 5e-4."""
+    cfg, _ = tiny
+    prompts = _prompts(cfg, 6)
+
+    def run(num_pages, spill):
+        eng = _mk_engine(tiny, num_pages=num_pages, kv_spill=spill)
+        try:
+            est = eng.generate(prompts, GREEDY, timeout=120.0)
+            resumed = [eng.generate([p], GREEDY, timeout=120.0)[0]
+                       for p in prompts]
+            _quiesce(eng)
+            info = eng.kv_memory_info()
+            return est, resumed, info
+        finally:
+            eng.stop()
+
+    # capped pool (6 sessions x 3 published pages vs 19 alloc pages,
+    # 5 active pages per slot) vs a never-spilled big pool
+    est_s, res_s, info_s = run(20, True)
+    est_r, res_r, _ = run(128, False)
+    assert info_s["memory/pages_spilled"] > 0, "pressure must spill"
+    assert info_s["memory/pages_restored"] > 0, "resume must restore"
+    for a, b in zip(est_s + res_s, est_r + res_r):
+        assert a["finish_reason"] == b["finish_reason"] != "abort"
+        assert a["token_ids"] == b["token_ids"]  # bitwise
+        np.testing.assert_allclose(a["logprobs"], b["logprobs"], atol=5e-4)
+
+
+def test_restore_lands_at_new_physical_index(tiny):
+    """Relocation safety (the salvage-republish argument): restore
+    allocates FRESH pages — with the freed indices re-occupied, the
+    restored chain lives at different physical pages yet greedy decode
+    continues bitwise."""
+    cfg, _ = tiny
+    eng = _mk_engine(tiny, num_pages=32, kv_spill=True)
+    try:
+        [p] = _prompts(cfg, 1)
+        first = eng.generate([p], GREEDY, timeout=120.0)[0]
+        _quiesce(eng)
+        orig = sorted(e.page for e in eng.prefix_cache.spill_candidates())
+        assert orig, "finalize must publish the session's pages"
+        n = eng._spill_pages(len(orig), cold_only=False)
+        assert n == len(orig)
+        assert eng.kvledger.spilled_pages == n
+        # occupy the LIFO-freed indices so the restore cannot land back
+        # on the original physical pages
+        held = eng.allocator.alloc(len(orig))
+        assert held is not None
+        resumed = eng.generate([p], GREEDY, timeout=120.0)[0]
+        _quiesce(eng)
+        eng.allocator.free(held)
+        assert eng.kvledger.pages_restored == n
+        fresh = sorted(e.page for e in eng.prefix_cache.spill_candidates())
+        assert not set(fresh) & set(orig), \
+            "restore must have landed at new physical indices"
+        assert resumed["token_ids"] == first["token_ids"]
+        np.testing.assert_allclose(resumed["logprobs"], first["logprobs"],
+                                   atol=5e-4)
+    finally:
+        eng.stop()
+
+
+# -- both tiers free on drop -------------------------------------------------
+
+
+def test_flush_while_spilled_frees_both_tiers(tiny):
+    """Spilled content dying without a restore (cache flush — the same
+    hook abort/stop churn rides) frees the host tier AND settles the
+    ledger's logical role; everything reconciles back to all-free."""
+    cfg, _ = tiny
+    eng = _mk_engine(tiny, num_pages=32, kv_spill=True)
+    try:
+        eng.generate(_prompts(cfg, 2), GREEDY, timeout=120.0)
+        _quiesce(eng)
+        n = eng._spill_pages(64, cold_only=False)
+        assert n > 0
+        assert eng.kvspill.resident_pages == n
+        eng.flush_prefix_cache()
+        _quiesce(eng)
+        assert eng.kvspill.resident_pages == 0, "host tier must free"
+        assert eng.kvledger.spilled_pages == 0
+        assert eng.kvledger.spill_drops == n
+        snap = eng.kv_memory_snapshot()
+        assert snap["reconcile"]["attributed_frac"] == 1.0
+        assert snap["reconcile"]["ledger_free"] == eng.num_pages - 1
+        assert snap["spill"]["spill_drops"] == n
+    finally:
+        eng.stop()
+
+
+# -- reconciliation with the spilled role ------------------------------------
+
+
+def test_reconciles_exactly_with_spilled_counted(tiny):
+    """attributed_frac == 1.0 EXACTLY at quiescence while pages sit in
+    the host tier: published + preref + spilled must equal cache
+    residency, spilled physical indices count as free."""
+    cfg, _ = tiny
+    eng = _mk_engine(tiny, num_pages=16, kv_spill=True)
+    try:
+        for p in _prompts(cfg, 6):
+            eng.generate([p], GREEDY, timeout=120.0)
+        _quiesce(eng)
+        snap = eng.kv_memory_snapshot()
+        assert snap["spill"]["spilled_pages"] > 0, \
+            "oversubscription must leave sessions on the host tier"
+        assert snap["roles"]["spilled"] == snap["spill"]["spilled_pages"]
+        rec = snap["reconcile"]
+        assert rec["attributed_frac"] == 1.0
+        assert rec["ledger_free"] == rec["pool_free"] \
+            == eng.allocator.free_count
+        assert rec["ledger_cache"] == rec["cache_pages"] \
+            == eng.prefix_cache.num_entries
+        # host-pool truth rides the statusz block
+        assert snap["spill"]["host"]["resident_pages"] \
+            == snap["spill"]["spilled_pages"]
+        info = eng.kv_memory_info()
+        assert info["kv_spilled_frac"] > 0.0
+    finally:
+        eng.stop()
+
+
+# -- cold-first capacity eviction --------------------------------------------
+
+
+def test_capacity_eviction_prefers_cold_entries(tiny):
+    """With the ledger's idle-age hook wired, capacity eviction removes
+    the COLDEST unreferenced entries first (not publish order), and the
+    ``prefix_cache/evict_cold_first`` counter books it."""
+    cfg, _ = tiny
+    eng = _mk_engine(tiny, num_pages=64, kv_spill=False)
+    try:
+        pa, pb, filler = _prompts(cfg, 3)
+        eng.generate([pa], GREEDY, timeout=120.0)
+        _quiesce(eng)
+        pages_a = {e.page for e in eng.prefix_cache.spill_candidates()}
+        # age A: unrelated decode work advances the dispatch clock
+        eng.generate([filler], GREEDY, timeout=120.0)
+        eng.generate([pb], GREEDY, timeout=120.0)
+        _quiesce(eng)
+        all_pages = {e.page for e in eng.prefix_cache.spill_candidates()}
+        assert len(all_pages) > len(pages_a)
+        freed = eng.prefix_cache.evict(len(pages_a))
+        assert freed >= len(pages_a)
+        left = {e.page for e in eng.prefix_cache.spill_candidates()}
+        assert not left & pages_a, "coldest (oldest-idle) must go first"
+        assert eng.prefix_cache.stats()["prefix_cache/evict_cold_first"] > 0
+    finally:
+        eng.stop()
+
+
+# -- off-switches ------------------------------------------------------------
+
+
+def test_spill_off_is_bitwise_identical(tiny):
+    """``kv_spill=False`` (and ``kv_ledger=False``, which disables spill
+    structurally) restores the pre-spill engine: same capped-pool
+    workload, greedy output bitwise identical — eviction-and-recompute
+    and spill-and-restore may differ in cost, never in tokens."""
+    cfg, _ = tiny
+    assert _mk_engine(tiny, kv_ledger=False).kvspill is None
+    prompts = _prompts(cfg, 6)
+
+    def run(**kw):
+        eng = _mk_engine(tiny, **kw)
+        try:
+            est = eng.generate(prompts, GREEDY, timeout=120.0)
+            res = [eng.generate([p], GREEDY, timeout=120.0)[0]
+                   for p in prompts]
+            return est + res, eng
+        finally:
+            eng.stop()
+
+    out_on, eng_on = run(kv_spill=True)
+    out_off, eng_off = run(kv_spill=False)
+    assert eng_on.kvspill is not None and eng_off.kvspill is None
+    assert eng_off.kv_memory_info()["memory/pages_spilled"] == 0
+    for a, b in zip(out_on, out_off):
+        assert a["token_ids"] == b["token_ids"]
+        assert a["logprobs"] == b["logprobs"]  # exact, not approx
+        assert a["finish_reason"] == b["finish_reason"]

@@ -156,6 +156,12 @@ WATCHED_EXTRA = (
     # the page pool's margin
     ("engine_kv_cold_page_frac", "high"),
     ("engine_hbm_headroom_gb", "low"),
+    # host-RAM KV spill tier (bench.py --kv-spill A/B): the
+    # sessions-per-chip multiplier over the HBM-capped baseline must
+    # hold, and the restore rate must not climb (pages thrashing between
+    # host and HBM means the watermarks are fighting the workload)
+    ("kv_spill.sessions_speedup", "low"),
+    ("kv_spill.restore_rate", "high"),
 )
 
 

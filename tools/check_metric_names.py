@@ -76,7 +76,13 @@ and the per-cause free split ``memory/freed_<cause>`` — alongside the
 ``engine/kv_{hot,warm,cold}_page_frac`` residency tiers and
 ``engine/hbm_{used,headroom,unaccounted}_gb`` HBM-truth gauges, all
 riding ``server_info`` and aggregated fleet-wide in rollout/pool.py
-(worst-case: max cold fraction, min headroom). New metric
+(worst-case: max cold fraction, min headroom). The host-RAM KV spill
+tier (rollout/kvspill.py) extends the same namespace with
+``memory/spilled_pages`` (current host-resident pages),
+``memory/{pages_spilled,pages_restored,spill_drops}`` (cumulative
+spill/restore/drop traffic) and ``memory/{spill,restore}_bytes``,
+next to the ``engine/kv_spilled_frac`` + ``engine/kv_restore_rate``
+gauges the manager forwards per instance. New metric
 emitters in
 ``polyrl_tpu/`` are linted automatically; nothing needs registering —
 EXCEPT a new top-level namespace, which must be added to ``NAMESPACES``
@@ -139,9 +145,13 @@ NAMESPACES = frozenset({
                      # (rollout/autoscale.py)
     "memory",        # KV memory plane: ledger reconciliation
                      # (memory/attributed_frac), page churn + free-cause
-                     # counters riding server_info next to the
-                     # engine/kv_{hot,warm,cold}_page_frac residency tiers
-                     # and HBM truth gauges (rollout/kvledger.py)
+                     # counters, and the host-RAM spill tier's
+                     # memory/{spilled_pages,pages_spilled,pages_restored,
+                     # spill_drops,spill_bytes,restore_bytes} — riding
+                     # server_info next to the engine/kv_{hot,warm,cold}_
+                     # page_frac residency tiers, HBM truth gauges, and
+                     # engine/{kv_spilled_frac,kv_restore_rate}
+                     # (rollout/kvledger.py, rollout/kvspill.py)
 })
 
 # APIs whose first positional string argument IS a metric key

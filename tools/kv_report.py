@@ -113,6 +113,27 @@ def _render_ledger(mem: dict) -> list[str]:
                    f"pool free list {rec.get('pool_free', '?')}")
         out.append(f"  ledger cache {rec.get('ledger_cache', '?'):>8}  vs "
                    f"cache resident {rec.get('cache_pages', '?')}")
+    spill = mem.get("spill", {})
+    if spill:
+        out.append("")
+        out.append(f"host spill tier: {spill.get('spilled_pages', 0)} pages "
+                   f"({_gb(float(spill.get('spilled_bytes', 0)))}) on host; "
+                   f"{spill.get('pages_spilled', 0)} spilled / "
+                   f"{spill.get('pages_restored', 0)} restored / "
+                   f"{spill.get('spill_drops', 0)} dropped; "
+                   f"restore rate {_fmt(spill.get('restore_rate'))} "
+                   f"pages/dispatch")
+        out.append(f"  traffic: {_gb(float(spill.get('spill_bytes', 0)))} "
+                   f"out, {_gb(float(spill.get('restore_bytes', 0)))} back")
+        host = spill.get("host", {})
+        if host:
+            out.append(f"  host pool: {host.get('resident_pages', 0)} pages "
+                       f"resident ({_gb(float(host.get('resident_bytes', 0)))}"
+                       f" of {_gb(float(host.get('capacity_bytes', 0)))}), "
+                       f"{host.get('copy_batches', 0)} copy batches, "
+                       f"{host.get('sync_fetches', 0)} sync fetches, "
+                       f"lane {host.get('lane_inflight', 0)}/"
+                       f"{host.get('lane_depth', 0)}")
     churn = mem.get("churn", {})
     if churn:
         out.append("")
@@ -163,15 +184,24 @@ def _render_fleet(mem: dict) -> list[str]:
                f"{_fmt(fleet.get('kv_cold_page_frac_max'))}"
                + (f", HBM headroom min = "
                   f"{_fmt(fleet.get('hbm_headroom_gb_min'))} GB"
-                  if "hbm_headroom_gb_min" in fleet else ""))
+                  if "hbm_headroom_gb_min" in fleet else "")
+               + (f", spilled frac max = "
+                  f"{_fmt(fleet.get('kv_spilled_frac_max'))}"
+                  if "kv_spilled_frac_max" in fleet else "")
+               + (f", restore rate max = "
+                  f"{_fmt(fleet.get('kv_restore_rate_max'))}"
+                  if "kv_restore_rate_max" in fleet else ""))
     engines = mem.get("engines", [])
     if engines:
         out.append("")
-        out.append(f"{'endpoint':<28} {'cold_frac':>10} {'headroom_gb':>12}")
+        out.append(f"{'endpoint':<28} {'cold_frac':>10} {'headroom_gb':>12} "
+                   f"{'spilled':>8} {'restore/d':>10}")
         for e in engines:
             out.append(f"{e.get('endpoint', '?'):<28} "
                        f"{_fmt(e.get('kv_cold_page_frac')):>10} "
-                       f"{_fmt(e.get('hbm_headroom_gb')):>12}")
+                       f"{_fmt(e.get('hbm_headroom_gb')):>12} "
+                       f"{_fmt(e.get('kv_spilled_frac')):>8} "
+                       f"{_fmt(e.get('kv_restore_rate')):>10}")
     return out
 
 
