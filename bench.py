@@ -630,6 +630,15 @@ def bench_cb(cfg, params, batch, prompt_len, new_tokens, max_slots=64,
         **({"engine_hbm_headroom_gb": round(float(
             srv_info["hbm_headroom_gb"]), 3)}
            if "hbm_headroom_gb" in srv_info else {}),
+        # engine-loop profiler (obs/engine_profile.py via server_info):
+        # the windowed device-vs-host split at end of run — device_frac
+        # dropping across rounds means the loop thread got host-bound,
+        # accounting_frac rising means the deck/ledger/spill bookkeeping
+        # started eating the loop (the two gauges bench_gate holds)
+        "engine_device_frac": round(float(srv_info.get(
+            "device_frac", 0.0)), 4),
+        "engine_accounting_frac": round(float(srv_info.get(
+            "accounting_frac", 0.0)), 4),
     }
 
 
@@ -1889,6 +1898,94 @@ def group_share_bench(preset: str = "tiny", g: int = 8, groups: int = 4,
     }
 
 
+def loop_profile_bench(preset: str = "tiny", batch: int = 16,
+                       prompt_len: int = 64, new_tokens: int = 32,
+                       reps: int = 3) -> dict:
+    """Engine-loop profiler self-overhead A/B (``python bench.py
+    --loop-profile``): the same concurrent workload through two CB
+    engines — profiler ON (the serving default: per-iteration phase
+    attribution, clock reads + fold locks on the loop thread) vs OFF
+    (``loop_profile=False``, the pre-profiler loop and the bitwise
+    baseline). Best-of-``reps`` timed walls on each side so one scheduler
+    hiccup doesn't read as profiler overhead. Extras carry the ON
+    engine's own verdict on itself — ``attributed_frac`` (must stay ~1.0
+    under real churn), the windowed ``device_frac`` and the
+    ``accounting_frac`` the overhead budget pins. CPU-sized by default;
+    scale via env/flags on a real chip."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from polyrl_tpu.models import decoder
+    from polyrl_tpu.rollout.cb_engine import STREAM_END, CBEngine
+    from polyrl_tpu.rollout.sampling import SamplingParams
+
+    cfg = decoder.get_config(preset, dtype=jnp.float32 if preset == "tiny"
+                             else jnp.bfloat16)
+    params = jax.jit(lambda: decoder.init_params(jax.random.PRNGKey(0),
+                                                 cfg))()
+    page_size = min(64, prompt_len)
+    seq_pages = -(-(prompt_len + new_tokens) // page_size)
+    rng = np.random.default_rng(7)
+    sp = SamplingParams(temperature=1.0, max_new_tokens=new_tokens,
+                        stop_token_ids=())
+
+    def run(profile: bool) -> dict:
+        eng = CBEngine(
+            cfg, params, max_slots=min(batch, 16), page_size=page_size,
+            max_seq_len=seq_pages * page_size, prompt_buckets=(prompt_len,),
+            num_pages=batch * seq_pages * 2, steps_per_dispatch=4,
+            loop_profile=profile)
+        eng.start()
+
+        def drive(tag: str) -> tuple[float, int]:
+            outs = [eng.submit(
+                f"{tag}-{i}",
+                rng.integers(1, cfg.vocab_size, prompt_len).tolist(), sp)
+                for i in range(batch)]
+            t0 = time.monotonic()
+            total = 0
+            for q in outs:
+                while True:
+                    item = q.get(timeout=600)
+                    if item is STREAM_END:
+                        break
+                    total += len(item["token_ids"])
+            return time.monotonic() - t0, total
+
+        drive("warm")  # untimed: XLA compiles stay out of the timed reps
+        walls, total = [], 0
+        for r in range(reps):
+            wall, tok = drive(f"r{r}")
+            walls.append(wall)
+            total = tok
+        res = {
+            "loop_profile": profile,
+            "wall_s_best": round(min(walls), 3),
+            "wall_s": [round(w, 3) for w in walls],
+            "tok_s": round(total / min(walls), 1) if min(walls) > 0 else 0.0,
+        }
+        if profile:
+            res.update({k: round(float(v), 4)
+                        for k, v in eng.loop_profile_info().items()})
+        eng.stop()
+        return res
+
+    on = run(True)
+    off = run(False)
+    overhead = (on["wall_s_best"] / max(off["wall_s_best"], 1e-9) - 1.0)
+    return {
+        "batch": batch, "prompt_len": prompt_len, "new_tokens": new_tokens,
+        "reps": reps, "on": on, "off": off,
+        # headline: profiler wall cost as a fraction of the unprofiled
+        # loop (negative = measurement noise; the gate bounds the rise)
+        "overhead_pct": round(100.0 * overhead, 2),
+        "engine_device_frac": on.get("device_frac", 0.0),
+        "engine_accounting_frac": on.get("accounting_frac", 0.0),
+        "engine_loop_attributed_frac": on.get("loop_attributed_frac", 0.0),
+    }
+
+
 def kv_spill_bench(preset: str = "tiny", sessions: int = 12,
                    prompt_len: int = 64, new_tokens: int = 16,
                    page_size: int = 16, max_slots: int = 4) -> dict:
@@ -2172,7 +2269,8 @@ def assemble_result(state: dict) -> dict:
               "engine_tpot_p95_ms", "engine_attributed_frac",
               "engine_prefill_reuse_frac", "engine_shared_prefix_read_frac",
               "engine_kv_read_pages_per_token",
-              "engine_kv_cold_page_frac", "engine_hbm_headroom_gb"):
+              "engine_kv_cold_page_frac", "engine_hbm_headroom_gb",
+              "engine_device_frac", "engine_accounting_frac"):
         v = cb.get(k)
         if isinstance(v, (int, float)) and not isinstance(v, bool):
             extra[k] = v
@@ -2683,6 +2781,20 @@ if __name__ == "__main__":
         print(json.dumps({"metric": "group_share_dispatch_reduction",
                           "value": res["dispatch_reduction"], "unit": "x",
                           "extra": {"group_share": res}}))
+    elif "--loop-profile" in sys.argv:
+        # engine-loop profiler self-overhead A/B: profiler ON vs OFF at
+        # the same concurrent workload — its own entry, CPU-sized by
+        # default; the headline is the profiler's wall cost in percent
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        res = loop_profile_bench(
+            preset=os.environ.get("POLYRL_BENCH_PRESET", "tiny"),
+            batch=int(_cli_float("--batch", 16)),
+            prompt_len=int(_cli_float("--prompt-len", 64)),
+            new_tokens=int(_cli_float("--new-tokens", 32)),
+            reps=int(_cli_float("--reps", 3)))
+        print(json.dumps({"metric": "loop_profile_overhead_pct",
+                          "value": res["overhead_pct"], "unit": "%",
+                          "extra": {"loop_profile": res}}))
     elif "--kv-spill" in sys.argv:
         # host-RAM KV spill oversubscription A/B: session-resume workload
         # at a fixed HBM-capped page budget, spill vs capacity-evict, with

@@ -119,6 +119,13 @@ class RolloutSection:
     # re-arming the sweep page-by-page (spill/restore thrash)
     kv_spill_high_watermark: float = 0.92
     kv_spill_low_watermark: float = 0.80
+    # engine-loop profiler (obs/engine_profile.py; ARCHITECTURE.md
+    # "Engine-loop profiler"): per-iteration phase attribution of the CB
+    # engine's loop wall behind the ``engine.loop`` statusz block,
+    # ``engine/device_frac`` / ``engine/accounting_frac`` gauges and
+    # tools/engine_report.py. False restores the pre-profiler engine,
+    # bit for bit.
+    loop_profile: bool = True
     # disaggregated plumbing (reference rollout_manager.{port,endpoint},
     # workers/config/rollout.py:95-101)
     manager_endpoint: str = ""            # "" → spawn the C++ manager locally

@@ -371,6 +371,11 @@ class Manager {
               // (absent on spill-off engines — atomics keep their zeros)
               fwd("kv_spilled_frac", inst->kv_spilled_frac);
               fwd("kv_restore_rate", inst->kv_restore_rate);
+              // engine-loop profiler: device-vs-host wall split (absent on
+              // loop_profile-off engines — device_frac keeps its -1
+              // sentinel)
+              fwd("device_frac", inst->device_frac);
+              fwd("accounting_frac", inst->accounting_frac);
               if (info["draining"].as_bool() && !inst->draining.load()) {
                 log_line("instance " + inst->endpoint +
                          " announced draining; leaving routing set");
@@ -512,6 +517,12 @@ void register_routes(phttp::Server& server, Manager& mgr) {
         o["hbm_headroom_gb"] = Value(inst->hbm_headroom_gb.load());
       o["kv_spilled_frac"] = Value(inst->kv_spilled_frac.load());
       o["kv_restore_rate"] = Value(inst->kv_restore_rate.load());
+      // -1 sentinels "engine never reported a loop profile" (loop_profile
+      // off / pre-profiler); omitting the key keeps the fleet min honest
+      if (inst->device_frac.load() >= 0.0) {
+        o["device_frac"] = Value(inst->device_frac.load());
+        o["accounting_frac"] = Value(inst->accounting_frac.load());
+      }
       arr.push_back(Value(std::move(o)));
     }
     Object top;
@@ -592,6 +603,16 @@ void register_routes(phttp::Server& server, Manager& mgr) {
       per += "polyrl_mgr_instance_kv_restore_rate{endpoint=\"" +
              esc(inst->endpoint) + "\"} " +
              std::to_string(inst->kv_restore_rate.load()) + "\n";
+      // engine-loop profiler: whose loop thread stopped feeding the chip,
+      // and whose bookkeeping is eating the loop (-1 = unreported)
+      if (inst->device_frac.load() >= 0.0) {
+        per += "polyrl_mgr_instance_device_frac{endpoint=\"" +
+               esc(inst->endpoint) + "\"} " +
+               std::to_string(inst->device_frac.load()) + "\n";
+        per += "polyrl_mgr_instance_accounting_frac{endpoint=\"" +
+               esc(inst->endpoint) + "\"} " +
+               std::to_string(inst->accounting_frac.load()) + "\n";
+      }
       if (inst->healthy.load()) {
         occ_sum += inst->occupancy.load();
         ++occ_n;
@@ -649,6 +670,8 @@ void register_routes(phttp::Server& server, Manager& mgr) {
     body += "# TYPE polyrl_mgr_instance_hbm_headroom_gb gauge\n";
     body += "# TYPE polyrl_mgr_instance_kv_spilled_frac gauge\n";
     body += "# TYPE polyrl_mgr_instance_kv_restore_rate gauge\n";
+    body += "# TYPE polyrl_mgr_instance_device_frac gauge\n";
+    body += "# TYPE polyrl_mgr_instance_accounting_frac gauge\n";
     body += per;
     long total_reqs = 0;
     std::string per_route;

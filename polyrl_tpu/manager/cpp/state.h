@@ -73,6 +73,13 @@ struct Instance {
   // and the windowed restore rate in pages/dispatch (the thrash signal).
   std::atomic<double> kv_spilled_frac{0.0};
   std::atomic<double> kv_restore_rate{0.0};
+  // engine-loop profiler (obs/engine_profile.py): windowed fraction of the
+  // loop wall spent dispatching to / waiting on the device, and the
+  // bookkeeping (deck+ledger+spill sweep) fraction. device_frac < 0
+  // sentinels "not reported" (loop_profile off / pre-profiler engines) so
+  // the fleet min never counts an unreporting engine as 0.
+  std::atomic<double> device_frac{-1.0};
+  std::atomic<double> accounting_frac{0.0};
 };
 
 using InstancePtr = std::shared_ptr<Instance>;

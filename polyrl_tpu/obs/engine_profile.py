@@ -1,0 +1,290 @@
+"""Engine-loop profiler: exhaustive per-iteration phase attribution for
+the CB engine's loop thread (ARCHITECTURE.md "Engine-loop profiler").
+
+PRs 7/12/17/18 piled flight-deck, KV-ledger and spill-sweep bookkeeping
+onto the engine loop; the only record of where a dispatch's wall went was
+a private cumulative ``_trace`` dict that never left the process. This
+module is the rollout-side analogue of the trainer's goodput ledger
+(obs/goodput.py): every loop iteration's wall is decomposed into an
+exhaustive, NON-OVERLAPPING phase taxonomy whose sum equals the iteration
+wall by construction (the residual lands in ``other``), so
+``attributed_frac`` reads exactly like the goodput ledger's — the named
+phases over the wall, > 1.0 meaning double-counted attribution.
+
+Phase taxonomy (seconds, exclusive self-time):
+
+- ``collect_wave``  — admission wave assembly (slot+page reservation,
+  prefix-cache match, group fork bookkeeping)
+- ``restore``       — spill readmit: host→device KV restore of spilled
+  prefix pages (rollout/kvspill.py restore-then-attach)
+- ``prefill_dispatch`` — prefill/attach/chunk dispatch calls (host wall
+  spent in the dispatch enqueue + any synchronous device wait inside it)
+- ``decode_dispatch_device`` — device-state upload + the fused-k step
+  dispatch (the device wait inside the decode hot path)
+- ``sample_fetch``  — loop thread blocked on the fetcher's batched
+  ``device_get`` (plus the dead-fetcher synchronous fallback)
+- ``emit``          — streaming fetched tokens to request queues, host
+  mirror updates, finalize folds
+- ``accounting``    — deck + KV-ledger + dispatch bookkeeping (the
+  PR 7/17/18 overhead the regression budget pins)
+- ``spill_sweep``   — watermark sweep page-out (host spill tier writes)
+- ``idle``          — no work: queue waits and backoff sleeps
+- ``other``         — the unattributed residual (clamped at 0)
+
+Attribution is STACK-BASED with exclusive (self-time) semantics: the
+engine nests phases freely (``_drain_emit_q`` runs inside admission,
+``_spill_pages`` inside allocation pressure) and a nested phase's wall is
+charged to the nested phase, never double-counted against its parent.
+Stacks are thread-local, so the fetcher thread (or a unit test driving
+engine internals directly) can enter phases without corrupting the loop
+thread's iteration; cumulative totals fold under one lock.
+
+The windowed device-vs-host split (``device_frac`` /
+``host_overhead_frac`` / ``accounting_frac`` / ``idle_frac``) is computed
+over a two-bucket flip window (~``window_s`` of recent loop wall) so a
+long-lived engine reports CURRENT behaviour, not a run-lifetime average:
+
+- ``device_frac``          = (prefill_dispatch + decode_dispatch_device +
+  sample_fetch) / wall — host wall spent dispatching to or waiting on the
+  device (the utilization ceiling the disaggregation work steers on);
+- ``accounting_frac``      = (accounting + spill_sweep) / wall;
+- ``host_overhead_frac``   = 1 − device_frac − idle_frac — ALL host-side
+  work including the residual, so the three fracs + idle partition 1.
+
+Per-dispatch spans for the dispatch phases are emitted into the process
+tracer ring (obs/trace.py) when tracing is enabled, trace_id-joined with
+whatever context the serving layer adopted — ``tools/trace2perfetto.py``
+renders the engine-loop track beside the trainer's spans.
+
+The legacy ``_trace``/``_tmark`` seam (POLYRL_CB_TRACE) is absorbed here:
+:meth:`mark_legacy` keeps the cumulative ``{key: seconds, n_<key>}``
+counters ``/metrics`` has always rendered, owned by the profiler instead
+of a parallel dict.
+"""
+
+from __future__ import annotations
+
+import collections
+import contextlib
+import threading
+import time
+
+from polyrl_tpu.obs.histogram import Histogram
+from polyrl_tpu.obs.trace import get_tracer
+
+PHASES = ("collect_wave", "restore", "prefill_dispatch",
+          "decode_dispatch_device", "sample_fetch", "emit", "accounting",
+          "spill_sweep", "idle", "other")
+# host wall spent dispatching to / waiting on the device
+DEVICE_PHASES = frozenset(
+    ("prefill_dispatch", "decode_dispatch_device", "sample_fetch"))
+# the bookkeeping overhead the regression budget pins
+ACCOUNTING_PHASES = frozenset(("accounting", "spill_sweep"))
+# phases worth a tracer span each occurrence (dispatch-scale, not µs-scale)
+SPAN_PHASES = frozenset(
+    ("prefill_dispatch", "decode_dispatch_device", "sample_fetch",
+     "restore"))
+
+
+class EngineLoopProfiler:
+    """Exhaustive engine-loop phase attribution (module docstring).
+
+    ``clock`` is injectable for fake-clock tests (the partition pin drives
+    it deterministically so ``attributed_frac`` is exactly 1.0)."""
+
+    def __init__(self, window_s: float = 20.0, clock=time.monotonic,
+                 tracer=None):
+        self._clock = clock
+        self._tracer = tracer  # None → resolve the process tracer lazily
+        self._lock = threading.Lock()
+        self._tls = threading.local()
+        self.window_s = float(window_s)
+        self.iters = 0
+        self.wall_s = 0.0
+        self.totals = {p: 0.0 for p in PHASES}
+        self.counts = {p: 0 for p in PHASES}
+        self.hists = {p: Histogram() for p in PHASES if p != "other"}
+        # two-bucket flip window: [wall, device, accounting, idle] each;
+        # readers sum both buckets → ~window_s/2..window_s of loop wall
+        self._win_cur = [0.0, 0.0, 0.0, 0.0]
+        self._win_prev = [0.0, 0.0, 0.0, 0.0]
+        # legacy POLYRL_CB_TRACE counters (cumulative seconds + n_ counts);
+        # the fetcher thread marks "fetch" concurrently with loop marks
+        self._legacy: dict[str, float] = collections.defaultdict(float)
+
+    # -- thread-local attribution state --------------------------------------
+
+    def _state(self):
+        st = getattr(self._tls, "state", None)
+        if st is None:
+            # stack of [phase_name, self_seconds]; mark = last event time;
+            # iter_phases = per-iteration fold (loop thread only)
+            st = self._tls.state = {"stack": [], "mark": None,
+                                    "iter_phases": None, "iter_t0": None}
+        return st
+
+    def _attr(self, st, now: float) -> None:
+        """Charge the wall since the last event to the innermost open
+        phase (self-time). Time with an empty stack inside an iteration
+        becomes the ``other`` residual at iteration close."""
+        mark = st["mark"]
+        if mark is not None and st["stack"]:
+            st["stack"][-1][1] += now - mark
+        st["mark"] = now
+
+    # -- phases ---------------------------------------------------------------
+
+    @contextlib.contextmanager
+    def phase(self, name: str):
+        st = self._state()
+        self._attr(st, self._clock())
+        st["stack"].append([name, 0.0])
+        span_cm = None
+        if name in SPAN_PHASES:
+            tracer = self._tracer if self._tracer is not None \
+                else get_tracer()
+            if tracer.enabled:
+                span_cm = tracer.span("engine/" + name)
+                span_cm.__enter__()
+        try:
+            yield
+        finally:
+            if span_cm is not None:
+                span_cm.__exit__(None, None, None)
+            self._attr(st, self._clock())
+            _name, self_s = st["stack"].pop()
+            if st["iter_phases"] is not None:
+                st["iter_phases"][name] = (
+                    st["iter_phases"].get(name, 0.0) + self_s)
+            with self._lock:
+                self.totals[name] += self_s
+                self.counts[name] += 1
+                self.hists[name].observe(self_s)
+
+    @contextlib.contextmanager
+    def iteration(self):
+        """One ``_loop_iter`` window: phases inside fold into the
+        iteration's partition; the leftover wall (empty-stack time between
+        phases) lands in ``other`` so the sum equals the iteration wall by
+        construction."""
+        st = self._state()
+        t0 = self._clock()
+        st["iter_phases"] = {}
+        st["iter_t0"] = t0
+        st["mark"] = t0
+        try:
+            yield
+        finally:
+            now = self._clock()
+            self._attr(st, now)
+            phases, st["iter_phases"] = st["iter_phases"], None
+            st["iter_t0"] = None
+            wall = now - t0
+            attributed = sum(phases.values())
+            other = max(0.0, wall - attributed)
+            device = sum(phases.get(p, 0.0) for p in DEVICE_PHASES)
+            acct = sum(phases.get(p, 0.0) for p in ACCOUNTING_PHASES)
+            idle = phases.get("idle", 0.0)
+            with self._lock:
+                self.iters += 1
+                self.wall_s += wall
+                self.totals["other"] += other
+                cur = self._win_cur
+                cur[0] += wall
+                cur[1] += device
+                cur[2] += acct
+                cur[3] += idle
+                if cur[0] >= self.window_s / 2.0:
+                    self._win_prev = cur
+                    self._win_cur = [0.0, 0.0, 0.0, 0.0]
+
+    # -- legacy POLYRL_CB_TRACE counters -------------------------------------
+
+    def mark_legacy(self, key: str, dt: float) -> None:
+        with self._lock:
+            self._legacy[key] += dt
+            self._legacy["n_" + key] += 1
+
+    def legacy_report(self) -> dict:
+        with self._lock:
+            return dict(self._legacy)
+
+    # -- export ---------------------------------------------------------------
+
+    def attributed_frac(self) -> float:
+        """Named-phase seconds over the iteration wall (goodput-ledger
+        semantics): 1.0 when every iteration's wall is inside a phase,
+        > 1.0 means double-counted attribution. 1.0 before any
+        iteration."""
+        with self._lock:
+            if self.wall_s <= 0.0:
+                return 1.0
+            return (self.wall_s - self.totals["other"]) / self.wall_s
+
+    def _window(self) -> tuple[float, float, float, float]:
+        cur, prev = self._win_cur, self._win_prev
+        return tuple(cur[i] + prev[i] for i in range(4))
+
+    def window_fracs(self) -> dict:
+        """The windowed device-vs-host split over ~window_s of recent
+        loop wall; zeros before the first iteration closes."""
+        with self._lock:
+            wall, device, acct, idle = self._window()
+        if wall <= 0.0:
+            return {"wall_s": 0.0, "device_frac": 0.0,
+                    "host_overhead_frac": 0.0, "accounting_frac": 0.0,
+                    "idle_frac": 0.0}
+        device_f = device / wall
+        idle_f = idle / wall
+        return {
+            "wall_s": wall,
+            "device_frac": device_f,
+            # everything host-side that is neither device wait nor idle —
+            # includes the unattributed residual, so the three partition 1
+            "host_overhead_frac": max(0.0, 1.0 - device_f - idle_f),
+            "accounting_frac": acct / wall,
+            "idle_frac": idle_f,
+        }
+
+    def server_info_fields(self) -> dict:
+        """Flat keys merged into ``server_info`` (no ``/`` — the C++
+        manager's stats poller indexes them directly; the server's
+        time-series feed prefixes them as ``engine/*``)."""
+        w = self.window_fracs()
+        return {
+            "device_frac": round(w["device_frac"], 6),
+            "host_overhead_frac": round(w["host_overhead_frac"], 6),
+            "accounting_frac": round(w["accounting_frac"], 6),
+            "loop_attributed_frac": round(self.attributed_frac(), 6),
+        }
+
+    def snapshot(self) -> dict:
+        """The /statusz ``engine.loop`` block (both planes carry one; the
+        trainer's is the fleet aggregate in rollout/pool.py)."""
+        with self._lock:
+            totals = dict(self.totals)
+            counts = dict(self.counts)
+            iters = self.iters
+            wall = self.wall_s
+            hists = {p: {
+                "p50": h.percentile(50.0), "p95": h.percentile(95.0),
+                "p99": h.percentile(99.0),
+                "max": h.vmax if h.count else 0.0,
+                "mean": h.mean, "count": float(h.count),
+            } for p, h in self.hists.items() if h.count}
+        out = {
+            "enabled": True,
+            "iters": iters,
+            "wall_s": round(wall, 3),
+            "attributed_frac": round(
+                (wall - totals["other"]) / wall if wall > 0 else 1.0, 6),
+            "phase_s": {p: round(v, 4) for p, v in totals.items()},
+            "phase_frac": {p: round(v / wall, 4) if wall > 0 else 0.0
+                           for p, v in totals.items()},
+            "phase_n": {p: counts[p] for p in PHASES if counts[p]},
+            "window": {k: round(v, 4)
+                       for k, v in self.window_fracs().items()},
+        }
+        if hists:
+            out["latency"] = hists
+        return out

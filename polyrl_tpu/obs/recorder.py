@@ -24,6 +24,10 @@ bundle into the run directory:
                          ``memory_fn``; when wired) — page roles, residency
                          tiers, free-cause churn and the ledger↔pool
                          reconciliation at anomaly time
+    ``engine_profile.json`` — the fleet engine-loop profiler view
+                         (obs/engine_profile.py via ``engine_profile_fn``;
+                         when wired) — per-engine device-vs-host wall
+                         split at anomaly time
     ``memprof.pprof``  — best-effort ``jax.profiler.device_memory_profile``
                          snapshot (real devices only; silently skipped on
                          CPU or when jax is absent)
@@ -182,6 +186,12 @@ DEFAULT_WATCH = {
     # means pages are thrashing between host and HBM — spilled pages being
     # pulled straight back means the watermarks are fighting the workload
     "engine/kv_restore_rate": "high",
+    # engine-loop profiler (obs/engine_profile.py): device_frac DROPPING
+    # means an engine's loop thread stopped feeding the chip (host-bound
+    # regression); accounting_frac RISING means the deck/ledger/spill
+    # bookkeeping started eating the loop — both one-sided
+    "engine/device_frac": "low",
+    "engine/accounting_frac": "high",
 }
 
 
@@ -244,6 +254,11 @@ class FlightRecorder:
         # memory.json so a cold-frac / headroom anomaly bundle carries the
         # page roles, tiers, free-cause churn and reconciliation state
         self.memory_fn = None
+        # optional zero-arg callable returning the fleet engine-loop
+        # profiler view (PoolManager.loop_profile_section) — written as
+        # engine_profile.json so a device-frac/accounting-frac anomaly
+        # bundle carries the per-engine device-vs-host split
+        self.engine_profile_fn = None
 
     # -- step stream ---------------------------------------------------------
 
@@ -347,6 +362,16 @@ class FlightRecorder:
                 if memory_view:
                     with open(os.path.join(path, "memory.json"), "w") as f:
                         json.dump(memory_view, f, indent=2)
+            if self.engine_profile_fn is not None:
+                try:
+                    profile_view = dict(self.engine_profile_fn())
+                except Exception:  # noqa: BLE001 — best-effort like counters
+                    log.exception("flight recorder engine_profile_fn failed")
+                    profile_view = {}
+                if profile_view:
+                    with open(os.path.join(path, "engine_profile.json"),
+                              "w") as f:
+                        json.dump(profile_view, f, indent=2)
             try:
                 # device memory profile: only real backends serve one (the
                 # CPU test backend raises / returns nothing useful) — any

@@ -8,10 +8,11 @@ trainer mounts a standalone :class:`StatuszServer` (it has no HTTP surface
 of its own); the rollout server mounts ``/statusz`` as a route on its
 existing listener (rollout/server.py).
 
-Schema (``polyrl/statusz/v7`` — additive evolution only; v2 added the
+Schema (``polyrl/statusz/v8`` — additive evolution only; v2 added the
 ``engine`` section, v3 the ``training`` section, v4 the ``timeseries``
 section, v5 the ``autoscale`` section, v6 the ``memory`` section, v7 the
-``spill`` block inside ``memory`` (host-RAM KV spill tier);
+``spill`` block inside ``memory`` (host-RAM KV spill tier), v8 the
+``loop`` block inside ``engine`` (engine-loop profiler);
 version-history table in ARCHITECTURE.md "Observability"):
 
 - ``role``      — ``trainer`` | ``rollout``
@@ -30,7 +31,15 @@ version-history table in ARCHITECTURE.md "Observability"):
   lifecycle tails (TTFT/TPOT/queue wait), slot occupancy, page-pool
   utilization, token-accounting reconciliation. Rollout role serves its
   own ledger; trainer role serves the fleet aggregate from PoolManager
-  sweeps; empty elsewhere.
+  sweeps; empty elsewhere. Since v8 it ALWAYS carries a ``loop`` block
+  (obs/engine_profile.py): exhaustive per-iteration phase attribution of
+  the engine loop's wall (``attributed_frac`` pinned to 1.0,
+  goodput-ledger style), per-phase log2 latency summaries, and the
+  windowed device-vs-host split (``device_frac`` /
+  ``host_overhead_frac`` / ``accounting_frac`` / ``idle_frac``).
+  ``{"enabled": false}`` when ``rollout.loop_profile`` is off or the
+  engine has no loop profiler; the trainer's is the fleet view keyed by
+  instance.
 - ``training``  — the training health plane (obs/rlhealth.py): last
   finalized ``training/*`` gauges (entropy/KL mirrors, degenerate-group
   fraction, per-token weight-version staleness) plus a short per-step
@@ -60,7 +69,7 @@ version-history table in ARCHITECTURE.md "Observability"):
   PoolManager sweeps; empty elsewhere (and with
   ``rollout.kv_ledger=false``).
 
-Every v7 section is ALWAYS present on both planes (conformance-tested) so
+Every v8 section is ALWAYS present on both planes (conformance-tested) so
 consumers never need existence checks.
 
 ``GET /metrics`` on the same listener renders the snapshot's numeric
@@ -80,7 +89,7 @@ from typing import Callable
 
 log = logging.getLogger(__name__)
 
-SCHEMA = "polyrl/statusz/v7"
+SCHEMA = "polyrl/statusz/v8"
 _PROC_T0 = time.monotonic()
 _HIST_SUFFIXES = ("p50", "p95", "p99", "max", "mean", "count")
 

@@ -43,6 +43,7 @@ colocated time-slicing.
 from __future__ import annotations
 
 import collections
+import contextlib
 import dataclasses
 import logging
 import os
@@ -57,6 +58,7 @@ import numpy as np
 
 from polyrl_tpu import obs
 from polyrl_tpu.models import decoder
+from polyrl_tpu.obs.engine_profile import EngineLoopProfiler
 from polyrl_tpu.rollout.engine import next_bucket
 from polyrl_tpu.rollout.flightdeck import EngineFlightDeck, ThroughputEWMA
 from polyrl_tpu.rollout.kvledger import PageLedger
@@ -73,6 +75,11 @@ log = logging.getLogger(__name__)
 STREAM_END = object()  # terminal marker on every request's output queue
 
 MAX_STOP_TOKENS = 8
+
+# reusable no-op phase context (contextlib.nullcontext is reentrant):
+# _phase() hands this out when the loop profiler is off so the hot path
+# pays one attribute read, not an allocation
+_NULL_PHASE = contextlib.nullcontext()
 
 
 def device_ngram_propose(tok_buf: jnp.ndarray, hist_len: jnp.ndarray,
@@ -218,6 +225,7 @@ class CBEngine:
         kv_spill_host_gb: float = 4.0,
         kv_spill_high_watermark: float = 0.92,
         kv_spill_low_watermark: float = 0.80,
+        loop_profile: bool = True,
     ):
         if any(b % page_size for b in prompt_buckets):
             raise ValueError("prompt buckets must be page-aligned")
@@ -483,15 +491,48 @@ class CBEngine:
         # of the trainer's marked_timer spans (SURVEY.md §5.1)
         if trace is None:  # explicit arg wins; env is the ops-facing toggle
             trace = bool(os.environ.get("POLYRL_CB_TRACE"))
-        self._trace: dict | None = (collections.defaultdict(float)
-                                    if trace else None)
+        self._trace_enabled = bool(trace)
+        # engine-loop profiler (obs/engine_profile.py): exhaustive phase
+        # attribution of every loop iteration, the windowed device-vs-host
+        # split, and the accounting-overhead gauge. When on it ABSORBS the
+        # legacy trace seam (one accounting path: _tmark feeds the
+        # profiler's legacy counters). rollout.loop_profile=False restores
+        # the pre-profiler loop bit for bit — the profiler never touches
+        # RNG, device state or scheduling, only clocks around them.
+        self.profiler = EngineLoopProfiler() if loop_profile else None
+        self._trace: dict | None = (
+            collections.defaultdict(float)
+            if trace and self.profiler is None else None)
         # the fetcher thread marks "fetch"; += on a shared dict is a
         # non-atomic read-modify-write against the loop thread's marks
         self._trace_lock = threading.Lock()
 
     def trace_report(self) -> dict:
         """Cumulative seconds per phase (POLYRL_CB_TRACE=1), else empty."""
+        if self.profiler is not None:
+            return self.profiler.legacy_report() if self._trace_enabled \
+                else {}
         return dict(self._trace or {})
+
+    def _phase(self, name: str):
+        """Profiler phase context for ``name`` (no-op when off)."""
+        prof = self.profiler
+        return prof.phase(name) if prof is not None else _NULL_PHASE
+
+    def loop_profile_info(self) -> dict:
+        """Flat server_info fields for the loop profiler ({} when off).
+        Safe from HTTP handler threads: the profiler locks internally."""
+        if self.profiler is None:
+            return {}
+        return self.profiler.server_info_fields()
+
+    def loop_profile_snapshot(self) -> dict:
+        """The /statusz ``engine.loop`` block (always present: a disabled
+        profiler reports ``{"enabled": False}`` so one curl answers
+        whether the plane is on)."""
+        if self.profiler is None:
+            return {"enabled": False}
+        return self.profiler.snapshot()
 
     # -- KV memory plane (rollout/kvledger.py) -------------------------------
 
@@ -605,6 +646,10 @@ class CBEngine:
             return 0
         if not self.kvspill.lane_free():
             return 0  # copy lane full: double-buffer backpressure
+        with self._phase("spill_sweep"):
+            return self._spill_pages_inner(target, cold_only)
+
+    def _spill_pages_inner(self, target: int, cold_only: bool) -> int:
         age = self.kvledger.idle_age
         cands = [(age(e.page), e) for e in self.prefix_cache.spill_candidates()]
         if cold_only:
@@ -658,6 +703,10 @@ class CBEngine:
         restored chain simply decodes solo. Returns False (nothing
         restored) when no pages can be found even after spilling colder
         pages / evicting the cache."""
+        with self._phase("restore"):
+            return self._restore_entries_inner(entries)
+
+    def _restore_entries_inner(self, entries: list) -> bool:
         need = len(entries)
         pages = self.allocator.alloc(need)
         while pages is None and self._outstanding():
@@ -740,7 +789,11 @@ class CBEngine:
                      for side in pools)
 
     def _tmark(self, key: str, t0: float) -> None:
-        if self._trace is not None:
+        if self.profiler is not None:
+            # one accounting path: the profiler owns the legacy counters
+            if self._trace_enabled:
+                self.profiler.mark_legacy(key, time.monotonic() - t0)
+        elif self._trace is not None:
             with self._trace_lock:
                 self._trace[key] += time.monotonic() - t0
                 self._trace["n_" + key] += 1
@@ -1567,9 +1620,17 @@ class CBEngine:
     # -- engine loop ---------------------------------------------------------
 
     def _loop(self) -> None:
+        prof = self.profiler
         while not self._stop.is_set():
             try:
-                self._loop_iter()
+                if prof is not None:
+                    # each iteration is one profiler attribution window:
+                    # phase self-times partition its wall, the leftover
+                    # lands in the `other` residual (engine_profile.py)
+                    with prof.iteration():
+                        self._loop_iter()
+                else:
+                    self._loop_iter()
             except Exception:  # noqa: BLE001 — loop must survive anything:
                 # a dead loop wedges every connected HTTP handler forever
                 log.exception("engine iteration failed; resetting")
@@ -1579,7 +1640,8 @@ class CBEngine:
         if self._paused.is_set():
             self._drain_emit_q()
             self._idle.set()
-            time.sleep(0.02)
+            with self._phase("idle"):
+                time.sleep(0.02)
             return
         self._drain_queue()
         if (not self._pending and not self._active.any()
@@ -1588,7 +1650,9 @@ class CBEngine:
             self.deck.on_idle()
             self._idle.set()
             try:
-                self._pending.append(self._queue.get(timeout=0.05))
+                with self._phase("idle"):
+                    req = self._queue.get(timeout=0.05)
+                self._pending.append(req)
             except queue.Empty:
                 pass
             return
@@ -1602,12 +1666,14 @@ class CBEngine:
                 # with the decode step below instead of monopolizing the
                 # device for the whole prefill
                 t0 = time.monotonic()
-                self._advance_chunk_job()
+                with self._phase("prefill_dispatch"):
+                    self._advance_chunk_job()
                 self._tmark("chunk_prefill", t0)
             if self._active.any():
                 self._step_once()
             elif self._pending and not self._chunk_jobs:
-                time.sleep(0.005)  # pending but blocked on pages/slots
+                with self._phase("idle"):
+                    time.sleep(0.005)  # pending but blocked on pages/slots
 
     def _abort_chunk_jobs(self) -> None:
         while self._chunk_jobs:
@@ -1652,20 +1718,24 @@ class CBEngine:
     GROUP_PREREF_TTL_S = 30.0
 
     def _admit(self) -> None:
-        self._sweep_group_prerefs()
+        with self._phase("accounting"):
+            self._sweep_group_prerefs()
         while self._pending:
-            wave, kind = self._collect_wave()
+            with self._phase("collect_wave"):
+                wave, kind = self._collect_wave()
             if not wave:
                 break
             try:
                 t0 = time.monotonic()
-                if len(wave) == 1:
-                    req, slot, pages, budget, mp, me = wave[0]
-                    self._prefill_request(slot, req, pages, budget, mp, me)
-                elif kind == "attach":
-                    self._prefill_attach_wave(wave)
-                else:
-                    self._prefill_wave(wave)
+                with self._phase("prefill_dispatch"):
+                    if len(wave) == 1:
+                        req, slot, pages, budget, mp, me = wave[0]
+                        self._prefill_request(slot, req, pages, budget,
+                                              mp, me)
+                    elif kind == "attach":
+                        self._prefill_attach_wave(wave)
+                    else:
+                        self._prefill_wave(wave)
                 self.prefill_dispatches += 1
                 self._tmark("prefill_dispatch", t0)
                 self.deck.on_admit_wave(len(wave))
@@ -2462,9 +2532,11 @@ class CBEngine:
                 self._fetched_q.clear()
                 exc, self._fetch_exc = self._fetch_exc, None
                 epoch = self._fetch_epoch
-            for ep, entry, arrs in ready:
-                if ep == epoch:
-                    self._emit_entry(entry, arrs)
+            if ready:
+                with self._phase("emit"):
+                    for ep, entry, arrs in ready:
+                        if ep == epoch:
+                            self._emit_entry(entry, arrs)
             if exc is not None:
                 raise exc
             with cv:
@@ -2486,7 +2558,8 @@ class CBEngine:
                 # check so the fetcher cannot pop a batch in between
                 with cv:
                     if self._fetch_inflight:
-                        cv.wait(timeout=0.2)
+                        with self._phase("sample_fetch"):
+                            cv.wait(timeout=0.2)
                         continue
                     # respect ``keep``: a dead fetcher must not turn the
                     # steady-state drain into a full barrier that stalls
@@ -2496,7 +2569,8 @@ class CBEngine:
                              for _ in range(max(0, n))]
                     epoch = self._fetch_epoch
                 if batch:
-                    fetched = jax.device_get([e[1] for e in batch])
+                    with self._phase("sample_fetch"):
+                        fetched = jax.device_get([e[1] for e in batch])
                     with cv:
                         self._fetched_q.extend(
                             (epoch, e, a) for e, a in zip(batch, fetched))
@@ -2504,7 +2578,8 @@ class CBEngine:
             with cv:
                 if not self._fetched_q and (self._emit_q
                                             or self._fetch_inflight):
-                    cv.wait(timeout=0.2)
+                    with self._phase("sample_fetch"):
+                        cv.wait(timeout=0.2)
 
     def _fetch_sync(self, keep: int = 0) -> None:
         """Unthreaded fallback: move queued outputs beyond ``keep`` (oldest
@@ -2516,7 +2591,8 @@ class CBEngine:
         if not batch:
             return
         t0 = time.monotonic()
-        fetched = jax.device_get([e[1] for e in batch])
+        with self._phase("sample_fetch"):
+            fetched = jax.device_get([e[1] for e in batch])
         self._tmark("fetch", t0)
         with self._fetch_cv:
             self._fetched_q.extend(
@@ -2696,7 +2772,8 @@ class CBEngine:
             self._spec_step_once(use_filters)
             return
         t0 = time.monotonic()
-        self._ensure_dev_state()
+        with self._phase("decode_dispatch_device"):
+            self._ensure_dev_state()
         self._tmark("upload", t0)
         st = self._dev_state
         # shared-prefix grouped decode: pack the live group tables (one
@@ -2712,17 +2789,20 @@ class CBEngine:
         if gshape is not None:
             args = args + (jnp.asarray(gpack),)
             self.grouped_decode_dispatches += 1
-        (kp, vp, self._rng, token, logp, done, st["seq_lens"],
-         st["last_tokens"], st["n_generated"], st["active"]) = fn(*args)
+        with self._phase("decode_dispatch_device"):
+            (kp, vp, self._rng, token, logp, done, st["seq_lens"],
+             st["last_tokens"], st["n_generated"], st["active"]) = fn(*args)
         self._tmark("step_dispatch", t0)
         self._pools = (kp, vp)
-        self._account_kv_reads(group_rows, self.steps_per_dispatch)
+        with self._phase("accounting"):
+            self._account_kv_reads(group_rows, self.steps_per_dispatch)
         self._inflight_tok[self._active] += self.steps_per_dispatch
         self._enqueue_output(("step", (token, logp, done),
                              [(int(i), int(self._slot_gen[i]))
                               for i in np.flatnonzero(self._active)],
                              self.steps_per_dispatch, self.weight_version))
-        self._deck_dispatch()
+        with self._phase("accounting"):
+            self._deck_dispatch()
         # run ahead up to pipeline_depth dispatches: older outputs stream
         # out of the fetcher while the device computes, hiding the fetch
         # round trips entirely
@@ -2851,26 +2931,29 @@ class CBEngine:
         device runs ahead."""
         m = self.spec_tokens + 1
         t0 = time.monotonic()
-        self._ensure_dev_state()
+        with self._phase("decode_dispatch_device"):
+            self._ensure_dev_state()
         self._tmark("upload", t0)
         st = self._dev_state
         fn = self._get_spec_step(use_filters, m, self.spec_rounds)
         t0 = time.monotonic()
-        (kp, vp, self._rng, st["tok_buf"], token, logp, done, emitted,
-         st["seq_lens"], st["last_tokens"], st["n_generated"],
-         st["active"]) = fn(
-            self.params, self._pools[0], self._pools[1], self._rng,
-            st["tok_buf"], st["page_table"], st["seq_lens"],
-            st["last_tokens"], st["n_generated"], st["budgets"],
-            st["active"], st["temps"], st["top_ps"], st["top_ks"],
-            st["stop_table"])
+        with self._phase("decode_dispatch_device"):
+            (kp, vp, self._rng, st["tok_buf"], token, logp, done, emitted,
+             st["seq_lens"], st["last_tokens"], st["n_generated"],
+             st["active"]) = fn(
+                self.params, self._pools[0], self._pools[1], self._rng,
+                st["tok_buf"], st["page_table"], st["seq_lens"],
+                st["last_tokens"], st["n_generated"], st["budgets"],
+                st["active"], st["temps"], st["top_ps"], st["top_ks"],
+                st["stop_table"])
         self._tmark("spec_dispatch", t0)
         self._pools = (kp, vp)
         # spec verify attends m virtual rows per slot per round, all over
         # the slot's own pages (grouped decode is decode-path only);
         # tokens normalized by the >=1-per-round emission floor
-        self._account_kv_reads((), self.spec_rounds * m,
-                               k_tokens=self.spec_rounds)
+        with self._phase("accounting"):
+            self._account_kv_reads((), self.spec_rounds * m,
+                                   k_tokens=self.spec_rounds)
         self.spec_dispatches += 1
         # acceptance ceiling: every active slot could emit up to
         # rounds * (spec_tokens+1) tokens from this dispatch
@@ -2882,7 +2965,8 @@ class CBEngine:
                              [(int(i), int(self._slot_gen[i]))
                               for i in np.flatnonzero(self._active)],
                              self.spec_rounds, self.weight_version))
-        self._deck_dispatch()
+        with self._phase("accounting"):
+            self._deck_dispatch()
         self._drain_emit_q(keep=self.pipeline_depth)
 
     def _deck_dispatch(self) -> None:

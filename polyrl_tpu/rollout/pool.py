@@ -389,12 +389,33 @@ class PoolManager:
                     if "kv_restore_rate" in i]
         if restores:
             out["engine/kv_restore_rate"] = max(restores)
+        # engine-loop profiler (obs/engine_profile.py) — the fleet's
+        # weakest link again: the LOWEST device_frac is the engine whose
+        # loop thread is burning the most host wall per device second
+        # (the disaggregation steering signal), the HIGHEST
+        # accounting_frac the first to trip the overhead budget. Presence
+        # guard: engines with loop_profile off (or predating it) are
+        # skipped, not counted as 0.
+        device = [float(i["device_frac"]) for i in rep
+                  if "device_frac" in i]
+        if device:
+            out["engine/device_frac"] = min(device)
+        acct = [float(i["accounting_frac"]) for i in rep
+                if "accounting_frac" in i]
+        if acct:
+            out["engine/accounting_frac"] = max(acct)
+        host = [float(i["host_overhead_frac"]) for i in rep
+                if "host_overhead_frac" in i]
+        if host:
+            out["engine/host_overhead_frac"] = max(host)
         return out
 
     def engine_section(self) -> dict:
         """The trainer-side /statusz ``engine`` block: the fleet aggregate
         plus the per-engine flight-deck view (served from the cached sweep
-        — the exporter never blocks on a respawning manager)."""
+        — the exporter never blocks on a respawning manager). Since v8 it
+        carries the ``loop`` block (the fleet view of the engine-loop
+        profiler) like the rollout plane does."""
         with self._lock:
             insts = list(dict(self._last_status).get("instances", []))
         fleet = {k.split("/", 1)[1]: round(v, 6)
@@ -418,8 +439,43 @@ class PoolManager:
                     i.get("shared_prefix_read_frac", 0.0)),
                 "throughput_tok_s": float(i.get("last_gen_throughput", 0.0)),
                 "kv_cold_page_frac": float(i.get("kv_cold_page_frac", 0.0)),
+                # engine-loop profiler split (presence-guarded: the
+                # manager only forwards them when the engine reports)
+                **({"device_frac": float(i["device_frac"])}
+                   if "device_frac" in i else {}),
+                **({"accounting_frac": float(i["accounting_frac"])}
+                   if "accounting_frac" in i else {}),
                 "running": int(i.get("num_running_reqs", 0)),
             } for i in insts if "occupancy" in i],
+            "loop": self.loop_profile_section(),
+        }
+
+    def loop_profile_section(self) -> dict:
+        """The fleet view of the engine-loop profiler (statusz v8
+        ``engine.loop`` on the trainer plane, and the FlightRecorder's
+        ``engine_profile_fn`` → ``engine_profile.json`` bundle artifact):
+        worst-case device/accounting split + the per-engine rows, served
+        from the cached sweep. ``{"enabled": false}`` when no engine
+        reports the profiler fields (loop_profile off fleet-wide, or
+        engines predating it)."""
+        with self._lock:
+            insts = list(dict(self._last_status).get("instances", []))
+        rep = [i for i in insts
+               if i.get("healthy") and "device_frac" in i]
+        if not rep:
+            return {"enabled": False}
+        return {
+            "enabled": True,
+            "engines_reporting": len(rep),
+            "device_frac_min": round(
+                min(float(i["device_frac"]) for i in rep), 6),
+            "accounting_frac_max": round(
+                max(float(i.get("accounting_frac", 0.0)) for i in rep), 6),
+            "engines": [{
+                "endpoint": i.get("endpoint", ""),
+                "device_frac": float(i["device_frac"]),
+                "accounting_frac": float(i.get("accounting_frac", 0.0)),
+            } for i in rep],
         }
 
     def memory_section(self) -> dict:
@@ -532,13 +588,17 @@ class BalanceEstimator:
     def observe(self, *, step_time_s: float = 0.0,
                 trainer_bubble_s: float = 0.0, throughput: float = 0.0,
                 generate_s: float = 0.0, update_s: float = 0.0,
-                occupancy: float = 0.0, **_ignored) -> None:
+                occupancy: float = 0.0, device_frac: float = 0.0,
+                **_ignored) -> None:
         """Fold one finished step in. ``generate_s``/``update_s`` are the
         goodput ledger's phase walls (timing_s/gen and the actor+critic
         update phases); ``occupancy`` the fleet-mean ``engine/occupancy``
         gauge (one step of lag — the sweep that produced it preceded this
-        record). Extra keys are accepted and ignored so callers can pass
-        a whole stats dict through."""
+        record); ``device_frac`` the fleet-MIN engine-loop profiler
+        device fraction (same lag) — a fleet that looks busy by
+        occupancy but is burning its wall host-side instead of on the
+        device should not read as "add engines". Extra keys are accepted
+        and ignored so callers can pass a whole stats dict through."""
         with self._lock:
             self._steps.append({
                 "step_time_s": float(step_time_s),
@@ -547,6 +607,7 @@ class BalanceEstimator:
                 "generate_s": float(generate_s),
                 "update_s": float(update_s),
                 "occupancy": float(occupancy),
+                "device_frac": float(device_frac),
             })
 
     def _window_median(self, key: str) -> float:
@@ -584,6 +645,10 @@ class BalanceEstimator:
             "bubble_slope": slope("trainer_bubble_s"),
             "step_time_slope": slope("step_time_s"),
             "throughput_slope": slope("throughput"),
+            # engine-loop profiler feed: a falling fleet device_frac with
+            # a rising occupancy reads "the engines are host-bound, not
+            # device-bound — more engines won't help"
+            "device_frac_slope": slope("device_frac"),
             "window_steps": float(len(steps)),
             "balance_trends_valid": 1.0 if valid else 0.0,
         }
@@ -613,6 +678,7 @@ class BalanceEstimator:
             upd = self._window_median("update_s")
             bubble = self._window_median("trainer_bubble_s")
             step = self._window_median("step_time_s")
+            device = self._window_median("device_frac")
         gen_total = gen + bubble  # colocated gen + blocked-on-remote time
         offload = gen_total / (gen_total + upd) if gen_total + upd > 0 else 0.0
         trends = self.trends()
@@ -628,6 +694,9 @@ class BalanceEstimator:
             "pool/balance_occupancy_slope": trends.get(
                 "occupancy_slope", 0.0),
             "pool/balance_bubble_slope": trends.get("bubble_slope", 0.0),
+            # windowed fleet-min engine-loop device fraction (what the
+            # balancer saw, not one sweep's snapshot)
+            "pool/balance_device_frac": device,
             "pool/balance_trends_valid": trends.get(
                 "balance_trends_valid", 0.0),
         }

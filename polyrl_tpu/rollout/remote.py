@@ -692,6 +692,7 @@ class RemoteRollout:
         smoothed.pop("generate_s", None)
         smoothed.pop("update_s", None)
         smoothed.pop("occupancy", None)
+        smoothed.pop("device_frac", None)
         smoothed.update(self.balance.stats())
         try:
             return self.manager.update_metrics(**smoothed)

@@ -58,6 +58,7 @@ def create_server(model: str, manager_endpoint: str | None = None,
                   kv_spill_host_gb: float = 4.0,
                   kv_spill_high_watermark: float = 0.92,
                   kv_spill_low_watermark: float = 0.80,
+                  loop_profile: bool = True,
                   fault_injector=None):
     """Build engine + server, register with the manager, attach receiver.
 
@@ -172,7 +173,8 @@ def create_server(model: str, manager_endpoint: str | None = None,
             kv_spill=kv_spill,
             kv_spill_host_gb=kv_spill_host_gb,
             kv_spill_high_watermark=kv_spill_high_watermark,
-            kv_spill_low_watermark=kv_spill_low_watermark)
+            kv_spill_low_watermark=kv_spill_low_watermark,
+            loop_profile=loop_profile)
     else:
         kwargs = {}
         if batch_buckets:
@@ -321,6 +323,11 @@ def main() -> None:
                         "disables spilling)")
     p.add_argument("--kv-spill-host-gb", type=float, default=4.0,
                    help="host-side capacity of the KV spill tier, GB")
+    p.add_argument("--no-loop-profile", action="store_true",
+                   help="disable the engine-loop profiler (the engine.loop "
+                        "statusz block reads enabled=false and the "
+                        "device_frac/accounting_frac gauges go absent; "
+                        "sampled output is identical either way)")
     p.add_argument("--lora-rank", type=int, default=0,
                    help="LoRA delta sync: serve base + adapters; pushes "
                         "carry only adapters (match the trainer's rank)")
@@ -359,6 +366,7 @@ def main() -> None:
                                args.kv_cold_after_dispatches),
                            kv_spill=not args.no_kv_spill,
                            kv_spill_host_gb=args.kv_spill_host_gb,
+                           loop_profile=not args.no_loop_profile,
                            lora_rank=args.lora_rank,
                            lora_alpha=args.lora_alpha)
     log.info("rollout server on %s", server.endpoint)

@@ -606,6 +606,14 @@ class RolloutServer:
             # TTFT+TPOT tails / token-accounting reconciliation — flat keys
             # the manager's stats poller forwards and bench reads
             info.update(deck.server_info_fields())
+        loop_info = getattr(self.engine, "loop_profile_info", None)
+        if loop_info is not None:
+            # engine-loop profiler (obs/engine_profile.py): the windowed
+            # device-vs-host split as flat keys — the manager's stats
+            # poller forwards device_frac / accounting_frac per instance,
+            # bench's cb phase promotes them, and the engine/* time-series
+            # feed below picks them up ({} when rollout.loop_profile=false)
+            info.update(loop_info())
         kv_info = getattr(self.engine, "kv_memory_info", None)
         if kv_info is not None:
             # KV memory plane (rollout/kvledger.py): residency tiers, the
@@ -698,6 +706,12 @@ class RolloutServer:
                     "shared_prefix_read_frac": float(
                         info.get("shared_prefix_read_frac", 0.0)),
                 }
+        # engine-loop profiler block: ALWAYS present in the engine section
+        # since v8 (even with the deck off / non-cb engines) so consumers
+        # never need existence checks — {"enabled": false} when off
+        loop_snap = getattr(self.engine, "loop_profile_snapshot", None)
+        engine_section["loop"] = (loop_snap() if loop_snap is not None
+                                  else {"enabled": False})
         kv_snap = getattr(self.engine, "kv_memory_snapshot", None)
         return statusz.build_snapshot(
             "rollout",

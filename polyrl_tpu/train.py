@@ -128,7 +128,8 @@ def _build_rollout(cfg: RunConfig, mcfg, params, tokenizer, cleanup: list):
                 kv_spill_host_gb=cfg.rollout.kv_spill_host_gb,
                 kv_spill_high_watermark=cfg.rollout.kv_spill_high_watermark,
                 kv_spill_low_watermark=(
-                    cfg.rollout.kv_spill_low_watermark), **kwargs)
+                    cfg.rollout.kv_spill_low_watermark),
+                loop_profile=cfg.rollout.loop_profile, **kwargs)
         from polyrl_tpu.rollout.engine import RolloutEngine
 
         kwargs = {}
@@ -230,6 +231,7 @@ def _build_rollout(cfg: RunConfig, mcfg, params, tokenizer, cleanup: list):
             kv_spill_host_gb=cfg.rollout.kv_spill_host_gb,
             kv_spill_high_watermark=cfg.rollout.kv_spill_high_watermark,
             kv_spill_low_watermark=cfg.rollout.kv_spill_low_watermark,
+            loop_profile=cfg.rollout.loop_profile,
             **({"prompt_buckets": tuple(cfg.rollout.prompt_buckets)}
                if cfg.rollout.prompt_buckets else {}))
         local_server = RolloutServer(eng, host="127.0.0.1", port=0)

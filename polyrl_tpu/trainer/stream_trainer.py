@@ -315,6 +315,18 @@ class StreamRLTrainer:
             recorder.memory_fn = (
                 lambda: rollout.pool.memory_section()
                 if rollout.pool is not None else {})
+            # device-frac / accounting-frac anomaly bundles carry the
+            # fleet engine-loop profiler view (per-engine device-vs-host
+            # split at anomaly time) as engine_profile.json; a
+            # {"enabled": False} fleet (no engine reporting the profiler)
+            # skips the file, mirroring memory_fn's empty-view semantics
+            def _loop_profile_view():
+                pool = rollout.pool
+                if pool is None:
+                    return {}
+                section = pool.loop_profile_section()
+                return section if section.get("enabled") else {}
+            recorder.engine_profile_fn = _loop_profile_view
 
     # -- profiling (reference _start/_stop_profiling with continuous-step
     # logic, stream_ray_trainer.py:356-361,629-641) ----------------------
@@ -1338,7 +1350,11 @@ class StreamRLTrainer:
                         # aggregation: the balance estimator's trend input
                         # (pool/balance_occupancy_slope)
                         occupancy=float(self._last_record.get(
-                            "engine/occupancy", 0.0)))
+                            "engine/occupancy", 0.0)),
+                        # fleet-min engine-loop device fraction (same lag):
+                        # host-bound engines must not read as "add more"
+                        device_frac=float(self._last_record.get(
+                            "engine/device_frac", 0.0)))
                     if pipeline is not None:
                         # scrape + balancer round-trip ride the pipeline
                         # thread (off the hot path); their gauges land in

@@ -131,7 +131,7 @@ def test_autoscale_chaos_spot_storm_fit():
         pool.wait_for_size(2, deadline_s=20.0)
         # /statusz carries the autoscale section with the decision trail
         snap = trainer.statusz_snapshot()
-        assert snap["schema"] == "polyrl/statusz/v7"
+        assert snap["schema"] == "polyrl/statusz/v8"
         assert snap["autoscale"]["totals"]["adds"] >= 1
         assert snap["autoscale"]["totals"]["drains"] >= 1
         assert snap["autoscale"]["envelope"] == {"min": 2, "max": 2}

@@ -389,4 +389,4 @@ def test_statusz_docs_lint_clean_and_bites(tmp_path):
     assert violations and lint.main([str(probe)]) == 1
     text = "\n".join(violations)
     assert "timeseries" in text and "critpath" in text
-    assert "polyrl/statusz/v7" in text
+    assert "polyrl/statusz/v8" in text

@@ -238,14 +238,14 @@ def test_statusz_v4_conformance_both_planes(tiny):
     the rollout plane's ``engine`` section carries the live ledger."""
     from polyrl_tpu.rollout.server import RolloutServer
 
-    assert statusz.SCHEMA == "polyrl/statusz/v7"
+    assert statusz.SCHEMA == "polyrl/statusz/v8"
     # trainer plane: the standalone exporter over build_snapshot (the only
     # snapshot constructor the trainer uses)
     srv = statusz.StatuszServer(lambda: statusz.build_snapshot(
         "trainer", step=3), host="127.0.0.1").start()
     try:
         snap = _get_json(f"http://{srv.endpoint}/statusz")
-        assert snap["schema"] == "polyrl/statusz/v7"
+        assert snap["schema"] == "polyrl/statusz/v8"
         for section in statusz.REQUIRED_SECTIONS:
             assert section in snap, f"trainer plane missing {section}"
     finally:
@@ -260,7 +260,7 @@ def test_statusz_v4_conformance_both_planes(tiny):
         engine.generate([[5, 3, 9]], SamplingParams(temperature=0.0,
                                                     max_new_tokens=4))
         snap = _get_json(f"http://127.0.0.1:{server.port}/statusz")
-        assert snap["schema"] == "polyrl/statusz/v7"
+        assert snap["schema"] == "polyrl/statusz/v8"
         for section in statusz.REQUIRED_SECTIONS:
             assert section in snap, f"rollout plane missing {section}"
         eng = snap["engine"]

@@ -268,7 +268,7 @@ def test_statusz_v6_memory_section_both_planes(tiny):
     (ALWAYS present — {} when nothing reports)."""
     from polyrl_tpu.rollout.server import RolloutServer
 
-    assert statusz.SCHEMA == "polyrl/statusz/v7"
+    assert statusz.SCHEMA == "polyrl/statusz/v8"
     assert "memory" in statusz.REQUIRED_SECTIONS
 
     # trainer plane: fleet view via build_snapshot's memory kwarg
@@ -279,7 +279,7 @@ def test_statusz_v6_memory_section_both_planes(tiny):
         host="127.0.0.1").start()
     try:
         snap = _get_json(f"http://{srv.endpoint}/statusz")
-        assert snap["schema"] == "polyrl/statusz/v7"
+        assert snap["schema"] == "polyrl/statusz/v8"
         assert snap["memory"] == fleet
     finally:
         srv.stop()
@@ -293,7 +293,7 @@ def test_statusz_v6_memory_section_both_planes(tiny):
         eng.generate([[5] * 16], SamplingParams(temperature=0.0,
                                                 max_new_tokens=4))
         snap = _get_json(f"http://127.0.0.1:{server.port}/statusz")
-        assert snap["schema"] == "polyrl/statusz/v7"
+        assert snap["schema"] == "polyrl/statusz/v8"
         mem = snap["memory"]
         # the four attributable roles cover every page but reserved page 0
         assert sum(mem["roles"].values()) == eng.num_pages - 1

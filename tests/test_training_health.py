@@ -213,7 +213,7 @@ def test_default_watch_directions_and_spec_forms():
 def test_statusz_v4_training_section_always_present():
     from polyrl_tpu.obs import statusz
 
-    assert statusz.SCHEMA == "polyrl/statusz/v7"
+    assert statusz.SCHEMA == "polyrl/statusz/v8"
     assert "training" in statusz.REQUIRED_SECTIONS
     # both roles, no args: every required section present (empty ok)
     for role in ("trainer", "rollout"):
@@ -394,7 +394,7 @@ def test_e2e_fit_training_records_and_entropy_collapse_bundle(tmp_path):
         with urllib.request.urlopen(
                 f"http://{statusz_srv.endpoint}/statusz", timeout=10.0) as r:
             snap = json.loads(r.read())
-        assert snap["schema"] == "polyrl/statusz/v7"
+        assert snap["schema"] == "polyrl/statusz/v8"
         assert snap["training"]["steps"] == 7
         assert snap["training"]["last"][
             "training/entropy"] == pytest.approx(0.01)

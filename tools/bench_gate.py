@@ -162,6 +162,14 @@ WATCHED_EXTRA = (
     # host and HBM means the watermarks are fighting the workload)
     ("kv_spill.sessions_speedup", "low"),
     ("kv_spill.restore_rate", "high"),
+    # engine-loop profiler (obs/engine_profile.py, promoted from the cb
+    # phase): the loop's device fraction dropping between rounds means
+    # the loop thread got host-bound (the chip is starving); the
+    # accounting fraction rising means deck/ledger/spill bookkeeping is
+    # eating the loop. The --loop-profile A/B's own overhead headline
+    # rides the standard value check when that entry runs.
+    ("engine_device_frac", "low"),
+    ("engine_accounting_frac", "high"),
 )
 
 

@@ -54,6 +54,14 @@ stream per decoded token) and ``engine/shared_prefix_read_frac`` (the
 fraction of logically-attended pages the grouped prefix phase
 deduplicated), fed per engine from ``EngineFlightDeck.on_kv_read`` via
 ``server_info`` and aggregated fleet-wide in ``rollout/pool.py``.
+The engine-loop profiler (obs/engine_profile.py) extends the same
+``engine/*`` namespace with the windowed device-vs-host loop-wall split —
+``engine/device_frac`` (fleet MIN: the engine whose loop thread feeds the
+chip least), ``engine/accounting_frac`` (fleet MAX: the worst
+deck/ledger/spill bookkeeping share), ``engine/host_overhead_frac`` and
+``engine/loop_attributed_frac`` — riding the flat ``server_info`` fields
+the manager forwards per instance, plus the balancer-side
+``pool/balance_device_frac`` windowed median.
 The training health
 plane (obs/rlhealth.py) emits ``training/*`` — distribution summaries
 (``training/adv_abs``, ``training/tis_weight``, ``training/staleness``,

@@ -13,7 +13,8 @@ critical-path plane (ARCHITECTURE.md "Critical-path plane") as text:
 - a trend table over the same window: windowed aggregates
   (last/mean/p95/min/max + least-squares slope, obs/timeseries.py) for
   the autoscaling-relevant series — step wall, bottleneck fraction,
-  headroom, occupancy, trainer bubble;
+  headroom, occupancy, the fleet engine-loop device/accounting split,
+  trainer bubble;
 - when pointed at a bundle: the bundle's reason/detail and the recorded
   critical paths (``critical_path.json`` — the segment chain of the last
   traced steps, longest segments first).
@@ -65,6 +66,11 @@ SERIES = (
     ("update_frac", "critpath/update_frac"),
     ("occupancy", "engine/occupancy"),
     ("occupancy_slope", "pool/balance_occupancy_slope"),
+    # engine-loop profiler fleet gauges (obs/engine_profile.py): the
+    # worst engine's device-vs-host split next to the occupancy rail —
+    # busy-but-host-bound fleets show high occupancy with low device_frac
+    ("device_frac", "engine/device_frac"),
+    ("accounting_frac", "engine/accounting_frac"),
     ("trainer_bubble_s", "perf/trainer_bubble_s"),
     ("throughput_tok_s", "perf/throughput_tokens_per_s"),
 )
